@@ -1,0 +1,15 @@
+"""Test harness config.
+
+Tests run on the CPU host platform with 8 virtual devices so multi-chip
+sharding paths compile and execute without TPU hardware (SURVEY.md §4.4 —
+single-process multi-device simulation).  Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

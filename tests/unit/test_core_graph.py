@@ -1,0 +1,181 @@
+"""Graph-mechanics tests for the core Unit/Workflow engine.
+
+Models the reference's workflow semantics (SURVEY.md §3.1): repeater loops,
+Bool gates, link_attrs aliasing, demand checking, initialization sweeps.
+"""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core.units import Unit
+from znicz_tpu.core.workflow import (
+    Workflow, DummyWorkflow, Repeater, NoMoreJobs)
+from znicz_tpu.core.memory import Array, roundup
+from znicz_tpu.core import prng
+
+
+class Counter(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(Counter, self).__init__(workflow, **kwargs)
+        self.count = 0
+
+    def run(self):
+        self.count += 1
+
+
+def test_bool_semantics():
+    a = Bool(False)
+    b = ~a
+    assert not bool(a) and bool(b)
+    a <<= True
+    assert bool(a) and not bool(b)  # derived sees the change lazily
+    c = ~a | b
+    assert not bool(c)
+    a <<= False
+    assert bool(c)
+    with pytest.raises(ValueError):
+        b <<= True  # cannot assign a derived expression
+
+
+def test_linear_chain_runs_once():
+    wf = DummyWorkflow()
+    u1, u2, u3 = (Counter(wf, name="u%d" % i) for i in range(3))
+    u1.link_from(wf.start_point)
+    u2.link_from(u1)
+    u3.link_from(u2)
+    wf.end_point.link_from(u3)
+    wf.initialize()
+    wf.run()
+    assert (u1.count, u2.count, u3.count) == (1, 1, 1)
+
+
+def test_diamond_waits_for_all_parents():
+    wf = DummyWorkflow()
+    a, b, c, d = (Counter(wf, name=n) for n in "abcd")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(a)
+    d.link_from(b, c)  # must fire exactly once, after BOTH b and c
+    wf.end_point.link_from(d)
+    wf.initialize()
+    wf.run()
+    assert d.count == 1
+
+
+def test_repeater_loop_with_gates():
+    """The canonical train loop: repeater -> work -> decision -> repeater,
+    with decision.complete blocking the repeater and passing the end_point."""
+    wf = DummyWorkflow()
+    rep = Repeater(wf, name="repeater")
+    work = Counter(wf, name="work")
+
+    class Decision(Counter):
+        def __init__(self, workflow, **kwargs):
+            super(Decision, self).__init__(workflow, **kwargs)
+            self.complete = Bool(False)
+
+        def run(self):
+            super(Decision, self).run()
+            if self.count >= 5:
+                self.complete <<= True
+
+    dec = Decision(wf, name="decision")
+    rep.link_from(wf.start_point)
+    work.link_from(rep)
+    dec.link_from(work)
+    rep.link_from(dec)          # loop edge
+    wf.end_point.link_from(dec)
+    rep.gate_block = dec.complete
+    wf.end_point.gate_block = ~dec.complete
+    wf.initialize()
+    wf.run()
+    assert work.count == 5
+    assert wf._stopped_by_end_point
+
+
+def test_gate_skip_propagates_without_running():
+    wf = DummyWorkflow()
+    a, b, c = (Counter(wf, name=n) for n in "abc")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    b.gate_skip = Bool(True)
+    wf.initialize()
+    wf.run()
+    assert (a.count, b.count, c.count) == (1, 0, 1)
+
+
+def test_link_attrs_aliasing_two_way():
+    wf = DummyWorkflow()
+    src = Counter(wf, name="src")
+    dst = Counter(wf, name="dst")
+    src.output = numpy.arange(4)
+    dst.link_attrs(src, ("input", "output"))
+    assert (dst.input == numpy.arange(4)).all()
+    src.output = numpy.zeros(2)
+    assert (dst.input == numpy.zeros(2)).all()   # live reference
+    dst.input = numpy.ones(3)
+    assert (src.output == numpy.ones(3)).all()   # write forwards too
+
+
+def test_demand_blocks_initialize():
+    wf = DummyWorkflow()
+    u = Counter(wf, name="needy")
+    u.demand("food")
+    u.link_from(wf.start_point)
+    with pytest.raises(RuntimeError):
+        wf.initialize()
+    u.food = 42
+    wf.initialize()
+    assert u.initialized
+
+
+def test_initialize_retry_sweeps():
+    """B's demand is produced by A's initialize — sweep must resolve it."""
+    wf = DummyWorkflow()
+
+    class Producer(Unit):
+        def initialize(self, **kwargs):
+            super(Producer, self).initialize(**kwargs)
+            consumer.ready = True
+
+    class ConsumerU(Unit):
+        def __init__(self, workflow, **kwargs):
+            super(ConsumerU, self).__init__(workflow, **kwargs)
+            self.demand("ready")
+
+    consumer = ConsumerU(wf, name="consumer")
+    producer = Producer(wf, name="producer")
+    producer.link_from(wf.start_point)
+    consumer.link_from(producer)
+    wf.initialize()
+    assert consumer.initialized
+
+
+def test_array_host_device_mirror():
+    a = Array(numpy.arange(6, dtype=numpy.float32).reshape(2, 3))
+    assert a.shape == (2, 3) and a.sample_size == 3
+    d = a.dev
+    assert d is not None
+    import jax.numpy as jnp
+    a.set_dev(jnp.asarray(d) * 2)
+    assert (a.mem == numpy.arange(6).reshape(2, 3) * 2).all()
+    a.map_write()
+    a.mem[...] = 1
+    assert float(a.dev.sum()) == 6.0
+
+
+def test_roundup_and_prng_determinism():
+    assert roundup(10, 8) == 16 and roundup(16, 8) == 16
+    r1 = prng.RandomGenerator().seed(1234)
+    r2 = prng.RandomGenerator().seed(1234)
+    a = numpy.zeros(16)
+    b = numpy.zeros(16)
+    r1.fill(a, -1, 1)
+    r2.fill(b, -1, 1)
+    assert (a == b).all()
+    k1 = r1.jax_key()
+    k2 = r2.jax_key()
+    assert (numpy.asarray(k1) == numpy.asarray(k2)).all()

@@ -1,0 +1,24 @@
+"""znicz_tpu — a TPU-native deep-learning framework with the capabilities of
+Samsung Veles/Znicz (reference: sycomix/veles.znicz).
+
+This is NOT a port.  The reference is a unit-at-a-time OpenCL/CUDA dataflow
+interpreter; znicz_tpu keeps the reference's *observable* architecture —
+declarative ``layers`` configs, type-string unit registry, forward/backward
+pairing, loader/evaluator/decision/snapshotter roles, master-slave-equivalent
+data parallelism — while executing compute the TPU way:
+
+* layers are pure functions over pytrees (``znicz_tpu.ops``),
+* the whole per-minibatch forward+backward+update compiles to ONE XLA
+  computation (``znicz_tpu.parallel.train_step``),
+* data parallelism is SPMD ``shard_map`` + ``psum`` over a
+  ``jax.sharding.Mesh`` (ICI collectives), not a parameter server,
+* the unit graph survives as the epoch-level control plane, where Python
+  gating is cheap (reference: veles.workflow / veles.units).
+
+Reference version parity target: Znicz 0.8.2 (/root/reference/__init__.py:48).
+"""
+
+__version__ = "0.1.0"
+__znicz_parity__ = "0.8.2"
+
+from znicz_tpu.core.config import root  # noqa: F401

@@ -1,0 +1,7 @@
+"""Core runtime — the TPU-era equivalent of the external Veles core platform.
+
+The reference imports ~50 ``veles.*`` modules (SURVEY.md §2.9); this package
+provides that observed contract: config root, Logger, seedable PRNG,
+Unit/Workflow dataflow engine, mirrored host/device Array, distributable
+protocol, snapshotter, dummy launcher.
+"""

@@ -1,0 +1,89 @@
+"""Accelerated units — backend-dispatching compute nodes.
+
+TPU-era equivalent of ``veles.accelerated_units`` (SURVEY.md layer L3, §3.2).
+The reference dispatches ``numpy_run`` / ``ocl_run`` / ``cuda_run``;
+znicz_tpu dispatches ``numpy_run`` / ``jax_run``.  ``jax_run`` bodies call
+jitted pure functions from :mod:`znicz_tpu.ops` on ``Array.dev`` buffers and
+store results with ``Array.set_dev`` — no host round-trips between chained
+units (the reference's map/unmap invariant, kept).
+
+There is deliberately NO build_program/get_kernel machinery: XLA tracing is
+the kernel JIT.  ``initialize`` is where output shapes are computed and
+buffers allocated, mirroring the reference lifecycle.
+"""
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.backends import NumpyDevice, JaxDevice, get_device
+from znicz_tpu.core.workflow import Workflow
+
+
+class INumpyUnit(object):
+    """Marker: unit has a numpy_run path (parity: veles INumpyUnit)."""
+
+
+class IJaxUnit(object):
+    """Marker: unit has a jax_run path (replaces IOpenCLUnit/ICUDAUnit)."""
+
+
+class AcceleratedUnit(Unit):
+    """A unit whose ``run`` dispatches on the device backend."""
+
+    def __init__(self, workflow, **kwargs):
+        super(AcceleratedUnit, self).__init__(workflow, **kwargs)
+        self.device = None
+        self.intel_opencl_workaround = False  # parity stub (all2all.py:248)
+
+    def initialize(self, device=None, **kwargs):
+        super(AcceleratedUnit, self).initialize(device=device, **kwargs)
+        self.device = device if device is not None else get_device()
+
+    def run(self):
+        if isinstance(self.device, NumpyDevice):
+            return self.numpy_run()
+        return self.jax_run()
+
+    # Subclasses implement both paths; numpy is the executable spec.
+    def numpy_run(self):
+        raise NotImplementedError(
+            "%s lacks numpy_run" % type(self).__name__)
+
+    def jax_run(self):
+        raise NotImplementedError(
+            "%s lacks jax_run" % type(self).__name__)
+
+    # -- buffer helpers (reference: init_vectors/unmap_vectors) -------------
+    def init_vectors(self, *arrays):
+        for a in arrays:
+            if a is not None and bool(a):
+                a.mem  # materialize host view
+
+    def unmap_vectors(self, *arrays):
+        for a in arrays:
+            if a is not None and bool(a):
+                a.unmap()
+
+    @staticmethod
+    def new_array(data=None, name=None):
+        return Array(data, name=name)
+
+
+class TrivialAcceleratedUnit(AcceleratedUnit):
+    def numpy_run(self):
+        pass
+
+    def jax_run(self):
+        pass
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow carrying a device for its accelerated units."""
+
+    def __init__(self, workflow=None, **kwargs):
+        super(AcceleratedWorkflow, self).__init__(workflow, **kwargs)
+
+    def initialize(self, device=None, **kwargs):
+        if device is None:
+            device = get_device()
+        return super(AcceleratedWorkflow, self).initialize(
+            device=device, **kwargs)

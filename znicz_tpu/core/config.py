@@ -1,0 +1,135 @@
+"""Hierarchical attribute-dict configuration tree.
+
+TPU-era equivalent of ``veles.config`` (reference usage:
+samples/MNIST/mnist_config.py:43-89, standard_workflow_base.py:56-71).
+Namespaces auto-vivify on attribute access; ``update`` merges nested dicts;
+values may be arbitrary Python objects (including ``genetics.Range``).
+"""
+
+import json
+
+
+class Config(object):
+    """One node of the config tree.  Attribute access auto-creates children."""
+
+    def __init__(self, path="root", **kwargs):
+        object.__setattr__(self, "_path_", path)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    # -- auto-vivification --------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("_") and name.endswith("_"):
+            raise AttributeError(name)
+        child = Config("%s.%s" % (self._path_, name))
+        object.__setattr__(self, name, child)
+        return child
+
+    def __setattr__(self, name, value):
+        if isinstance(value, dict):
+            node = getattr(self, name)
+            if isinstance(node, Config):
+                node.update(value)
+                return
+            value_cfg = Config("%s.%s" % (self._path_, name))
+            value_cfg.update(value)
+            value = value_cfg
+        object.__setattr__(self, name, value)
+
+    # -- dict-ish interface -------------------------------------------------
+    def update(self, value=None, **kwargs):
+        """Recursively merge a dict (or another Config) into this node."""
+        if value is None:
+            value = kwargs
+        if isinstance(value, Config):
+            value = value.as_dict()
+        if not isinstance(value, dict):
+            raise TypeError(
+                "Config.update takes a dict, got %s" % type(value))
+        for k, v in value.items():
+            if isinstance(v, dict):
+                node = getattr(self, k)
+                if isinstance(node, Config):
+                    node.update(v)
+                else:
+                    setattr(self, k, v)
+            else:
+                object.__setattr__(self, k, v)
+        return self
+
+    def __contains__(self, name):
+        return name in self.__dict__
+
+    def get(self, name, default=None):
+        v = self.__dict__.get(name, default)
+        return v
+
+    def items(self):
+        return ((k, v) for k, v in self.__dict__.items()
+                if not (k.startswith("_") and k.endswith("_")))
+
+    def keys(self):
+        return (k for k, _ in self.items())
+
+    def as_dict(self):
+        out = {}
+        for k, v in self.items():
+            out[k] = v.as_dict() if isinstance(v, Config) else v
+        return out
+
+    # -- presentation -------------------------------------------------------
+    def __repr__(self):
+        return "<Config %s: %s>" % (self._path_, sorted(self.__dict__))
+
+    def print_(self, indent=0, file=None):
+        import sys
+        file = file or sys.stdout
+        for k, v in sorted(self.items()):
+            if isinstance(v, Config):
+                print("%s%s:" % ("  " * indent, k), file=file)
+                v.print_(indent + 1, file)
+            else:
+                print("%s%s: %s" % ("  " * indent, k, v), file=file)
+
+    def to_json(self):
+        def default(o):
+            if isinstance(o, Config):
+                return o.as_dict()
+            return repr(o)
+        return json.dumps(self.as_dict(), default=default, sort_keys=True)
+
+
+#: The global configuration root (reference: ``veles.config.root``).
+root = Config("root")
+
+# Engine-level defaults observed in the reference
+# (samples/CIFAR10/cifar_caffe_config.py:52-53, site_config.py:37-40).
+root.common.update({
+    "engine": {
+        "precision_type": "float",    # "float" | "double"
+        "precision_level": 0,         # 0: fast, 1: deterministic-ish
+        "backend": "auto",            # "numpy" | "jax" | "auto"
+    },
+    "dirs": {
+        "datasets": "/root/repo/.data",
+        "snapshots": "/root/repo/.snapshots",
+        "cache": "/root/repo/.cache",
+    },
+    "disable": {"plotting": True, "publishing": True},
+})
+
+
+def get(value, default=None):
+    """Return ``value`` unless it is an untouched auto-vivified Config node."""
+    if value is None:
+        return default
+    if isinstance(value, Config) and not any(True for _ in value.keys()):
+        return default
+    return value
+
+
+def dtype_map():
+    """Numpy dtype for the configured precision."""
+    import numpy
+    return {"float": numpy.float32, "double": numpy.float64}[
+        root.common.engine.precision_type]

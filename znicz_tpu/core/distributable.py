@@ -1,0 +1,35 @@
+"""The IDistributable protocol.
+
+Parity with the reference's master-slave data-parallel contract
+(SURVEY.md §2.8, §3.3; nn_units.py:178-211, 644-694).  In znicz_tpu the
+*performance* path for data parallelism is SPMD psum over the ICI mesh
+(znicz_tpu.parallel), but the protocol methods are kept because the
+reference uses them in-process too — e.g. weight copy during forward-workflow
+extraction (standard_workflow.py:282-286) — and they remain the portable
+serialization boundary for elastic multi-process training over DCN.
+"""
+
+
+class IDistributable(object):
+    """Units override the subset they need; defaults are no-ops."""
+
+    negotiates_on_connect = False
+
+    def generate_data_for_master(self):
+        return None
+
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass
+
+    def apply_data_from_slave(self, data, slave=None):
+        pass
+
+    def drop_slave(self, slave=None):
+        pass
+
+
+class TriviallyDistributable(IDistributable):
+    """Stateless under distribution (reference: pooling.py:122)."""

@@ -1,0 +1,58 @@
+"""InputJoiner — concatenates several input Arrays along the feature axis.
+
+TPU-era equivalent of ``veles.input_joiner.InputJoiner`` (used by the LSTM
+cell sub-workflow, reference lstm.py:91-137).
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+
+
+class InputJoiner(AcceleratedUnit):
+    def __init__(self, workflow, **kwargs):
+        super(InputJoiner, self).__init__(workflow, **kwargs)
+        self.inputs = kwargs.get("inputs", [])
+        self.output = Array(name="joined")
+        self.demand("inputs")
+
+    def link_inputs(self, other, *attrs):
+        """Add attributes of ``other`` as inputs (live references)."""
+        for attr in attrs:
+            self.inputs.append((other, attr))
+        return self
+
+    def _resolved_inputs(self):
+        out = []
+        for item in self.inputs:
+            if isinstance(item, tuple):
+                unit, attr = item
+                out.append(getattr(unit, attr))
+            else:
+                out.append(item)
+        return out
+
+    def initialize(self, device=None, **kwargs):
+        super(InputJoiner, self).initialize(device=device, **kwargs)
+        ins = self._resolved_inputs()
+        if not ins:
+            raise ValueError(
+                "%s: no inputs configured (pass inputs= or call "
+                "link_inputs())" % self.name)
+        batch = ins[0].shape[0]
+        width = sum(a.sample_size for a in ins)
+        self.output.reset(numpy.zeros((batch, width),
+                                      dtype=ins[0].dtype))
+
+    def numpy_run(self):
+        ins = self._resolved_inputs()
+        self.output.map_invalidate()
+        self.output.mem[...] = numpy.concatenate(
+            [a.matrix for a in ins], axis=1)
+
+    def jax_run(self):
+        import jax.numpy as jnp
+        ins = self._resolved_inputs()
+        devs = [a.dev.reshape(a.shape[0], -1) for a in ins]
+        self.output.set_dev(jnp.concatenate(devs, axis=1))

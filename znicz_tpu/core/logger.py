@@ -1,0 +1,52 @@
+"""Logger mixin — every unit logs with its own name prefix.
+
+TPU-era equivalent of ``veles.logger.Logger`` (SURVEY.md §5.5).
+"""
+
+import logging
+
+_configured = False
+
+
+def setup_logging(level=logging.INFO):
+    global _configured
+    if _configured:
+        return
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+        datefmt="%H:%M:%S")
+    _configured = True
+
+
+class Logger(object):
+    """Mixin giving self.debug/info/warning/error with class-name prefixes."""
+
+    def __init__(self, **kwargs):
+        super(Logger, self).__init__()
+        setup_logging()
+        self._logger_ = logging.getLogger(
+            kwargs.get("logger_name", type(self).__name__))
+
+    @property
+    def logger(self):
+        try:
+            return self._logger_
+        except AttributeError:
+            self._logger_ = logging.getLogger(type(self).__name__)
+            return self._logger_
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+    def exception(self, msg="Exception", *args):
+        self.logger.exception(msg, *args)

@@ -1,0 +1,226 @@
+"""Mirrored host/device tensor buffers.
+
+TPU-era equivalent of ``veles.memory.Array`` (SURVEY.md layer L1).  The
+reference's central invariant — crossing the host/device boundary is explicit
+and lazy via ``map_read/map_write/map_invalidate/unmap`` — is kept, but the
+device side is an immutable ``jax.Array``: device "writes" replace the buffer
+(:meth:`Array.set_dev`), which is exactly how XLA wants it.  Chains of units
+pass device buffers to each other without host round-trips; ``.mem`` pulls to
+host on demand.
+
+States:
+  HOST  — host numpy copy is authoritative (device stale/absent)
+  DEV   — device jax.Array is authoritative (host stale/absent)
+  SYNC  — both valid
+"""
+
+import numpy
+
+HOST, DEV, SYNC = "host", "dev", "sync"
+
+
+def roundup(n, m):
+    """Round ``n`` up to a multiple of ``m`` (reference: veles.memory.roundup)."""
+    r = n % m
+    return n if r == 0 else n + m - r
+
+
+class Array(object):
+    """A tensor mirrored between host numpy and device jax.Array."""
+
+    __slots__ = ("_host", "_dev", "_state", "name")
+
+    def __init__(self, data=None, name=None):
+        self._host = None
+        self._dev = None
+        self._state = HOST
+        self.name = name
+        if data is not None:
+            self.mem = data
+
+    # -- allocation / reset -------------------------------------------------
+    def reset(self, arr=None):
+        """Drop current contents; optionally adopt a new host array.
+
+        Reference: ``Array.reset`` (used by unit initialize to realloc).
+        """
+        self._host = None if arr is None else numpy.asarray(arr)
+        self._dev = None
+        self._state = HOST
+        return self
+
+    @property
+    def mem(self):
+        """Host numpy view (syncs from device if the device copy is newer)."""
+        if self._state == DEV:
+            self._host = numpy.asarray(self._dev)
+            self._state = SYNC
+        return self._host
+
+    @mem.setter
+    def mem(self, value):
+        if value is None:
+            self.reset()
+            return
+        self._host = value if isinstance(value, numpy.ndarray) \
+            else numpy.asarray(value)
+        self._state = HOST
+
+    # -- explicit mapping (reference contract, nn_units.py:51) --------------
+    def map_read(self):
+        if self._state == DEV:
+            self._host = numpy.asarray(self._dev)
+            self._state = SYNC
+        return self
+
+    def map_write(self):
+        self.map_read()
+        if self._host is not None and not self._host.flags.writeable:
+            self._host = numpy.array(self._host)  # jax gives read-only views
+        self._state = HOST
+        return self
+
+    def map_invalidate(self):
+        """Host will be overwritten wholesale; skip device download."""
+        if self._host is None and self._dev is not None:
+            self._host = numpy.empty(self._dev.shape,
+                                     dtype=numpy.dtype(str(self._dev.dtype)))
+        elif self._host is not None and not self._host.flags.writeable:
+            self._host = numpy.empty_like(self._host)
+        self._state = HOST
+        return self
+
+    def unmap(self):
+        """Hand ownership to the device (uploads if host was dirty)."""
+        self.dev
+        return self
+
+    # -- device side --------------------------------------------------------
+    @property
+    def dev(self):
+        """Device jax.Array (uploads host if the host copy is newer)."""
+        import jax
+        if self._state == HOST:
+            if self._host is None:
+                return None
+            self._dev = jax.device_put(self._host)
+            self._state = SYNC
+        return self._dev
+
+    def set_dev(self, arr):
+        """Adopt a new device array as authoritative (a device 'write')."""
+        self._dev = arr
+        self._state = DEV
+        return self
+
+    @property
+    def devmem(self):  # reference-compatible alias
+        return self.dev
+
+    # -- shape & views ------------------------------------------------------
+    def __bool__(self):
+        return self._host is not None or self._dev is not None
+
+    __nonzero__ = __bool__
+
+    @property
+    def shape(self):
+        if self._state == DEV and self._dev is not None:
+            return tuple(self._dev.shape)
+        return self._host.shape if self._host is not None else \
+            (tuple(self._dev.shape) if self._dev is not None else None)
+
+    @shape.setter
+    def shape(self, value):
+        self.mem = self.mem.reshape(value)
+
+    @property
+    def size(self):
+        s = self.shape
+        return 0 if s is None else int(numpy.prod(s)) if s else 1
+
+    @property
+    def sample_size(self):
+        """Elements per sample = size / shape[0] (reference semantics)."""
+        s = self.shape
+        return 0 if not s else self.size // s[0]
+
+    @property
+    def dtype(self):
+        if self._host is not None:
+            return self._host.dtype
+        if self._dev is not None:
+            return numpy.dtype(str(self._dev.dtype))
+        return None
+
+    @property
+    def matrix(self):
+        """2D (n_samples, sample_size) host view."""
+        m = self.mem
+        return m.reshape(m.shape[0], -1)
+
+    @property
+    def plain(self):
+        """Flat host view."""
+        return self.mem.reshape(-1)
+
+    def __len__(self):
+        s = self.shape
+        return s[0] if s else 0
+
+    def __getitem__(self, idx):
+        return self.mem[idx]
+
+    def __setitem__(self, idx, value):
+        self.map_write()
+        self.mem[idx] = value
+
+    def __repr__(self):
+        return "<Array %s %s %s state=%s>" % (
+            self.name or "", self.shape, self.dtype, self._state)
+
+
+def reshape(arr, shape):
+    """Reshape an Array's host view (reference: veles.memory.reshape)."""
+    arr.mem = arr.mem.reshape(shape)
+    return arr.mem
+
+
+def reshape_transposed(arr):
+    m = arr.mem
+    return m.reshape(m.shape[::-1])
+
+
+def ravel(arr):
+    return arr.mem.reshape(-1)
+
+
+def interleave(arr):
+    """CHW → HWC style interleave helper used by image tooling."""
+    if arr.ndim == 3:
+        return numpy.transpose(arr, (1, 2, 0))
+    if arr.ndim == 4:
+        return numpy.transpose(arr, (0, 2, 3, 1))
+    raise ValueError("interleave expects 3D/4D")
+
+
+class NumDiff(object):
+    """Five-point numeric differentiation helper.
+
+    Reference: ``veles.memory.NumDiff`` used by the gradient numdiff harness
+    (tests/unit/gd_numdiff.py:74-78) — valid in float64 only.
+    """
+
+    #: Perturbation offsets in units of h.
+    points = (2.0, 1.0, -1.0, -2.0)
+    #: Five-point stencil coefficients / (12 h).
+    coeffs = numpy.array([-1.0, 8.0, -8.0, 1.0], dtype=numpy.float64)
+    divizor = 12.0
+    h = 1.0e-4  # matches NumDiff usage scale in the reference tests
+
+    def __init__(self):
+        self.errs = numpy.zeros(len(NumDiff.points), dtype=numpy.float64)
+
+    @property
+    def derivative(self):
+        return (self.errs * NumDiff.coeffs).sum() / (NumDiff.divizor * NumDiff.h)

@@ -1,0 +1,118 @@
+"""Seedable PRNG streams.
+
+TPU-era equivalent of ``veles.prng`` (SURVEY.md §2.9).  The reference keeps a
+registry of named streams (``prng.get(key)``), seeded from 1024-int32 seed
+files by the functional-test harness (tests/functional/standard_test.py:67-73)
+and used for weight init (``rand.fill`` / ``rand.fill_normal_real``,
+all2all.py:119-127), loader shuffling, and dropout mask generation
+(dropout.py:110,149).
+
+TPU-first addition: every stream can also mint ``jax.random`` keys
+(:meth:`RandomGenerator.jax_key`) so device-side randomness (dropout,
+stochastic pooling) is reproducible from the same seed, replacing the
+reference's device-side xorshift state arrays (dropout.py:112-117).
+"""
+
+import numpy
+
+
+class RandomGenerator(object):
+    """One seedable random stream wrapping ``numpy.random.RandomState``."""
+
+    def __init__(self, key=None):
+        self.key = key
+        self._state = numpy.random.RandomState()
+        self._seed_arr = None
+        self._key_counter = 0
+        self.seed(numpy.frombuffer(b"znicz-tpu-default-seed-0123456789ab",
+                                   dtype=numpy.uint8))
+
+    # -- seeding ------------------------------------------------------------
+    def seed(self, seed, dtype=None, count=None):
+        """Seed from an int, an array, or a file path of raw ``dtype`` values.
+
+        Mirrors the reference harness contract
+        (tests/functional/standard_test.py:67-73): seed files are raw binary,
+        read as ``count`` items of ``dtype``.
+        """
+        if isinstance(seed, str):
+            seed = numpy.fromfile(seed, dtype=dtype or numpy.int32,
+                                  count=count or 1024)
+        if isinstance(seed, (int, numpy.integer)):
+            arr = numpy.asarray([seed], dtype=numpy.uint32)
+        else:
+            raw = numpy.ascontiguousarray(seed).tobytes()
+            raw += b"\x00" * (-len(raw) % 4)
+            arr = numpy.frombuffer(raw, dtype=numpy.uint32).copy()
+        self._seed_arr = arr
+        self._state.seed(arr)
+        self._key_counter = 0
+        return self
+
+    @property
+    def state(self):
+        return self._state
+
+    # -- in-place fillers (reference: all2all.py:119-127) -------------------
+    def fill(self, arr, vle_min=-1.0, vle_max=1.0):
+        """Uniform fill of a numpy array in place."""
+        arr[...] = self._state.uniform(
+            vle_min, vle_max, size=arr.shape).astype(arr.dtype)
+
+    def fill_normal_real(self, arr, mean=0.0, stddev=1.0, clip_to_sigma=None):
+        vals = self._state.normal(mean, stddev, size=arr.shape)
+        if clip_to_sigma is not None:
+            vals = numpy.clip(vals, mean - clip_to_sigma * stddev,
+                              mean + clip_to_sigma * stddev)
+        arr[...] = vals.astype(arr.dtype)
+
+    # -- draws --------------------------------------------------------------
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self._state.normal(loc, scale, size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return self._state.uniform(low, high, size)
+
+    def randint(self, low, high=None, size=None, dtype=int):
+        return self._state.randint(low, high, size).astype(dtype)
+
+    def rand(self, *shape):
+        return self._state.rand(*shape)
+
+    def shuffle(self, arr):
+        self._state.shuffle(arr)
+
+    def permutation(self, n):
+        return self._state.permutation(n)
+
+    def choice(self, a, size=None, replace=True, p=None):
+        return self._state.choice(a, size, replace, p)
+
+    # -- TPU-first: deterministic jax.random keys ---------------------------
+    def jax_key(self):
+        """Mint the next ``jax.random`` key in this stream.
+
+        Deterministic given the seed: key #n after seeding is always the
+        same.  This is how device-side randomness (dropout masks, stochastic
+        pooling) stays reproducible under jit.
+        """
+        import jax
+        base = int(self._seed_arr.view(numpy.uint32)[:2].sum()) & 0x7FFFFFFF
+        self._key_counter += 1
+        return jax.random.fold_in(
+            jax.random.PRNGKey(base), self._key_counter)
+
+
+# -- stream registry (reference: veles.prng.get) ---------------------------
+_streams = {}
+
+
+def get(key=1):
+    """Return the process-global stream with the given key (default 1).
+
+    The reference seeds two streams (keys 1 and 2) in functional tests.
+    """
+    rg = _streams.get(key)
+    if rg is None:
+        rg = _streams[key] = RandomGenerator(key)
+    return rg

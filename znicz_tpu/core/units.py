@@ -1,0 +1,204 @@
+"""The Unit dataflow-graph node.
+
+TPU-era equivalent of ``veles.units.Unit`` (SURVEY.md layer L3).  Contract
+observed at reference call sites:
+
+* ``link_from(*parents)`` — control edges; a unit fires when ALL parents have
+  signalled (``Repeater`` fires on ANY, closing the training loop).
+* ``link_attrs(other, "a", ("mine", "theirs"))`` — live attribute aliasing;
+  reads and writes forward to the source unit (standard_workflow.py:346-363).
+* ``gate_block`` / ``gate_skip`` — ``mutable.Bool`` gates: *block* consumes
+  the signal (no run, no propagation); *skip* propagates without running
+  (standard_workflow.py:365,488,514,528).
+* ``demand("attr")`` — attributes that must be non-None by ``initialize``
+  (all2all.py:100, conv.py:63).
+
+In znicz_tpu this graph is the *epoch-level control plane*; per-minibatch
+compute lives in jitted pure functions (znicz_tpu.ops).  Python-level gating
+is cheap at that cadence and semantically identical to the reference.
+"""
+
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core.mutable import Bool
+
+
+class Unit(Logger):
+    """A node in the control-plane dataflow graph."""
+
+    def __init__(self, workflow, **kwargs):
+        self.name = kwargs.get("name", type(self).__name__)
+        super(Unit, self).__init__(logger_name=self.name)
+        self._links_from = {}      # src unit -> fired flag
+        self._links_to = {}        # dst unit -> True
+        self._linked_attrs_ = {}   # my attr -> (src unit, src attr, two_way)
+        self.gate_block = kwargs.get("gate_block", Bool(False))
+        self.gate_skip = kwargs.get("gate_skip", Bool(False))
+        self._demanded = set()
+        self.view_group = kwargs.get("view_group", None)
+        self._initialized = False
+        self.run_was_called = False
+        self.workflow = None
+        if workflow is not None:
+            workflow.add_unit(self)
+
+    # -- attribute forwarding ----------------------------------------------
+    def __getattr__(self, name):
+        # Only called when normal lookup fails.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        linked = self.__dict__.get("_linked_attrs_")
+        if linked and name in linked:
+            src, src_attr, _ = linked[name]
+            return getattr(src, src_attr)
+        raise AttributeError("%s has no attribute %r" % (self.name, name))
+
+    def __setattr__(self, name, value):
+        linked = self.__dict__.get("_linked_attrs_")
+        if linked and name in linked:
+            src, src_attr, two_way = linked[name]
+            if two_way:
+                setattr(src, src_attr, value)
+            else:
+                del linked[name]  # local write detaches a one-way alias
+                object.__setattr__(self, name, value)
+            return
+        object.__setattr__(self, name, value)
+
+    def link_attrs(self, other, *args, two_way=True):
+        """Alias attributes of ``other`` as my own (live references).
+
+        ``two_way=False`` makes a read-only alias: a local write detaches
+        the link instead of mutating the source unit.
+        """
+        for arg in args:
+            if isinstance(arg, tuple):
+                mine, theirs = arg
+            else:
+                mine = theirs = arg
+            if mine in self.__dict__:
+                del self.__dict__[mine]
+            self._linked_attrs_[mine] = (other, theirs, two_way)
+        return self
+
+    def has_linked_attr(self, name):
+        return name in self._linked_attrs_
+
+    # -- demands ------------------------------------------------------------
+    def demand(self, *names):
+        self._demanded.update(names)
+
+    def undemand(self, *names):
+        self._demanded.difference_update(names)
+
+    def _check_demands(self):
+        missing = []
+        for name in sorted(self._demanded):
+            try:
+                v = getattr(self, name)
+            except AttributeError:
+                v = None
+            if v is None:
+                missing.append(name)
+        return missing
+
+    # -- control edges -------------------------------------------------------
+    def link_from(self, *parents):
+        for p in parents:
+            self._links_from[p] = False
+            p._links_to[self] = True
+        return self
+
+    def unlink_from(self, *parents):
+        for p in parents:
+            self._links_from.pop(p, None)
+            p._links_to.pop(self, None)
+        return self
+
+    def unlink_all(self):
+        for p in list(self._links_from):
+            self.unlink_from(p)
+        for d in list(self._links_to):
+            d.unlink_from(self)
+        return self
+
+    @property
+    def links_from(self):
+        return self._links_from
+
+    @property
+    def links_to(self):
+        return self._links_to
+
+    # -- firing protocol -----------------------------------------------------
+    def _signal(self, src):
+        """A parent finished; fire when all parents have."""
+        if src in self._links_from:
+            self._links_from[src] = True
+        if self._ready_to_fire():
+            self.workflow._schedule(self)
+
+    def _ready_to_fire(self):
+        return all(self._links_from.values())
+
+    def _reset_fired(self):
+        for k in self._links_from:
+            self._links_from[k] = False
+
+    def _fire(self):
+        """Called by the workflow scheduler when this unit's turn comes."""
+        self._reset_fired()
+        if bool(self.gate_block):
+            return  # consume the signal
+        if not bool(self.gate_skip):
+            self.run()
+            self.run_was_called = True
+        for dst in list(self._links_to):
+            dst._signal(self)
+
+    # -- lifecycle ------------------------------------------------------------
+    @property
+    def initialized(self):
+        return self._initialized
+
+    def initialize(self, device=None, **kwargs):
+        """Allocate buffers etc.  Subclasses override; call super() first."""
+        self._initialized = True
+
+    def run(self):
+        pass
+
+    def stop(self):
+        pass
+
+    @property
+    def is_slave(self):
+        wf = self.workflow
+        return wf.is_slave if wf is not None else False
+
+    @property
+    def is_master(self):
+        wf = self.workflow
+        return wf.is_master if wf is not None else False
+
+    @property
+    def is_standalone(self):
+        return not self.is_slave and not self.is_master
+
+    def __repr__(self):
+        return "<%s %r>" % (type(self).__name__, self.name)
+
+
+class IUnit(object):
+    """Marker interface kept for reference parity (veles.units.IUnit)."""
+
+
+def nothing(*args, **kwargs):
+    """No-op placeholder (reference: veles.units.nothing)."""
+    return None
+
+
+class TrivialUnit(Unit):
+    """A unit that does nothing when run."""
+
+    def run(self):
+        pass

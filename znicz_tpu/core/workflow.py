@@ -1,0 +1,273 @@
+"""Workflow — a container of units with a queue-based dataflow scheduler.
+
+TPU-era equivalent of ``veles.workflow`` (SURVEY.md layer L3, §3.1).  The
+reference runs an event-driven async engine; at TPU epoch-level cadence a
+synchronous FIFO scheduler is semantically identical and much simpler:
+units fire when all their parents have signalled and their gates permit.
+
+The canonical training graph (standard_workflow.py:173-208) is a loop:
+repeater -> loader -> forwards -> evaluator -> decision -> snapshotter ->
+gds -> (back to repeater), with ``decision.complete`` gating the repeater
+(block) and the end_point (pass).
+"""
+
+from collections import deque
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.core import prng as random_generator
+
+
+class NoMoreJobs(Exception):
+    """Raised by a decision when the training run is over
+    (reference: veles.workflow.NoMoreJobs, decision.py:218-220)."""
+
+
+class StartPoint(Unit):
+    def run(self):
+        pass
+
+
+class EndPoint(Unit):
+    def run(self):
+        self.workflow._on_end_point()
+
+
+class Repeater(Unit):
+    """Fires on ANY parent signal — the loop-closing unit
+    (reference: veles.workflow.Repeater)."""
+
+    def _ready_to_fire(self):
+        return any(self._links_from.values()) or not self._links_from
+
+    def _reset_fired(self):
+        for k in self._links_from:
+            self._links_from[k] = False
+
+
+class FireStarter(Unit):
+    """Re-arms gates of listed units (reference: veles.plumbing.FireStarter,
+    linked by standard_workflow_base.link_fire_starter)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(FireStarter, self).__init__(workflow, **kwargs)
+        self.units = kwargs.get("units", [])
+
+    def run(self):
+        for u in self.units:
+            u.gate_block <<= False
+
+
+class Workflow(Unit):
+    """A unit container + scheduler.  Nestable (a Workflow is a Unit)."""
+
+    def __init__(self, workflow=None, **kwargs):
+        self._units = []
+        super(Workflow, self).__init__(workflow, **kwargs)
+        self.start_point = StartPoint(self, name="start_point")
+        self.end_point = EndPoint(self, name="end_point")
+        self._queue = deque()
+        self._running = False
+        self._stopped_by_end_point = False
+        self.launcher = kwargs.get("launcher", None)
+        self._is_slave = False
+        self._is_master = False
+        self.device = None
+        self._finished_callbacks = []
+
+    # -- container -----------------------------------------------------------
+    def add_unit(self, unit):
+        if unit.workflow is not None and unit.workflow is not self:
+            raise ValueError(
+                "%s already belongs to workflow %s" % (unit.name,
+                                                       unit.workflow.name))
+        if unit.workflow is None:
+            unit.workflow = self
+            self._units.append(unit)
+        return unit
+
+    def add_ref(self, unit):  # reference-compatible alias
+        return self.add_unit(unit)
+
+    def del_ref(self, unit):
+        if unit in self._units:
+            self._units.remove(unit)
+            unit.workflow = None
+
+    @property
+    def units(self):
+        return list(self._units)
+
+    def __iter__(self):
+        return iter(self._units)
+
+    # -- roles ---------------------------------------------------------------
+    @property
+    def is_slave(self):
+        return self._is_slave
+
+    @property
+    def is_master(self):
+        return self._is_master
+
+    @property
+    def is_standalone(self):
+        return not (self._is_slave or self._is_master)
+
+    # -- lifecycle -----------------------------------------------------------
+    def initialize(self, device=None, **kwargs):
+        """Initialize all units in graph order with demand-driven retries.
+
+        Some units' demanded attrs are produced by other units' initialize
+        (e.g. forwards allocate ``output`` consumed by the next layer), so we
+        sweep until quiescent (the reference initializes in graph order with
+        the same effect).
+        """
+        super(Workflow, self).initialize(device=device, **kwargs)
+        self.device = device
+        pending = [u for u in self._units if not u.initialized]
+        order = self._graph_order()
+        pending.sort(key=lambda u: order.get(u, len(order)))
+        max_sweeps = len(pending) + 2
+        for _ in range(max_sweeps):
+            if not pending:
+                break
+            deferred = []
+            for u in pending:
+                missing = u._check_demands()
+                if missing:
+                    deferred.append((u, missing))
+                    continue
+                u.initialize(device=device, **kwargs)
+                u._initialized = True
+            if len(deferred) == len(pending):
+                lines = "; ".join("%s needs %s" % (u.name, m)
+                                  for u, m in deferred)
+                raise RuntimeError(
+                    "Workflow.initialize deadlock — unsatisfied demands: "
+                    + lines)
+            pending = [u for u, _ in deferred]
+        return self
+
+    def _graph_order(self):
+        """BFS order over control links from start_point."""
+        order, seen = {}, set()
+        q = deque([self.start_point])
+        seen.add(self.start_point)
+        i = 0
+        while q:
+            u = q.popleft()
+            order[u] = i
+            i += 1
+            for dst in u._links_to:
+                if dst not in seen:
+                    seen.add(dst)
+                    q.append(dst)
+        return order
+
+    # -- scheduler -----------------------------------------------------------
+    def _schedule(self, unit):
+        self._queue.append(unit)
+
+    def run(self):
+        """Run the dataflow until quiescence or end_point."""
+        self._running = True
+        self._stopped_by_end_point = False
+        self._queue.clear()
+        for u in self._units:
+            u._reset_fired()
+        self._schedule(self.start_point)
+        try:
+            while self._queue and self._running:
+                unit = self._queue.popleft()
+                unit._fire()
+        except NoMoreJobs:
+            pass
+        self._running = False
+        for cb in self._finished_callbacks:
+            cb()
+        return self
+
+    def _on_end_point(self):
+        self._stopped_by_end_point = True
+        self._running = False
+
+    def stop(self):
+        self._running = False
+
+    def stopped(self):
+        return not self._running
+
+    def on_workflow_finished(self, callback=None):
+        if callback is not None:
+            self._finished_callbacks.append(callback)
+
+    # -- misc reference-parity helpers ----------------------------------------
+    @property
+    def run_is_blocked(self):
+        return False
+
+
+class DummyLauncher(object):
+    """In-process launcher stand-in (reference: veles.dummy.DummyLauncher,
+    used by the functional-test harness standard_test.py:64-65)."""
+
+    def __init__(self, **kwargs):
+        self.testing = kwargs.get("testing", False)
+        self.device = None
+        self.workflow = None
+        self.interactive = False
+
+    def add_ref(self, workflow):
+        self.workflow = workflow
+
+    def del_ref(self, workflow):
+        pass
+
+    @property
+    def is_slave(self):
+        return False
+
+    @property
+    def is_master(self):
+        return False
+
+    @property
+    def is_standalone(self):
+        return True
+
+    def initialize(self, **kwargs):
+        if self.workflow is not None:
+            self.workflow.initialize(**kwargs)
+
+    def run(self):
+        if self.workflow is not None:
+            self.workflow.run()
+
+    def stop(self):
+        if self.workflow is not None:
+            self.workflow.stop()
+
+
+class DummyWorkflow(Workflow):
+    """A standalone workflow with a DummyLauncher parent
+    (reference: veles.dummy.DummyWorkflow)."""
+
+    def __init__(self, **kwargs):
+        super(DummyWorkflow, self).__init__(None, **kwargs)
+        self.launcher = DummyLauncher()
+        self.launcher.add_ref(self)
+
+
+class DummyUnit(Unit):
+    """Bag-of-attributes unit for tests (reference: veles.dummy.DummyUnit)."""
+
+    def __init__(self, workflow=None, **kwargs):
+        super(DummyUnit, self).__init__(workflow, **kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+# Seed the default PRNG streams on import so standalone scripts behave
+# deterministically (tests re-seed from seed files).
+random_generator.get(1)
+random_generator.get(2)
