@@ -6,8 +6,13 @@ single-process multi-device simulation).  Must run before jax import.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop the axon TPU-tunnel plugin from the import path: its PJRT discovery
+# can block on the tunnel even when JAX_PLATFORMS=cpu.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

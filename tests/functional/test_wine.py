@@ -1,0 +1,39 @@
+"""Functional test: the Wine MLP converges (reference contract:
+samples/Wine/wine.py:58 — within 100 epochs)."""
+
+import numpy
+
+from znicz_tpu.core import prng
+
+
+def test_wine_converges():
+    prng.get(1).seed(1024)
+    prng.get(2).seed(1025)
+    from znicz_tpu.samples.wine import WineWorkflow
+    from znicz_tpu.core.backends import JaxDevice
+
+    wf = WineWorkflow()
+    wf.decision.max_epochs = 40
+    wf.initialize(device=JaxDevice())
+    wf.run()
+    # training error reaches (near) zero well before 40 epochs
+    assert wf.loader.epoch_number <= 40
+    assert wf.decision.best_n_err_pt[2] is not None
+    assert wf.decision.best_n_err_pt[2] < 2.0, wf.decision.best_n_err_pt
+    # snapshot was written with the decision suffix
+    assert wf.snapshotter.destination is None or \
+        "train" in wf.snapshotter.destination
+
+
+def test_wine_numpy_backend():
+    prng.get(1).seed(77)
+    prng.get(2).seed(78)
+    from znicz_tpu.samples.wine import WineWorkflow
+    from znicz_tpu.core.backends import NumpyDevice
+
+    wf = WineWorkflow()
+    wf.decision.max_epochs = 15
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    assert wf.decision.best_n_err_pt[2] is not None
+    assert wf.decision.best_n_err_pt[2] < 10.0

@@ -1,0 +1,58 @@
+"""Backend cross-validation for FC units — jax vs numpy paths.
+
+The reference pattern (tests/unit/test_all2all.py:95-152): compute on the
+accelerated device and on NumpyDevice, assert max-abs diff < 1e-4.  The
+numpy path is the executable spec.
+"""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.backends import NumpyDevice, JaxDevice
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core import prng
+from znicz_tpu.units import all2all
+
+CASES = [
+    (all2all.All2All, "all2all"),
+    (all2all.All2AllTanh, "all2all_tanh"),
+    (all2all.All2AllRELU, "all2all_relu"),
+    (all2all.All2AllStrictRELU, "all2all_str"),
+    (all2all.All2AllSigmoid, "all2all_sigmoid"),
+    (all2all.All2AllSoftmax, "softmax"),
+]
+
+
+def _build(cls, device, x):
+    wf = DummyWorkflow()
+    unit = cls(wf, output_sample_shape=(7,))
+    unit.rand = prng.RandomGenerator().seed(42)
+    unit.input = type(unit.output)(x.copy())
+    unit.link_from(wf.start_point)
+    unit.initialize(device=device)
+    unit.run()
+    return unit
+
+
+@pytest.mark.parametrize("cls,name", CASES)
+def test_jax_matches_numpy(cls, name):
+    rng = numpy.random.RandomState(7)
+    x = rng.uniform(-1, 1, (5, 11)).astype(numpy.float32)
+    u_np = _build(cls, NumpyDevice(), x)
+    u_jx = _build(cls, JaxDevice(), x)
+    assert numpy.allclose(u_np.weights.mem, u_jx.weights.mem), name
+    diff = numpy.abs(u_np.output.mem - u_jx.output.mem).max()
+    assert diff < 1e-4, "%s: max diff %g" % (name, diff)
+    if cls is all2all.All2AllSoftmax:
+        assert (u_np.max_idx.mem == numpy.asarray(u_jx.max_idx.mem)).all()
+        s = u_jx.output.mem.sum(axis=1)
+        assert numpy.allclose(s, 1.0, atol=1e-5)
+
+
+def test_registry_has_pairs():
+    from znicz_tpu.units import gd  # noqa: F401  (registers backwards)
+    from znicz_tpu.units.nn_units import mapping
+    for _, name in CASES:
+        match = mapping[name]
+        assert match.has_forward
+        assert next(match.backwards) is not None

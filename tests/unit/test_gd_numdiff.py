@@ -1,0 +1,110 @@
+"""Numeric differentiation of analytic gradients (float64 only).
+
+The reference's strongest correctness harness (tests/unit/gd_numdiff.py:
+43-156): perturb every weight/bias/input element with a five-point stencil,
+compute d(loss)/d(theta) by finite differences, assert
+|analytic - numeric| < 1e-5.  Here the loss is softmax cross-entropy
+(mean over batch), matching EvaluatorSoftmax's err_output.
+"""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.backends import NumpyDevice, JaxDevice
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.units import all2all, gd
+from znicz_tpu.ops import dense
+
+H = 1e-5
+POINTS = (2 * H, H, -H, -2 * H)
+COEFFS = numpy.array([-1.0, 8.0, -8.0, 1.0]) / (12.0 * H)
+
+
+def ce_loss(x, params, labels):
+    """Forward the 2-layer net in float64 numpy and return mean CE."""
+    (w1, b1), (w2, b2) = params
+    h = dense.forward_numpy(x, w1, b1, activation="tanh")
+    y = dense.forward_numpy(h, w2, b2, activation="linear")
+    sm, _ = dense.softmax_numpy(y)
+    n = x.shape[0]
+    return -numpy.log(sm[numpy.arange(n), labels]).sum() / n
+
+
+def numdiff(f, arr):
+    """Five-point numeric gradient of scalar f w.r.t. every arr element."""
+    g = numpy.zeros_like(arr)
+    flat = arr.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        vals = []
+        for d in POINTS:
+            flat[i] = orig + d
+            vals.append(f())
+        flat[i] = orig
+        gf[i] = (numpy.array(vals) * COEFFS).sum()
+    return g
+
+
+def build_net(device):
+    rng = numpy.random.RandomState(11)
+    x = rng.uniform(-1, 1, (4, 5))
+    labels = rng.randint(0, 3, 4).astype(numpy.int32)
+
+    wf = DummyWorkflow()
+    f1 = all2all.All2AllTanh(wf, output_sample_shape=(6,),
+                             weights_stddev=0.3, bias_stddev=0.3)
+    f1.rand = prng.RandomGenerator().seed(5)
+    f1.input = Array(x.copy())
+    f2 = all2all.All2AllSoftmax(wf, output_sample_shape=(3,),
+                                weights_stddev=0.3, bias_stddev=0.3)
+    f2.rand = prng.RandomGenerator().seed(6)
+    f2.link_attrs(f1, ("input", "output"))
+    for f in (f1, f2):
+        f.link_from(wf.start_point)
+        f.initialize(device=device)
+    return wf, x, labels, f1, f2
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, JaxDevice])
+def test_gradients_match_numdiff(device_cls):
+    device = device_cls()
+    wf, x, labels, f1, f2 = build_net(device)
+    f1.run()
+    f2.run()
+
+    # evaluator math: err_output = (softmax - onehot)/batch
+    n = x.shape[0]
+    sm = f2.output.mem
+    err = sm.copy()
+    err[numpy.arange(n), labels] -= 1.0
+    err /= n
+
+    g2 = gd.GDSoftmax(wf, apply_gradient=False)
+    g2.err_output = Array(err.copy())
+    g2.link_attrs(f2, "output", "input", "weights", "bias")
+    g2.initialize(device=device)
+    g2.run()
+
+    g1 = gd.GDTanh(wf, apply_gradient=False, need_err_input=False)
+    g1.link_attrs(g2, ("err_output", "err_input"))
+    g1.link_attrs(f1, "output", "input", "weights", "bias")
+    g1.initialize(device=device)
+    g1.run()
+
+    params = [(f1.weights.map_write().mem, f1.bias.map_write().mem),
+              (f2.weights.map_write().mem, f2.bias.map_write().mem)]
+    loss = lambda: ce_loss(x, params, labels)  # noqa: E731
+
+    for unit, (w, b), tag in ((g2, params[1], "layer2"),
+                              (g1, params[0], "layer1")):
+        gw_num = numdiff(loss, w)
+        gb_num = numdiff(loss, b)
+        gw_ana = unit.gradient_weights.mem
+        gb_ana = unit.gradient_bias.mem
+        assert numpy.abs(gw_ana - gw_num).max() < 1e-5, tag
+        assert numpy.abs(gb_ana - gb_num).max() < 1e-5, tag
+
+    assert g2.err_input.mem.shape == f1.output.shape

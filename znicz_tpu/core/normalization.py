@@ -1,0 +1,133 @@
+"""Data normalizers.
+
+TPU-era equivalent of ``veles.normalization`` (SURVEY.md §2.9).  A normalizer
+is fit ("analyzed") on the training set and applied in place everywhere.
+Names follow the reference configs: "none", "pointwise", "linear",
+"mean_disp", "external_mean".
+"""
+
+import numpy
+
+_registry = {}
+
+
+def register(name):
+    def deco(cls):
+        _registry[name] = cls
+        cls.NAME = name
+        return cls
+    return deco
+
+
+def create(name, **kwargs):
+    try:
+        return _registry[name](**kwargs)
+    except KeyError:
+        raise KeyError("Unknown normalization %r; known: %s"
+                       % (name, sorted(_registry)))
+
+
+class NormalizerBase(object):
+    def __init__(self, **kwargs):
+        self.state = {}
+
+    def analyze(self, data):
+        pass
+
+    def normalize(self, data):
+        raise NotImplementedError
+
+    def denormalize(self, data):
+        raise NotImplementedError
+
+
+@register("none")
+class NoneNormalizer(NormalizerBase):
+    def normalize(self, data):
+        return data
+
+    def denormalize(self, data):
+        return data
+
+
+@register("pointwise")
+class PointwiseNormalizer(NormalizerBase):
+    """Per-feature linear map to [-1, 1] fit on the training set."""
+
+    def analyze(self, data):
+        mn = data.min(axis=0)
+        mx = data.max(axis=0)
+        span = mx - mn
+        span[span == 0] = 1.0
+        self.state = {"mul": 2.0 / span, "sub": mn, "span": span}
+
+    def normalize(self, data):
+        data -= self.state["sub"]
+        data *= self.state["mul"]
+        data -= 1.0
+        return data
+
+    def denormalize(self, data):
+        data += 1.0
+        data /= self.state["mul"]
+        data += self.state["sub"]
+        return data
+
+
+@register("linear")
+class LinearNormalizer(NormalizerBase):
+    """Whole-tensor linear map to [-1, 1]."""
+
+    def __init__(self, interval=(-1, 1), **kwargs):
+        super(LinearNormalizer, self).__init__(**kwargs)
+        self.interval = interval
+
+    def analyze(self, data):
+        self.state = {"min": float(data.min()), "max": float(data.max())}
+
+    def normalize(self, data):
+        lo, hi = self.interval
+        span = self.state["max"] - self.state["min"] or 1.0
+        data -= self.state["min"]
+        data *= (hi - lo) / span
+        data += lo
+        return data
+
+    def denormalize(self, data):
+        lo, hi = self.interval
+        span = self.state["max"] - self.state["min"] or 1.0
+        data -= lo
+        data *= span / (hi - lo)
+        data += self.state["min"]
+        return data
+
+
+@register("mean_disp")
+class MeanDispNormalizer(NormalizerBase):
+    """Subtract per-feature mean, divide by per-feature dispersion
+    (parity: veles.mean_disp_normalizer.MeanDispNormalizer; the imagenet
+    loader feeds precomputed mean/rdisp arrays via kwargs)."""
+
+    def __init__(self, mean=None, rdisp=None, **kwargs):
+        super(MeanDispNormalizer, self).__init__(**kwargs)
+        if mean is not None:
+            self.state = {"mean": numpy.asarray(mean),
+                          "rdisp": numpy.asarray(rdisp)}
+
+    def analyze(self, data):
+        if self.state:
+            return
+        mean = data.mean(axis=0)
+        disp = data.max(axis=0) - data.min(axis=0)
+        disp[disp == 0] = 1.0
+        self.state = {"mean": mean, "rdisp": 1.0 / disp}
+
+    def normalize(self, data):
+        data -= self.state["mean"].reshape(1, -1)
+        data *= self.state["rdisp"].reshape(1, -1)
+        return data
+
+    def denormalize(self, data):
+        data /= self.state["rdisp"].reshape(1, -1)
+        data += self.state["mean"].reshape(1, -1)
+        return data

@@ -1,0 +1,10 @@
+"""Dataset loaders.
+
+TPU-era equivalent of the veles-core loader contract + the reference's
+``loader/`` tree (SURVEY.md §2.5).  Constants parity: TEST=0, VALID=1,
+TRAIN=2 (reference: veles.loader import sites, loader_wine.py:41).
+"""
+
+from znicz_tpu.loader.base import (  # noqa: F401
+    TEST, VALID, TRAIN, CLASS_NAME, Loader, FullBatchLoader,
+    UserLoaderRegistry, ILoader, IFullBatchLoader)

@@ -1,0 +1,279 @@
+"""Loader base classes — the minibatch-serving contract.
+
+The veles core loader is external to the reference repo; this implements the
+contract observed at every use site (SURVEY.md §2.5): attributes
+``minibatch_data/labels/indices/class/size/offset``, ``class_lengths``,
+``total_samples``, ``last_minibatch``, ``epoch_ended``, ``epoch_number``,
+``complete``; methods ``load_data``, ``create_minibatch_data``,
+``fill_minibatch``.
+
+Epoch semantics:
+* One epoch serves every class segment with samples, in order
+  TEST -> TRAIN -> VALID.  **Deliberate deviation** from the reference
+  core's numeric order: serving VALID last is what the reference's own
+  DecisionGD assumes at epoch end (decision.py:478-482 — "minibatch_class
+  will be VALID if validation exists"), and measures validation *after*
+  that epoch's training, which is the ML-standard reading.
+* ``last_minibatch`` is true on each class segment's final minibatch;
+  ``epoch_ended`` additionally on the epoch's final segment.
+* ``epoch_number`` increments as the epoch wraps — after 3 full epochs
+  ``epoch_number == 3`` (reference test contract,
+  tests/functional/test_mnist_all2all.py:118).
+* The TRAIN segment is reshuffled every epoch from the loader's PRNG
+  stream (stream 2 — the functional-test harness seeds it separately).
+* The tail minibatch of a segment keeps the buffer size constant
+  (static shapes for XLA) and sets ``minibatch_size`` to the true count;
+  consumers zero the padded tail (evaluator contract).
+"""
+
+import numpy
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAME = {TEST: "test", VALID: "validation", TRAIN: "train"}
+
+#: serving order within one epoch (see module docstring)
+SERVE_ORDER = (TEST, TRAIN, VALID)
+
+
+class ILoader(object):
+    """Marker interface (parity: veles.loader.ILoader)."""
+
+
+class IFullBatchLoader(ILoader):
+    pass
+
+
+class UserLoaderRegistry(type):
+    """Registry of loader classes by MAPPING name
+    (reference: standard_workflow_base.py:113)."""
+
+    loaders = {}
+
+    def __init__(cls, name, bases, clsdict):
+        super(UserLoaderRegistry, cls).__init__(name, bases, clsdict)
+        mapping = clsdict.get("MAPPING", None)
+        if mapping:
+            UserLoaderRegistry.loaders[mapping] = cls
+
+    @staticmethod
+    def get_factory(name):
+        try:
+            kls = UserLoaderRegistry.loaders[name]
+        except KeyError:
+            raise KeyError(
+                "Unknown loader %r; known: %s" % (
+                    name, sorted(UserLoaderRegistry.loaders)))
+        return kls
+
+
+class Loader(Unit, metaclass=UserLoaderRegistry):
+    """Serves minibatches; subclasses provide the data."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Loader, self).__init__(workflow, **kwargs)
+        self.max_minibatch_size = kwargs.get("minibatch_size", 100)
+        self.prng = kwargs.get("prng", prng.get(2))
+        self.shuffle_limit = kwargs.get(
+            "shuffle_limit", numpy.iinfo(numpy.uint32).max)
+        self.normalization_type = kwargs.get("normalization_type", "none")
+        self.normalization_parameters = kwargs.get(
+            "normalization_parameters", {})
+        self.testing = kwargs.get("testing", False)
+
+        self.class_lengths = [0, 0, 0]
+        self.minibatch_data = Array(name="minibatch_data")
+        self.minibatch_labels = Array(name="minibatch_labels")
+        self.minibatch_indices = Array(name="minibatch_indices")
+        self.minibatch_size = 0
+        self.minibatch_offset = 0
+        self.minibatch_class = TRAIN
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.epoch_number = 0
+        self.complete = Bool(False)
+        self.train_ended = Bool(False)
+        self._indices = {}       # class -> index array into the dataset
+        self._segment = 0        # position in the serving order
+        self._offset_in_class = 0
+        self._global_offset = 0
+        self.normalizer = None
+        self._labels_mapping = {}
+
+    # -- to be provided by subclasses ---------------------------------------
+    def load_data(self):
+        """Fill class_lengths and prepare the dataset."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        """Allocate minibatch_data for max_minibatch_size samples."""
+        raise NotImplementedError
+
+    def fill_minibatch(self):
+        """Copy the samples at minibatch_indices into minibatch buffers."""
+        raise NotImplementedError
+
+    # -- common ------------------------------------------------------------
+    @property
+    def total_samples(self):
+        return int(sum(self.class_lengths))
+
+    @property
+    def effective_class_lengths(self):
+        return self.class_lengths
+
+    @property
+    def labels_mapping(self):
+        return self._labels_mapping
+
+    def _serve_order(self):
+        return [c for c in SERVE_ORDER if self.class_lengths[c] > 0]
+
+    def class_index_range(self, clazz):
+        """[start, end) of this class inside the dataset's sample axis,
+        assuming dataset layout [TEST | VALID | TRAIN] (numeric order)."""
+        start = sum(self.class_lengths[:clazz])
+        return start, start + self.class_lengths[clazz]
+
+    def initialize(self, device=None, **kwargs):
+        super(Loader, self).initialize(device=device, **kwargs)
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError("%s loaded zero samples" % self.name)
+        if self.max_minibatch_size < 1:
+            raise ValueError("minibatch_size must be >= 1")
+        self.max_minibatch_size = min(self.max_minibatch_size,
+                                      max(self.class_lengths))
+        for clazz in range(3):
+            start, end = self.class_index_range(clazz)
+            self._indices[clazz] = numpy.arange(start, end,
+                                                dtype=numpy.int32)
+        self._shuffle()
+        self.create_minibatch_data()
+        if not self.minibatch_data:
+            raise ValueError("create_minibatch_data did not allocate "
+                             "minibatch_data")
+        if not self.minibatch_labels:
+            self.minibatch_labels.reset(numpy.zeros(
+                self.max_minibatch_size, dtype=numpy.int32))
+        self.minibatch_indices.reset(numpy.zeros(
+            self.max_minibatch_size, dtype=numpy.int32))
+        self._segment = 0
+        self._offset_in_class = 0
+        self._global_offset = 0
+        self.info(
+            "%s: %d samples (test %d, validation %d, train %d), mb=%d",
+            self.name, self.total_samples, self.class_lengths[TEST],
+            self.class_lengths[VALID], self.class_lengths[TRAIN],
+            self.max_minibatch_size)
+
+    def _shuffle(self):
+        if self.epoch_number < self.shuffle_limit:
+            self.prng.shuffle(self._indices[TRAIN])
+
+    def run(self):
+        order = self._serve_order()
+        clazz = order[self._segment]
+        length = self.class_lengths[clazz]
+        off = self._offset_in_class
+        n = min(self.max_minibatch_size, length - off)
+        sel = self._indices[clazz][off:off + n]
+
+        self.minibatch_class = clazz
+        self.minibatch_size = int(n)
+        self._global_offset += n
+        self.minibatch_offset = self._global_offset
+
+        idx = self.minibatch_indices.mem
+        idx[:n] = sel
+        idx[n:] = -1
+        self.fill_minibatch()
+        if n < self.max_minibatch_size:
+            self.minibatch_labels.map_write()
+            self.minibatch_labels.mem[n:] = -1
+
+        seg_done = off + n >= length
+        epoch_done = seg_done and self._segment == len(order) - 1
+        self.last_minibatch <<= seg_done
+        self.epoch_ended <<= epoch_done
+        self.train_ended <<= seg_done and clazz == TRAIN
+
+        if epoch_done:
+            self.epoch_number += 1
+            self._segment = 0
+            self._offset_in_class = 0
+            self._global_offset = 0
+            self._shuffle()
+        elif seg_done:
+            self._segment += 1
+            self._offset_in_class = 0
+        else:
+            self._offset_in_class = off + n
+
+    # -- master-slave stubs (kept for protocol parity) ----------------------
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass
+
+
+class FullBatchLoader(Loader):
+    """Loader keeping the whole dataset in memory
+    (contract: original_data/original_labels + normalization)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(FullBatchLoader, self).__init__(workflow, **kwargs)
+        self.original_data = Array(name="original_data")
+        self._original_labels = []
+        self.force_numpy = kwargs.get("force_numpy", False)
+
+    @property
+    def original_labels(self):
+        return self._original_labels
+
+    def create_minibatch_data(self):
+        sample_shape = self.original_data.shape[1:]
+        dtype = root.common.engine.precision_dtype \
+            if "precision_dtype" in root.common.engine.__dict__ else \
+            self.original_data.dtype
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + tuple(sample_shape), dtype=dtype))
+
+    def initialize(self, device=None, **kwargs):
+        super(FullBatchLoader, self).initialize(device=device, **kwargs)
+        self._apply_normalization()
+
+    def _apply_normalization(self):
+        from znicz_tpu.core import normalization
+        if self.normalization_type in (None, "none"):
+            self.normalizer = normalization.NoneNormalizer()
+            return
+        self.normalizer = normalization.create(
+            self.normalization_type, **self.normalization_parameters)
+        data = self.original_data.mem
+        flat = data.reshape(data.shape[0], -1)
+        # Fit on TRAIN only (reference semantics: normalizer analyzed on
+        # the training set, applied everywhere).
+        start, end = self.class_index_range(TRAIN)
+        fit_on = flat[start:end] if end > start else flat
+        self.normalizer.analyze(fit_on)
+        self.original_data.map_write()
+        self.normalizer.normalize(flat)
+
+    def fill_minibatch(self):
+        idx = self.minibatch_indices.mem
+        n = self.minibatch_size
+        self.minibatch_data.map_invalidate()
+        self.minibatch_labels.map_write()
+        data = self.original_data.mem
+        for i in range(n):
+            self.minibatch_data.mem[i] = data[idx[i]]
+        if self._original_labels:
+            for i in range(n):
+                self.minibatch_labels.mem[i] = self._original_labels[idx[i]]

@@ -1,0 +1,49 @@
+"""Wine dataset loader (reference loader/loader_wine.py:44-66).
+
+Contract parity: reads ``dataset_file`` CSV rows of ``label,feat...``
+(labels 1-based in the file, stored 0-based), pointwise normalization,
+all samples to TRAIN when training / to TEST when testing.  If the file is
+absent, materializes it from sklearn's bundled copy of the same UCI Wine
+data (the reference downloads it over HTTP, which a zero-egress box can't).
+"""
+
+import os
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.base import (
+    FullBatchLoader, IFullBatchLoader, TEST, VALID, TRAIN)
+
+
+class WineLoader(FullBatchLoader, IFullBatchLoader):
+    MAPPING = "wine_loader"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs["normalization_type"] = "pointwise"
+        super(WineLoader, self).__init__(workflow, **kwargs)
+        self.dataset_file = kwargs.get("dataset_file", os.path.join(
+            root.common.dirs.datasets, "wine", "wine.txt"))
+
+    def _materialize_dataset(self):
+        from sklearn.datasets import load_wine
+        wine = load_wine()
+        os.makedirs(os.path.dirname(self.dataset_file), exist_ok=True)
+        rows = numpy.hstack([(wine.target + 1)[:, None].astype(numpy.float32),
+                             wine.data.astype(numpy.float32)])
+        numpy.savetxt(self.dataset_file, rows, delimiter=",", fmt="%.6g")
+
+    def load_data(self):
+        if not os.path.exists(self.dataset_file):
+            self._materialize_dataset()
+        arr = numpy.loadtxt(self.dataset_file, delimiter=",",
+                            dtype=numpy.float32)
+        self.original_data.mem = arr[:, 1:].copy()
+        self.original_labels[:] = (
+            arr[:, 0].ravel().astype(numpy.int32) - 1)
+        if not self.testing:
+            self.class_lengths[TEST] = self.class_lengths[VALID] = 0
+            self.class_lengths[TRAIN] = self.original_data.shape[0]
+        else:
+            self.class_lengths[TEST] = self.original_data.shape[0]
+            self.class_lengths[VALID] = self.class_lengths[TRAIN] = 0

@@ -1,0 +1,15 @@
+"""Pure compute functions — the TPU-era equivalent of the reference's
+``ocl/`` + ``cuda/`` kernel trees (SURVEY.md §2.6).
+
+Every op has two twins:
+
+* a **jax** function (jitted; XLA fuses bias+activation into the GEMM the way
+  the reference's hand-written ``apply_bias_with_activation`` kernels did) —
+  the TPU path;
+* a **numpy** function — the executable spec, used by ``numpy_run`` and by
+  cross-validation tests (replacing the reference's numpy-vs-OpenCL/CUDA
+  pattern, tests/unit/test_all2all.py:95-152).
+
+No im2col staging, no hand-scheduled reductions: ``lax.conv_general_dilated``
+and XLA fusion own that on TPU (SURVEY.md §7 design stance).
+"""

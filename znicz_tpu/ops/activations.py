@@ -1,0 +1,83 @@
+"""Activation functions and their output-space derivatives.
+
+Parity targets (constants from the reference kernel tree):
+* scaled tanh  f(x) = 1.7159 tanh(0.6666 x)          (all2all.py:271-279)
+  f'(y) = 1.14381894 - 0.388484177 y^2               (cuda/gradient_descent_tanh.cu)
+* relu (softplus) f(x) = log(1+e^x), clamped at x>15 (all2all.py:298-317)
+  f'(y) = 1 - e^{-y}                                 (cuda/gradient_descent_relu.cu)
+* strict relu f(x) = max(x, 0), f'(y) = [y > 0]      (cuda/gradient_descent_strict_relu.cu)
+* sigmoid f(x) = 1/(1+e^{-x}), f'(y) = y(1-y)        (cuda/gradient_descent_sigmoid.cu)
+
+All derivatives are functions of the OUTPUT y, matching the reference's
+``err_y_update`` kernels so backward units need only the forward's output.
+"""
+
+import numpy
+import jax.numpy as jnp
+
+TANH_A = 1.7159
+TANH_B = 0.6666
+TANH_DA = 1.14381894     # A * B
+TANH_DB = -0.388484177   # -(B / A)
+
+
+# -- jax twins --------------------------------------------------------------
+
+def apply_jax(name, x):
+    if name == "linear":
+        return x
+    if name == "tanh":
+        return TANH_A * jnp.tanh(TANH_B * x)
+    if name == "relu":
+        return jnp.where(x > 15, x, jnp.log1p(jnp.exp(jnp.minimum(x, 15.0))))
+    if name == "strict_relu":
+        return jnp.maximum(x, 0)
+    if name == "sigmoid":
+        return 1.0 / (1.0 + jnp.exp(-x))
+    raise ValueError("unknown activation %r" % name)
+
+
+def derivative_jax(name, y):
+    """f'(x) expressed through the output y = f(x)."""
+    if name == "linear":
+        return jnp.ones_like(y)
+    if name == "tanh":
+        return y * y * TANH_DB + TANH_DA
+    if name == "relu":
+        return 1.0 - jnp.exp(-y)
+    if name == "strict_relu":
+        return (y > 0).astype(y.dtype)
+    if name == "sigmoid":
+        return y * (1.0 - y)
+    raise ValueError("unknown activation %r" % name)
+
+
+# -- numpy twins (the executable spec) --------------------------------------
+
+def apply_numpy(name, x):
+    if name == "linear":
+        return x
+    if name == "tanh":
+        return TANH_A * numpy.tanh(TANH_B * x)
+    if name == "relu":
+        return numpy.where(x > 15, x,
+                           numpy.log1p(numpy.exp(numpy.minimum(x, 15.0))))
+    if name == "strict_relu":
+        return numpy.maximum(x, 0)
+    if name == "sigmoid":
+        return 1.0 / (1.0 + numpy.exp(-x))
+    raise ValueError("unknown activation %r" % name)
+
+
+def derivative_numpy(name, y):
+    if name == "linear":
+        return numpy.ones_like(y)
+    if name == "tanh":
+        return y * y * TANH_DB + TANH_DA
+    if name == "relu":
+        return 1.0 - numpy.exp(-y)
+    if name == "strict_relu":
+        return (y > 0).astype(y.dtype)
+    if name == "sigmoid":
+        return y * (1.0 - y)
+    raise ValueError("unknown activation %r" % name)

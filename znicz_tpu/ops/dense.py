@@ -1,0 +1,101 @@
+"""Fully-connected forward/backward ops.
+
+TPU-era equivalent of the reference's GEMM + ``apply_bias_with_activation``
+kernel pair (all2all.py:195-254, ocl/all2all/forward.cl) and the backward
+GEMM trio (gd.py:421-482).  One jitted function each; XLA fuses bias and
+activation into the matmul epilogue — the hand-written fusion the reference
+did with #define'd kernels.
+
+Convention (matches the reference): ``weights`` has shape
+(neurons, input_sample_size) unless ``weights_transposed``; forward computes
+``y = x @ W^T + b``.
+"""
+
+from functools import partial
+
+import numpy
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.ops import activations
+
+
+# -- forward ----------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("activation", "weights_transposed",
+                                   "include_bias"))
+def forward_jax(x, weights, bias, activation="linear",
+                weights_transposed=False, include_bias=True):
+    x2 = x.reshape(x.shape[0], -1)
+    y = x2 @ weights if weights_transposed else x2 @ weights.T
+    if include_bias:
+        y = y + bias
+    return activations.apply_jax(activation, y)
+
+
+@jax.jit
+def softmax_jax(y):
+    """Exp-normalize with winner index (reference fused ``apply_exp`` kernel,
+    all2all.py:418-443): returns (softmax(y), argmax(y))."""
+    max_idx = jnp.argmax(y, axis=1).astype(jnp.int32)
+    m = jnp.max(y, axis=1, keepdims=True)
+    e = jnp.exp(y - m)
+    return e / jnp.sum(e, axis=1, keepdims=True), max_idx
+
+
+def forward_numpy(x, weights, bias, activation="linear",
+                  weights_transposed=False, include_bias=True):
+    x2 = x.reshape(x.shape[0], -1)
+    y = x2 @ weights if weights_transposed else x2 @ weights.T
+    if include_bias:
+        y = y + bias
+    return activations.apply_numpy(activation, y)
+
+
+def softmax_numpy(y):
+    max_idx = numpy.argmax(y, axis=1).astype(numpy.int32)
+    m = numpy.max(y, axis=1, keepdims=True)
+    e = numpy.exp(y - m)
+    return e / numpy.sum(e, axis=1, keepdims=True), max_idx
+
+
+# -- backward ---------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("weights_transposed", "need_err_input",
+                                   "include_bias"))
+def backward_jax(inp, err_output, weights, weights_transposed=False,
+                 need_err_input=True, include_bias=True):
+    """Returns (err_input, gradient_weights, gradient_bias).
+
+    Math parity: grad_w = err_output^T @ input (gd.py:436-439),
+    grad_b = err_output.sum(0) (gd.py:449),
+    err_input = err_output @ weights (gd.py:467-470).
+    """
+    x2 = inp.reshape(inp.shape[0], -1)
+    e2 = err_output.reshape(err_output.shape[0], -1)
+    if weights_transposed:
+        grad_w = x2.T @ e2
+        err_in = e2 @ weights.T if need_err_input else None
+    else:
+        grad_w = e2.T @ x2
+        err_in = e2 @ weights if need_err_input else None
+    grad_b = e2.sum(axis=0) if include_bias else None
+    if err_in is not None:
+        err_in = err_in.reshape(inp.shape)
+    return err_in, grad_w, grad_b
+
+
+def backward_numpy(inp, err_output, weights, weights_transposed=False,
+                   need_err_input=True, include_bias=True):
+    x2 = inp.reshape(inp.shape[0], -1)
+    e2 = err_output.reshape(err_output.shape[0], -1)
+    if weights_transposed:
+        grad_w = x2.T @ e2
+        err_in = e2 @ weights.T if need_err_input else None
+    else:
+        grad_w = e2.T @ x2
+        err_in = e2 @ weights if need_err_input else None
+    grad_b = e2.sum(axis=0) if include_bias else None
+    if err_in is not None:
+        err_in = err_in.reshape(inp.shape)
+    return err_in, grad_w, grad_b

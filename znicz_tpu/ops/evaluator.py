@@ -1,0 +1,118 @@
+"""Evaluator ops — the boundary between forward and backward.
+
+TPU-era equivalent of the reference's fused evaluator kernels
+(evaluator.jcl/.jcu): ONE jitted computation produces the softmax-CE
+gradient, the error count, the confusion matrix and the max gradient sum
+(reference numpy spec: evaluator.py:271-312).  MSE twin below
+(evaluator.py:334-556).
+
+Semantics parity:
+* ``err_output = (softmax_output - onehot(label)) * (1/batch if mean else 1)``
+* samples with ``label < 0`` contribute zero error and no stats;
+* samples beyond ``batch_size`` (padded tail minibatch) zeroed;
+* ``n_err = [misclassified, evaluated]`` accumulated across minibatches;
+* confusion_matrix[max_idx, label] += 1.
+"""
+
+from functools import partial
+
+import numpy
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_classes", "mean"))
+def softmax_ce_jax(output, max_idx, labels, batch_size, n_classes, mean=True):
+    """Returns (err_output, n_err_delta[2], confusion_delta, max_err_sum).
+
+    ``output`` is the softmax output (B, C); ``labels`` int (B,);
+    ``batch_size`` may be < B for the padded tail minibatch.
+    """
+    B, C = output.shape
+    idx = jnp.arange(B)
+    in_batch = idx < batch_size
+    valid = in_batch & (labels >= 0)
+
+    onehot = jax.nn.one_hot(jnp.maximum(labels, 0), C, dtype=output.dtype)
+    mult = jnp.where(mean, 1.0 / jnp.maximum(batch_size, 1), 1.0)
+    err = (output - onehot) * mult.astype(output.dtype)
+    err = jnp.where(valid[:, None], err, 0)
+
+    hits = valid & (max_idx == labels)
+    n_total = valid.sum()
+    n_ok = hits.sum()
+    n_err_delta = jnp.stack([n_total - n_ok, n_total]).astype(jnp.int32)
+
+    conf = jnp.zeros((n_classes, n_classes), dtype=jnp.int32)
+    conf = conf.at[max_idx, jnp.maximum(labels, 0)].add(
+        valid.astype(jnp.int32))
+
+    max_err_sum = jnp.where(valid, jnp.abs(err).sum(axis=1), 0).max()
+    return err, n_err_delta, conf, max_err_sum
+
+
+def softmax_ce_numpy(output, max_idx, labels, batch_size, n_classes,
+                     mean=True):
+    B, C = output.shape
+    err = numpy.zeros_like(output)
+    conf = numpy.zeros((n_classes, n_classes), dtype=numpy.int32)
+    mult = 1.0 / batch_size if mean else 1.0
+    n_ok = 0
+    n_total = 0
+    max_err_sum = 0.0
+    for i in range(int(batch_size)):
+        if labels[i] < 0:
+            continue
+        err[i] = output[i]
+        err[i, labels[i]] -= 1.0
+        err[i] *= mult
+        conf[max_idx[i], labels[i]] += 1
+        if max_idx[i] == labels[i]:
+            n_ok += 1
+        n_total += 1
+        max_err_sum = max(max_err_sum, numpy.abs(err[i]).sum())
+    n_err_delta = numpy.array([n_total - n_ok, n_total], dtype=numpy.int32)
+    return err, n_err_delta, conf, max_err_sum
+
+
+@partial(jax.jit, static_argnames=("mean", "root"))
+def mse_jax(output, target, batch_size, mean=True, root=False):
+    """Returns (err_output, metrics_delta[3], per-sample mse).
+
+    metrics = [sum_mse, max_mse, min_mse] (reference evaluator.py:334-556).
+    """
+    B = output.shape[0]
+    o2 = output.reshape(B, -1)
+    t2 = target.reshape(B, -1)
+    idx = jnp.arange(B)
+    in_batch = idx < batch_size
+    mult = jnp.where(mean, 1.0 / jnp.maximum(batch_size, 1), 1.0)
+    err = (o2 - t2) * mult.astype(output.dtype)
+    err = jnp.where(in_batch[:, None], err, 0)
+    diff = jnp.where(in_batch[:, None], o2 - t2, 0)
+    mse_per = (diff * diff).sum(axis=1) / o2.shape[1]
+    mse_per = jnp.where(root, jnp.sqrt(mse_per), mse_per)
+    s = mse_per.sum()
+    mx = mse_per.max()
+    mn = jnp.where(in_batch, mse_per, jnp.inf).min()
+    return err.reshape(output.shape), jnp.stack([s, mx, mn]), mse_per
+
+
+def mse_numpy(output, target, batch_size, mean=True, root=False):
+    B = output.shape[0]
+    o2 = output.reshape(B, -1)
+    t2 = target.reshape(B, -1)
+    err = numpy.zeros_like(o2)
+    mult = 1.0 / batch_size if mean else 1.0
+    bs = int(batch_size)
+    err[:bs] = (o2[:bs] - t2[:bs]) * mult
+    diff = numpy.zeros_like(o2)
+    diff[:bs] = o2[:bs] - t2[:bs]
+    mse_per = (diff * diff).sum(axis=1) / o2.shape[1]
+    if root:
+        mse_per = numpy.sqrt(mse_per)
+    s = mse_per[:bs].sum()
+    mx = mse_per[:bs].max() if bs else 0.0
+    mn = mse_per[:bs].min() if bs else 0.0
+    return (err.reshape(output.shape),
+            numpy.array([s, mx, mn]), mse_per)

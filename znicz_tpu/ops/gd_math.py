@@ -1,0 +1,137 @@
+"""The gradient-descent update algebra — exact parity with the reference.
+
+This is the per-layer optimizer the whole framework shares
+(nn_units.py:696-719, gd.py:314-419, cuda/gradient_descent_common.cu
+``gradient_step_l12``):
+
+1. ``step = grad + wd * ((1 - l1_vs_l2) * w + 0.5 * l1_vs_l2 * sign(w))
+            [+ ortho]``;  ``gradient = -lr * step``
+2. accumulate (nn_units.py:419-428):
+   ``acc = acc_alpha * gradient + acc_beta * acc``
+   ``gradient = gd_beta * gradient + gd_alpha * acc``
+3. moment (gd.py:314-326, variant_moment_gradient=True):
+   ``vel = gradient + moment * vel``; applied gradient is ``vel``
+4. ``w += gradient`` when apply_gradient.
+
+The ortho regularizer (nn_units.py:713-717): each gradient row i gains
+``(col_sums - w[i]) * factor_ortho / n_rows`` where col_sums = w.sum(axis=0).
+
+Solvers adagrad/adadelta/fast (gd.py:395-419) transform the velocity before
+application; they compose with the above exactly as the reference's
+``numpy_update`` does.
+
+State per parameter tensor is a dict pytree: ``acc`` (accumulated gradient),
+``vel`` (gradient with moment), plus solver slots.  The same function runs
+under jit (jax arrays) and eagerly (numpy) — pure jnp/numpy-agnostic algebra
+via the ``xp`` module argument.
+"""
+
+from functools import partial
+
+import numpy
+import jax
+import jax.numpy as jnp
+
+
+def _gradient_step(xp, w, grad, lr, wd, l1_vs_l2, factor_ortho, use_ortho):
+    step = grad + wd * ((1.0 - l1_vs_l2) * w +
+                        0.5 * l1_vs_l2 * xp.sign(w))
+    if use_ortho:
+        col_sums = w.sum(axis=0)
+        step = step + (col_sums[None, :] - w) * (factor_ortho / w.shape[0])
+    return lr * step
+
+
+def update(xp, w, grad, state, hyper, flags):
+    """One parameter update.  Returns (new_w, new_state, applied_gradient).
+
+    hyper: dict(lr, wd, l1_vs_l2, moment, acc_alpha, acc_beta, gd_alpha,
+                gd_beta, factor_ortho)
+    flags: dict(accumulate, apply, solvers=frozenset, variant_moment=True)
+    state: dict(acc, vel, [adagrad], [adadelta_v, adadelta_gv], [fast])
+    """
+    gradient = -_gradient_step(
+        xp, w, grad, hyper["lr"], hyper["wd"], hyper["l1_vs_l2"],
+        hyper.get("factor_ortho", 0.0), flags.get("ortho", False))
+    new_state = dict(state)
+
+    if flags.get("accumulate") and state.get("acc") is not None:
+        acc = hyper["acc_alpha"] * gradient + hyper["acc_beta"] * state["acc"]
+        gradient = hyper["gd_beta"] * gradient + hyper["gd_alpha"] * acc
+        new_state["acc"] = acc
+
+    if state.get("vel") is not None:
+        if flags.get("variant_moment", True):
+            vel = gradient + hyper["moment"] * state["vel"]
+        else:
+            vel = ((1.0 - hyper["moment"]) * gradient +
+                   hyper["moment"] * state["vel"])
+        new_state["vel"] = vel
+        gradient = vel
+    solvers = flags.get("solvers") or frozenset()
+    if "adagrad" in solvers:
+        ada = state["adagrad"] + new_state["vel"] ** 2
+        gradient = gradient * xp.sqrt(ada + hyper.get("adagrad_eps", 1e-8))
+        new_state["adagrad"] = ada
+    if "adadelta" in solvers:
+        eps = hyper.get("adadelta_eps", 1e-8)
+        adom = hyper.get("adadelta_adom", 0.3)
+        gv = (adom * state["adadelta_gv"] +
+              (1.0 - adom) * new_state["vel"] ** 2)
+        s1 = xp.sqrt(state["adadelta_v"] + eps)
+        s2 = xp.sqrt(gv + eps)
+        gradient = gradient * (s1 / s2)
+        v = adom * state["adadelta_v"] + (1.0 - adom) * gradient ** 2
+        new_state["adadelta_gv"] = gv
+        new_state["adadelta_v"] = v
+    if "fast" in solvers:
+        fast = (state["fast"] * 0.95 +
+                hyper.get("fast_lr", 0.02) * new_state["vel"])
+        new_state["fast"] = fast
+
+    new_w = w
+    if flags.get("apply", True):
+        new_w = w + gradient
+        if "fast" in solvers:
+            new_w = new_w - new_state["fast"]
+    return new_w, new_state, gradient
+
+
+# jit-compiled entry for the jax path; hyper values become traced scalars so
+# learning-rate schedules don't retrigger compilation.
+@partial(jax.jit, static_argnames=("flags_key",))
+def _update_jax(w, grad, state, hyper, flags_key):
+    flags = dict(flags_key)
+    flags["solvers"] = frozenset(flags.get("solvers") or ())
+    return update(jnp, w, grad, state, hyper, flags)[:2] + (None,)
+
+
+def update_jax(w, grad, state, hyper, flags):
+    flags_key = tuple(sorted(
+        (k, tuple(sorted(v)) if isinstance(v, (set, frozenset)) else v)
+        for k, v in flags.items()))
+    new_w, new_state, _ = _update_jax(w, grad, state, hyper, flags_key)
+    return new_w, new_state
+
+
+def update_numpy(w, grad, state, hyper, flags):
+    return update(numpy, w, grad, state, hyper, flags)[:2]
+
+
+def init_state(w, flags, like=numpy):
+    """Allocate the optimizer-state pytree for one parameter tensor."""
+    z = (lambda: like.zeros_like(w))
+    state = {}
+    if flags.get("accumulate"):
+        state["acc"] = z()
+    if flags.get("need_vel", True):
+        state["vel"] = z()
+    solvers = flags.get("solvers") or frozenset()
+    if "adagrad" in solvers:
+        state["adagrad"] = z()
+    if "adadelta" in solvers:
+        state["adadelta_v"] = z()
+        state["adadelta_gv"] = z()
+    if "fast" in solvers:
+        state["fast"] = z()
+    return state

@@ -1,0 +1,1 @@
+"""Packaged sample models (reference ``samples/`` — SURVEY.md §2.6 L6)."""

@@ -1,0 +1,7 @@
+"""The Znicz NN engine — layer units, evaluators, decisions, schedulers.
+
+TPU-era equivalent of the reference repo's top-level unit modules
+(SURVEY.md §2.2-§2.5).  Importing a module registers its units in the
+type-string registry (``nn_units.mapping``); keep imports even if they look
+unused — exactly like the reference (standard_workflow_base.py:44-51).
+"""

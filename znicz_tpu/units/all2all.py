@@ -1,0 +1,144 @@
+"""Fully-connected forward units.
+
+TPU-era equivalent of reference all2all.py (474 LoC — SURVEY.md §2.2).
+Type strings: all2all, all2all_tanh, all2all_relu, all2all_str,
+all2all_sigmoid, softmax.
+
+Compute goes through :mod:`znicz_tpu.ops.dense`: one jitted
+matmul+bias+activation (XLA fuses the epilogue the way the reference's
+``apply_bias_with_activation`` kernel did).  Weight init magnitude heuristic
+and fillings match reference all2all.py:106-127.
+"""
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.units.nn_units import NNLayerBase, FullyConnectedOutput
+from znicz_tpu.ops import dense
+
+
+class All2All(FullyConnectedOutput, NNLayerBase):
+    """y = x @ W^T + b with linear activation (reference all2all.py:53-268)."""
+
+    MAPPING = {"all2all"}
+    ACTIVATION = "linear"
+    C = 10  # weights-magnitude constant (reference all2all.py:92)
+
+    def __init__(self, workflow, **kwargs):
+        super(All2All, self).__init__(workflow, **kwargs)
+        self.demand("input", "output_sample_shape")
+
+    def get_weights_magnitude(self):
+        """Initial weight range such that activations start near maximum
+        (reference all2all.py:106-117)."""
+        vle = numpy.sqrt(self.C / (self.input.sample_size +
+                                   numpy.prod(self.output_sample_shape)))
+        if self.weights_filling == "gaussian":
+            vle /= 3
+        return vle
+
+    def initialize(self, device=None, **kwargs):
+        super(All2All, self).initialize(device=device, **kwargs)
+        if self.weights_stddev is None:
+            self.weights_stddev = min(self.get_weights_magnitude(), 0.5)
+        if self.bias_stddev is None:
+            self.bias_stddev = self.weights_stddev
+
+        weights_shape = (self.neurons_number, self.input.sample_size)
+        if not self.weights:
+            w = numpy.zeros(weights_shape, dtype=self.input.dtype)
+            self.fill_array(self.weights_filling, w, self.weights_stddev)
+            if self.weights_transposed:
+                w = w.T.copy()
+            self.weights.reset(w)
+        if self.include_bias and not self.bias:
+            b = numpy.zeros(self.neurons_number, dtype=self.input.dtype)
+            self.fill_array(self.bias_filling, b, self.bias_stddev)
+            self.bias.reset(b)
+        if not self.output or self.output.shape[0] != self.input.shape[0]:
+            self.output.reset(numpy.zeros(
+                (self.input.shape[0],) + self.output_sample_shape,
+                dtype=self.input.dtype))
+
+    def numpy_run(self):
+        self.output.map_invalidate()
+        y = dense.forward_numpy(
+            self.input.mem, self.weights.mem,
+            self.bias.mem if self.include_bias else None,
+            activation=self.ACTIVATION,
+            weights_transposed=self.weights_transposed,
+            include_bias=self.include_bias)
+        self.output.mem[...] = y.reshape(self.output.shape)
+
+    def jax_run(self):
+        y = dense.forward_jax(
+            self.input.dev, self.weights.dev,
+            self.bias.dev if self.include_bias else None,
+            activation=self.ACTIVATION,
+            weights_transposed=self.weights_transposed,
+            include_bias=self.include_bias)
+        self.output.set_dev(y.reshape(self.output.shape))
+
+
+class All2AllTanh(All2All):
+    """f(x) = 1.7159 tanh(0.6666 x) (reference all2all.py:271-295)."""
+    MAPPING = {"all2all_tanh"}
+    ACTIVATION = "tanh"
+    A = 1.7159
+    B = 0.6666
+    C = 9.0
+
+
+class All2AllRELU(All2All):
+    """Softplus f(x) = log(1 + e^x) (reference all2all.py:298-317)."""
+    MAPPING = {"all2all_relu"}
+    ACTIVATION = "relu"
+
+
+class All2AllStrictRELU(All2All):
+    """f(x) = max(x, 0) (reference all2all.py:320-340)."""
+    MAPPING = {"all2all_str"}
+    ACTIVATION = "strict_relu"
+
+
+class All2AllSigmoid(All2All):
+    """f(x) = 1/(1+e^-x) (reference all2all.py:343-367)."""
+    MAPPING = {"all2all_sigmoid"}
+    ACTIVATION = "sigmoid"
+    C = 1
+
+
+class All2AllSoftmax(All2All):
+    """Linear + exp-normalize, records winner indices
+    (reference all2all.py:370-474)."""
+
+    MAPPING = {"softmax"}
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, **kwargs):
+        super(All2AllSoftmax, self).__init__(workflow, **kwargs)
+        self.max_idx = Array(name="max_idx")
+
+    def initialize(self, device=None, **kwargs):
+        super(All2AllSoftmax, self).initialize(device=device, **kwargs)
+        if self.neurons_number <= 1:
+            raise ValueError(
+                "Output sample size should be greater than 1 for SoftMax")
+        if not self.max_idx or self.max_idx.shape[0] != self.output.shape[0]:
+            self.max_idx.reset(numpy.zeros(self.output.shape[0],
+                                           dtype=numpy.int32))
+
+    def numpy_run(self):
+        super(All2AllSoftmax, self).numpy_run()
+        self.max_idx.map_invalidate()
+        out2 = self.output.matrix
+        sm, idx = dense.softmax_numpy(out2)
+        self.output.mem[...] = sm.reshape(self.output.shape)
+        self.max_idx.mem[...] = idx
+
+    def jax_run(self):
+        super(All2AllSoftmax, self).jax_run()
+        y = self.output.dev
+        sm, idx = dense.softmax_jax(y.reshape(y.shape[0], -1))
+        self.output.set_dev(sm.reshape(y.shape))
+        self.max_idx.set_dev(idx)

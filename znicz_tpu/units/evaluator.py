@@ -1,0 +1,235 @@
+"""Evaluator units — produce err_output + metrics from the last forward.
+
+TPU-era equivalent of reference evaluator.py (556 LoC — SURVEY.md §2.4).
+The evaluator is the forward/backward boundary: EvaluatorSoftmax fuses the
+softmax-CE gradient, error count, confusion matrix and max-gradient-sum into
+one jitted op (:mod:`znicz_tpu.ops.evaluator`) exactly like the reference's
+single fused kernel (evaluator.jcl).
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.ops import evaluator as ev_ops
+
+
+class EvaluatorsRegistry(type):
+    """LOSS-string registry (reference evaluator.py:58-68)."""
+
+    evaluators = {}
+
+    def __init__(cls, name, bases, clsdict):
+        super(EvaluatorsRegistry, cls).__init__(name, bases, clsdict)
+        loss = clsdict.get("LOSS", None)
+        if loss:
+            EvaluatorsRegistry.evaluators[loss] = cls
+
+
+class IResultProvider(object):
+    def get_metric_names(self):
+        return set()
+
+    def get_metric_values(self):
+        return {}
+
+
+class EvaluatorBase(AcceleratedUnit, IResultProvider,
+                    metaclass=EvaluatorsRegistry):
+    """Allocates err_output; testing mode merges per-minibatch outputs
+    (reference evaluator.py:73-141)."""
+
+    LOSS = None
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "EVALUATOR")
+        super(EvaluatorBase, self).__init__(workflow, **kwargs)
+        self.mean = kwargs.get("mean", True)
+        self.err_output = Array(name="err_output")
+        self._merged_output = None
+        self.krn_constants_i_ = None
+        self.testing = kwargs.get("testing", False)
+        self.demand("output", "batch_size")
+
+    @property
+    def merged_output(self):
+        return self._merged_output
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorBase, self).initialize(device=device, **kwargs)
+        if not self.err_output or \
+                self.err_output.shape != self.output.shape:
+            self.err_output.reset(numpy.zeros(
+                self.output.shape, dtype=self.output.dtype))
+        if self.testing:
+            total = getattr(self, "class_lengths", None)
+            n = sum(total) if total else self.output.shape[0]
+            self._merged_output = numpy.zeros(
+                (n,) + tuple(self.output.shape[1:]),
+                dtype=self.output.dtype)
+
+    def merge_output(self):
+        """Testing mode: collect minibatch outputs into one array
+        (reference evaluator.py:122-131)."""
+        if self._merged_output is None:
+            return
+        bs = int(self.batch_size)
+        off = int(self.offset)
+        self.output.map_read()
+        self._merged_output[off - bs:off] = self.output.mem[:bs]
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Softmax cross-entropy gradient + classification stats
+    (reference evaluator.py:145-330)."""
+
+    MAPPING = "evaluator_softmax"
+    LOSS = "softmax"
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorSoftmax, self).__init__(workflow, **kwargs)
+        self.compute_confusion_matrix = kwargs.get(
+            "compute_confusion_matrix", True)
+        self.confusion_matrix = Array(name="confusion_matrix")
+        self.n_err = Array(name="n_err")
+        self.max_err_output_sum = Array(name="max_err_output_sum")
+        self.class_keys = None
+        self.demand("labels", "max_idx")
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorSoftmax, self).initialize(device=device, **kwargs)
+        out_size = int(numpy.prod(self.output.shape[1:]))
+        self.n_err.reset(numpy.zeros(2, dtype=numpy.int32))
+        self.max_err_output_sum.reset(numpy.zeros(1, self.output.dtype))
+        if self.compute_confusion_matrix:
+            self.confusion_matrix.reset(numpy.zeros(
+                (out_size, out_size), dtype=numpy.int32))
+        else:
+            self.confusion_matrix.reset()
+
+    def _accumulate(self, err, n_err_delta, conf_delta, max_err_sum):
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = err
+        self.n_err.map_write()
+        self.n_err.mem += numpy.asarray(n_err_delta)
+        if self.confusion_matrix:
+            self.confusion_matrix.map_write()
+            self.confusion_matrix.mem += numpy.asarray(conf_delta)
+        self.max_err_output_sum.map_write()
+        self.max_err_output_sum.mem[0] = max(
+            float(self.max_err_output_sum.mem[0]), float(max_err_sum))
+
+    def numpy_run(self):
+        self.output.map_read()
+        self.max_idx.map_read()
+        self.labels.map_read()
+        out2 = self.output.matrix
+        err, n_err_delta, conf, mx = ev_ops.softmax_ce_numpy(
+            out2, self.max_idx.mem, self.labels.mem,
+            int(self.batch_size), out2.shape[1], mean=self.mean)
+        self._accumulate(err.reshape(self.output.shape),
+                         n_err_delta, conf, mx)
+        if self.testing:
+            self.merge_output()
+
+    def jax_run(self):
+        out = self.output.dev
+        out2 = out.reshape(out.shape[0], -1)
+        err, n_err_delta, conf, mx = ev_ops.softmax_ce_jax(
+            out2, self.max_idx.dev, self.labels.dev,
+            int(self.batch_size), int(out2.shape[1]), mean=self.mean)
+        # stats are tiny; accumulate on host (epoch-cadence reads)
+        self._accumulate(numpy.asarray(err).reshape(self.output.shape),
+                         n_err_delta, conf, mx)
+        self.err_output.set_dev(err.reshape(self.output.shape))
+        if self.testing:
+            self.merge_output()
+
+    def get_metric_names(self):
+        return {"n_err", "confusion"} if not self.testing else {"Output"}
+
+    def get_metric_values(self):
+        if self.testing and self._merged_output is not None:
+            return {"Output": numpy.array(self._merged_output)}
+        return {}
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """MSE gradient + [sum,max,min] metrics + optional class-target
+    nearest-neighbour error (reference evaluator.py:334-556)."""
+
+    MAPPING = "evaluator_mse"
+    LOSS = "mse"
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorMSE, self).__init__(workflow, **kwargs)
+        self.metrics = Array(name="metrics")
+        self.mse = Array(name="mse")
+        self.n_err = Array(name="n_err")
+        self.root = kwargs.get("root", True)
+        self.squared_mse = kwargs.get("squared_mse", False)
+        self.class_targets = None
+        self.labels = None
+        self.demand("target")
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorMSE, self).initialize(device=device, **kwargs)
+        if self.output.shape != self.target.shape:
+            raise ValueError(
+                "output shape %s != target shape %s"
+                % (self.output.shape, self.target.shape))
+        self.metrics.reset(numpy.zeros(3, dtype=self.output.dtype))
+        self.metrics.mem[2] = numpy.inf
+        self.mse.reset(numpy.zeros(self.output.shape[0],
+                                   dtype=self.output.dtype))
+        self.n_err.reset(numpy.zeros(2, dtype=numpy.int32))
+
+    def _accumulate(self, err, metrics_delta, mse_per):
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = numpy.asarray(err)
+        self.metrics.map_write()
+        md = numpy.asarray(metrics_delta)
+        self.metrics.mem[0] += md[0]
+        self.metrics.mem[1] = max(self.metrics.mem[1], md[1])
+        self.metrics.mem[2] = min(self.metrics.mem[2], md[2])
+        self.mse.map_invalidate()
+        self.mse.mem[...] = numpy.asarray(mse_per)
+        if (self.class_targets is not None and self.labels is not None):
+            self._nn_class_error()
+
+    def _nn_class_error(self):
+        """Nearest class-target error (reference mse_find_closest kernel)."""
+        self.class_targets.map_read()
+        self.labels.map_read()
+        self.output.map_read()
+        ct = self.class_targets.matrix
+        out = self.output.matrix
+        n_ok = 0
+        bs = int(self.batch_size)
+        for i in range(bs):
+            d = ((ct - out[i]) ** 2).sum(axis=1)
+            if int(numpy.argmin(d)) == int(self.labels.mem[i]):
+                n_ok += 1
+        self.n_err.map_write()
+        self.n_err.mem[0] += bs - n_ok
+        self.n_err.mem[1] += bs
+
+    def numpy_run(self):
+        self.output.map_read()
+        self.target.map_read()
+        err, md, mse_per = ev_ops.mse_numpy(
+            self.output.matrix, self.target.matrix, int(self.batch_size),
+            mean=self.mean, root=self.root)
+        self._accumulate(err.reshape(self.output.shape), md, mse_per)
+        if self.testing:
+            self.merge_output()
+
+    def jax_run(self):
+        err, md, mse_per = ev_ops.mse_jax(
+            self.output.dev, self.target.dev, int(self.batch_size),
+            mean=self.mean, root=self.root)
+        self._accumulate(numpy.asarray(err), md, mse_per)
+        self.err_output.set_dev(err)
+        if self.testing:
+            self.merge_output()

@@ -1,0 +1,122 @@
+"""Fully-connected backward (gradient-descent) units.
+
+TPU-era equivalent of reference gd.py (668 LoC — SURVEY.md §2.3).
+Registered under the same type strings as their forward pairs.
+
+Each run: (1) optional chain-rule ``err_output *= f'(output)``,
+(2) err_input GEMM, (3) weight/bias gradient GEMMs, (4) the shared update
+algebra (:mod:`znicz_tpu.ops.gd_math`) with pluggable solvers
+(momentum / adagrad / adadelta / fast — reference gd.py:111,131-207).
+On the jax path all four stages are jitted and stay device-resident.
+"""
+
+import jax.numpy as jnp
+import numpy
+
+from znicz_tpu.units.nn_units import (
+    GradientDescentBase, GradientDescentWithActivation)
+from znicz_tpu.ops import dense, activations
+
+
+class GradientDescent(GradientDescentBase):
+    """Backward for All2All (reference gd.py:73-551)."""
+
+    MAPPING = {"all2all"}
+    ACTIVATION = "linear"
+    SOLVERS = ("momentum", "adagrad", "adadelta", "fast")
+
+    def __init__(self, workflow, **kwargs):
+        super(GradientDescent, self).__init__(workflow, **kwargs)
+        self.demand("weights")
+        if self.include_bias:
+            self.demand("bias")
+
+    # -- chain rule through the activation ---------------------------------
+    def numpy_err_output_update(self):
+        if self.ACTIVATION == "linear":
+            return
+        self.err_output.map_write()
+        self.err_output.mem *= activations.derivative_numpy(
+            self.ACTIVATION, self.output.mem.reshape(
+                self.err_output.shape))
+
+    def jax_err_output_update(self):
+        if self.ACTIVATION == "linear":
+            return
+        d = activations.derivative_jax(
+            self.ACTIVATION, self.output.dev.reshape(self.err_output.shape))
+        self.err_output.set_dev(self.err_output.dev * d)
+
+    # -- numpy path (the executable spec) ----------------------------------
+    def numpy_run(self):
+        self.numpy_err_output_update()
+        err_in, grad_w, grad_b = dense.backward_numpy(
+            self.input.mem, self.err_output.mem, self.weights.mem,
+            weights_transposed=self.weights_transposed,
+            need_err_input=self.need_err_input,
+            include_bias=self.include_bias and self.bias is not None)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            bp = err_in * self.err_input_alpha
+            if self.err_input_beta:
+                bp = bp + self.err_input_beta * self.err_input.mem
+            self.err_input.mem[...] = bp
+        if self.need_gradient_weights:
+            self.gradient_weights.map_write()
+            self.gradient_weights.mem[...] = grad_w
+            self._numpy_apply_update("weights")
+            if self.include_bias and self.bias:
+                self.gradient_bias.map_write()
+                self.gradient_bias.mem[...] = grad_b
+                self._numpy_apply_update("bias")
+
+    # -- jax path ----------------------------------------------------------
+    def jax_run(self):
+        self.jax_err_output_update()
+        err_in, grad_w, grad_b = dense.backward_jax(
+            self.input.dev, self.err_output.dev, self.weights.dev,
+            weights_transposed=self.weights_transposed,
+            need_err_input=self.need_err_input,
+            include_bias=self.include_bias and self.bias is not None)
+        if self.need_err_input:
+            bp = err_in * self.err_input_alpha
+            if self.err_input_beta:
+                bp = bp + self.err_input_beta * self.err_input.dev
+            self.err_input.set_dev(bp)
+        if self.need_gradient_weights:
+            self.gradient_weights.set_dev(grad_w)
+            self._jax_apply_update("weights", grad_w)
+            if self.include_bias and self.bias:
+                self.gradient_bias.set_dev(grad_b)
+                self._jax_apply_update("bias", grad_b)
+
+
+class GDSoftmax(GradientDescent):
+    """err_output already equals the softmax-CE gradient from the evaluator
+    (reference gd.py:552-558)."""
+    MAPPING = {"softmax"}
+    ACTIVATION = "linear"
+
+
+class GDTanh(GradientDescentWithActivation, GradientDescent):
+    """f'(y) = 1.14381894 - 0.388484177 y^2 (reference gd.py:561-591)."""
+    MAPPING = {"all2all_tanh"}
+    ACTIVATION = "tanh"
+
+
+class GDRELU(GradientDescentWithActivation, GradientDescent):
+    """f'(y) = 1 - e^-y (reference gd.py:594-620)."""
+    MAPPING = {"all2all_relu"}
+    ACTIVATION = "relu"
+
+
+class GDStrictRELU(GradientDescentWithActivation, GradientDescent):
+    """f'(y) = [y > 0] (reference gd.py:623-646)."""
+    MAPPING = {"all2all_str"}
+    ACTIVATION = "strict_relu"
+
+
+class GDSigmoid(GradientDescentWithActivation, GradientDescent):
+    """f'(y) = y (1 - y) (reference gd.py:649-668)."""
+    MAPPING = {"all2all_sigmoid"}
+    ACTIVATION = "sigmoid"
