@@ -2,19 +2,37 @@
 
 Tests run on the CPU host platform with 8 virtual devices so multi-chip
 sharding paths compile and execute without TPU hardware (SURVEY.md §4.4 —
-single-process multi-device simulation).  Must run before jax import.
+single-process multi-device simulation).
+
+The axon TPU-tunnel plugin registers itself (and imports jax) from
+``sitecustomize`` at interpreter startup, so jax has already latched
+``JAX_PLATFORMS=axon`` from the environment by the time this file runs —
+setting the env var here is too late.  ``jax.config.update`` still works
+because no backend has been initialized yet.
 """
 
 import os
-import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# Drop the axon TPU-tunnel plugin from the import path: its PJRT discovery
-# can block on the tunnel even when JAX_PLATFORMS=cpu.
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-os.environ["PYTHONPATH"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+# float32 matmuls must match numpy to <1e-4 (reference test contract,
+# tests/unit/test_all2all.py:95-152).  TPU-style bf16 passes are a bench-time
+# choice, not a test-time one.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _snapshots_to_tmp(tmp_path, monkeypatch):
+    """Keep generated snapshot pickles out of the repo tree."""
+    from znicz_tpu.core.config import root
+    monkeypatch.setattr(root.common.dirs, "snapshots", str(tmp_path))
+

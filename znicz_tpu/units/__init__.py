@@ -1,7 +1,13 @@
 """The Znicz NN engine — layer units, evaluators, decisions, schedulers.
 
 TPU-era equivalent of the reference repo's top-level unit modules
-(SURVEY.md §2.2-§2.5).  Importing a module registers its units in the
+(SURVEY.md §2.2-§2.5).  Importing this package registers every unit in the
 type-string registry (``nn_units.mapping``); keep imports even if they look
 unused — exactly like the reference (standard_workflow_base.py:44-51).
 """
+
+from znicz_tpu.units import nn_units  # noqa: F401
+from znicz_tpu.units import all2all  # noqa: F401
+from znicz_tpu.units import gd  # noqa: F401
+from znicz_tpu.units import evaluator  # noqa: F401
+from znicz_tpu.units import decision  # noqa: F401

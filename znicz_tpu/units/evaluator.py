@@ -51,6 +51,9 @@ class EvaluatorBase(AcceleratedUnit, IResultProvider,
         self.krn_constants_i_ = None
         self.testing = kwargs.get("testing", False)
         self.demand("output", "batch_size")
+        if self.testing:
+            # merge_output needs the loader's running sample offset
+            self.demand("offset")
 
     @property
     def merged_output(self):
@@ -108,9 +111,13 @@ class EvaluatorSoftmax(EvaluatorBase):
         else:
             self.confusion_matrix.reset()
 
-    def _accumulate(self, err, n_err_delta, conf_delta, max_err_sum):
-        self.err_output.map_invalidate()
-        self.err_output.mem[...] = err
+    def _accumulate_stats(self, n_err_delta, conf_delta, max_err_sum):
+        """Fold tiny per-minibatch stats into host accumulators.
+
+        The err_output tensor itself stays wherever the compute ran —
+        device-resident on the jax path (the GD chain reads ``.dev``; no
+        D2H round-trip on the hot loop), host on the numpy path.
+        """
         self.n_err.map_write()
         self.n_err.mem += numpy.asarray(n_err_delta)
         if self.confusion_matrix:
@@ -128,8 +135,9 @@ class EvaluatorSoftmax(EvaluatorBase):
         err, n_err_delta, conf, mx = ev_ops.softmax_ce_numpy(
             out2, self.max_idx.mem, self.labels.mem,
             int(self.batch_size), out2.shape[1], mean=self.mean)
-        self._accumulate(err.reshape(self.output.shape),
-                         n_err_delta, conf, mx)
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = err.reshape(self.output.shape)
+        self._accumulate_stats(n_err_delta, conf, mx)
         if self.testing:
             self.merge_output()
 
@@ -139,10 +147,9 @@ class EvaluatorSoftmax(EvaluatorBase):
         err, n_err_delta, conf, mx = ev_ops.softmax_ce_jax(
             out2, self.max_idx.dev, self.labels.dev,
             int(self.batch_size), int(out2.shape[1]), mean=self.mean)
-        # stats are tiny; accumulate on host (epoch-cadence reads)
-        self._accumulate(numpy.asarray(err).reshape(self.output.shape),
-                         n_err_delta, conf, mx)
         self.err_output.set_dev(err.reshape(self.output.shape))
+        # stats are tiny ((2,), (C,C), scalar); accumulate on host
+        self._accumulate_stats(n_err_delta, conf, mx)
         if self.testing:
             self.merge_output()
 
@@ -185,9 +192,7 @@ class EvaluatorMSE(EvaluatorBase):
                                    dtype=self.output.dtype))
         self.n_err.reset(numpy.zeros(2, dtype=numpy.int32))
 
-    def _accumulate(self, err, metrics_delta, mse_per):
-        self.err_output.map_invalidate()
-        self.err_output.mem[...] = numpy.asarray(err)
+    def _accumulate_stats(self, metrics_delta, mse_per):
         self.metrics.map_write()
         md = numpy.asarray(metrics_delta)
         self.metrics.mem[0] += md[0]
@@ -221,7 +226,9 @@ class EvaluatorMSE(EvaluatorBase):
         err, md, mse_per = ev_ops.mse_numpy(
             self.output.matrix, self.target.matrix, int(self.batch_size),
             mean=self.mean, root=self.root)
-        self._accumulate(err.reshape(self.output.shape), md, mse_per)
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = err.reshape(self.output.shape)
+        self._accumulate_stats(md, mse_per)
         if self.testing:
             self.merge_output()
 
@@ -229,7 +236,7 @@ class EvaluatorMSE(EvaluatorBase):
         err, md, mse_per = ev_ops.mse_jax(
             self.output.dev, self.target.dev, int(self.batch_size),
             mean=self.mean, root=self.root)
-        self._accumulate(numpy.asarray(err), md, mse_per)
         self.err_output.set_dev(err)
+        self._accumulate_stats(md, mse_per)
         if self.testing:
             self.merge_output()

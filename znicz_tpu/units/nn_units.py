@@ -69,6 +69,10 @@ class MatchingObject(type):
         types = clsdict.get("MAPPING", None)
         if not types or clsdict.get("hide_from_registry"):
             return
+        if not isinstance(types, (set, frozenset)):
+            raise TypeError(
+                "%s.MAPPING must be a set of type strings, got %s"
+                % (name, type(types).__name__))
         for tpe in types:
             match = mapping.setdefault(tpe, Match())
             if getattr(cls, "_registry_role", None) == "backward":
@@ -222,6 +226,12 @@ class GradientDescentWithActivation(object):
 
     ACTIVATION = "linear"
 
+    def __init__(self, workflow, **kwargs):
+        super(GradientDescentWithActivation, self).__init__(workflow, **kwargs)
+        # The chain-rule pre-step reads the forward's activation output;
+        # fail at initialize, not mid-run (reference nn_units.py:299-306).
+        self.demand("output")
+
 
 class GradientDescentBase(AcceleratedUnit, IDistributable,
                           metaclass=MatchingObject):
@@ -327,16 +337,11 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
         for key, ref in (("weights", self.weights), ("bias", self.bias)):
             if ref is None or not ref:
                 continue
-            st = {}
-            for s in self.solvers:
-                if s == "adagrad":
-                    st["adagrad"] = numpy.zeros_like(ref.mem)
-                elif s == "adadelta":
-                    st["adadelta_v"] = numpy.zeros_like(ref.mem)
-                    st["adadelta_gv"] = numpy.zeros_like(ref.mem)
-                elif s == "fast":
-                    st["fast"] = numpy.zeros_like(ref.mem)
-            self._solver_state_np[key] = st
+            # acc/vel live in the reference-visible Arrays above; only the
+            # solver slots come from the shared allocator.
+            self._solver_state_np[key] = gd_math.init_state(
+                ref.mem, {"solvers": self.solvers, "accumulate": False,
+                          "need_vel": False})
 
     # -- shared update plumbing --------------------------------------------
     def _hyper(self, bias=False):
