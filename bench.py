@@ -1,0 +1,62 @@
+"""Benchmark — prints ONE JSON line for the driver.
+
+Measures fused train-step throughput (images/sec) on the flagship model
+(see __graft_entry__.py) on whatever device is live (real TPU chip under
+the driver; CPU elsewhere).  The reference publishes no throughput numbers
+(SURVEY.md §6), so vs_baseline compares against the previous published
+value in BASELINE.json when present, else 1.0.
+"""
+
+import json
+import os
+import time
+
+import numpy
+
+
+def main():
+    from znicz_tpu.core import prng
+    from znicz_tpu.parallel import FusedMLP
+    import __graft_entry__ as ge
+
+    batch = 256
+    trainer = FusedMLP(ge.FLAGSHIP_LAYERS, ge.INPUT_SIZE,
+                       rand=prng.RandomGenerator().seed(1234))
+    r = numpy.random.RandomState(0)
+    x = r.uniform(-1, 1, (batch, ge.INPUT_SIZE)).astype(numpy.float32)
+    labels = r.randint(0, 10, batch).astype(numpy.int32)
+
+    # warmup + compile
+    for _ in range(3):
+        trainer.step(x, labels)
+    import jax
+    jax.block_until_ready(trainer.params)
+
+    n_steps = 50
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        m = trainer.step(x, labels)
+    jax.block_until_ready(trainer.params)
+    dt = time.perf_counter() - t0
+    ips = n_steps * batch / dt
+
+    baseline = 0.0
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            baseline = float(json.load(f).get("published", {})
+                             .get("mlp_images_per_sec", 0.0))
+    except Exception:
+        pass
+    vs = ips / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "mnist_mlp_fused_train_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

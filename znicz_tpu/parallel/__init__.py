@@ -1,0 +1,11 @@
+"""SPMD parallelism over TPU device meshes.
+
+TPU-era replacement for the reference's master-slave parameter server
+(SURVEY.md §2.8): the per-minibatch forward+backward+update runs as ONE
+jitted XLA computation over a ``jax.sharding.Mesh``; gradient all-reduce,
+weight broadcast and Decision stat aggregation (sum n_err / confusion,
+decision.py:529-544) become XLA collectives inserted by GSPMD.
+"""
+
+from znicz_tpu.parallel.mesh import make_mesh  # noqa: F401
+from znicz_tpu.parallel.fused import FusedMLP, build_fc_specs  # noqa: F401

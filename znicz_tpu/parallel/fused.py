@@ -1,0 +1,301 @@
+"""Fused SPMD training — one jitted XLA computation per minibatch.
+
+SURVEY.md §7 design stance: the unit graph remains the epoch-level control
+plane, but the hot loop — forward, loss gradient, backward, per-layer
+update — compiles to a single XLA computation.  This module is the fused
+path for fully-connected stacks (the reference's all2all family,
+all2all.py:53-474 + gd.py:73-551); conv models plug in as further spec
+types.
+
+Parity: weight init matches ``All2All.initialize`` (magnitude heuristic
+all2all.py:106-117, fill semantics all2all.py:119-127, same PRNG draw
+order), and the update algebra is literally :func:`znicz_tpu.ops.gd_math.
+update` with ``xp=jnp`` — the same function the unit-at-a-time path runs.
+Gradients come from ``jax.grad`` of the softmax-CE loss, which reproduces
+the reference's hand-written chain rule (verified by the parity test
+against the unit-graph path in float64).
+
+Sharding: parameters and inputs carry ``NamedSharding`` annotations over a
+``(data, model)`` mesh; GSPMD inserts the gradient all-reduce (psum over
+``data``) and the activation all-gathers (over ``model``) — the TPU-native
+replacement for the reference's parameter-server broadcast/aggregate cycle
+(nn_units.py:178-208, 644-694).
+"""
+
+from dataclasses import dataclass, field
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from znicz_tpu.core import prng
+from znicz_tpu.ops import activations, gd_math
+
+#: the FC family the fused path can compile (reference all2all.py classes);
+#: activation + magnitude constants come from the registered unit classes —
+#: single source of truth with the unit-graph path.
+FC_TYPES = ("all2all", "all2all_tanh", "all2all_relu", "all2all_str",
+            "all2all_sigmoid", "softmax")
+
+
+def _forward_class(tpe):
+    from znicz_tpu.units import nn_units, all2all  # noqa: F401 (registers)
+    return nn_units.mapping[tpe].forward
+
+DEFAULT_HYPER = dict(lr=0.01, wd=0.00005, l1_vs_l2=0.0, moment=0.0,
+                     acc_alpha=0.0, acc_beta=0.0, gd_alpha=0.0, gd_beta=1.0,
+                     factor_ortho=0.0)
+
+
+@dataclass
+class FCSpec:
+    """One fully-connected layer of the fused stack."""
+    type: str
+    n_in: int
+    n_out: int
+    activation: str
+    hyper: dict = field(default_factory=dict)        # weights hyper
+    hyper_bias: dict = field(default_factory=dict)   # bias hyper
+    flags: dict = field(default_factory=dict)
+    weights_stddev: float = None
+    bias_stddev: float = None
+    weights_filling: str = "uniform"
+    bias_filling: str = "uniform"
+    include_bias: bool = True
+
+    @property
+    def is_softmax(self):
+        return self.type == "softmax"
+
+    def init_stddev(self):
+        """Reference magnitude heuristic (all2all.py:106-117), using the
+        registered unit class's C constant."""
+        if self.weights_stddev is not None:
+            return self.weights_stddev
+        from znicz_tpu.units.nn_units import weights_magnitude
+        vle = weights_magnitude(_forward_class(self.type).C,
+                                self.n_in, self.n_out, self.weights_filling)
+        return min(vle, 0.5)
+
+
+def build_fc_specs(layers, input_sample_size, defaults=None):
+    """Build FCSpec list from a declarative ``layers`` config.
+
+    Each entry is a dict with "type" plus forward kwargs (optionally under
+    "->") and backward kwargs (under "<-") — the reference config format
+    (standard_workflow_base.py:406-422).
+    """
+    defaults = dict(DEFAULT_HYPER, **(defaults or {}))
+    specs = []
+    n_in = int(input_sample_size)
+    for layer in layers:
+        layer = dict(layer)
+        tpe = layer.pop("type")
+        if tpe not in FC_TYPES:
+            raise ValueError("fused path does not support layer type %r"
+                             % tpe)
+        fwd = dict(layer.pop("->", {}))
+        bwd = dict(layer.pop("<-", {}))
+        fwd.update({k: v for k, v in layer.items()})
+        shape = fwd.get("output_sample_shape", fwd.get("output_samples"))
+        if shape is None:
+            raise ValueError("layer %r needs output_sample_shape" % tpe)
+        n_out = int(numpy.prod(shape))
+        hyper = dict(defaults)
+        hyper.update(
+            lr=bwd.get("learning_rate", defaults["lr"]),
+            wd=bwd.get("weights_decay", defaults["wd"]),
+            l1_vs_l2=bwd.get("l1_vs_l2", defaults["l1_vs_l2"]),
+            moment=bwd.get("gradient_moment", defaults["moment"]),
+            acc_alpha=bwd.get("acc_alpha", defaults["acc_alpha"]),
+            acc_beta=bwd.get("acc_beta", defaults["acc_beta"]),
+            gd_alpha=bwd.get("gd_alpha", defaults["gd_alpha"]),
+            gd_beta=bwd.get("gd_beta", defaults["gd_beta"]),
+            factor_ortho=bwd.get("factor_ortho", defaults["factor_ortho"]))
+        hyper_bias = dict(hyper)
+        hyper_bias.update(
+            lr=bwd.get("learning_rate_bias", hyper["lr"]),
+            wd=bwd.get("weights_decay_bias", 0.0),
+            l1_vs_l2=bwd.get("l1_vs_l2_bias", hyper["l1_vs_l2"]),
+            moment=bwd.get("gradient_moment_bias", hyper["moment"]),
+            factor_ortho=0.0)
+        flags = dict(accumulate=bool(bwd.get("accumulate_gradient", False)),
+                     apply=True,
+                     solvers=frozenset(bwd.get("solvers", ())),
+                     ortho=bool(bwd.get("factor_ortho", 0)),
+                     variant_moment=bwd.get("variant_moment_gradient", True))
+        specs.append(FCSpec(
+            type=tpe, n_in=n_in, n_out=n_out,
+            activation=("linear" if tpe == "softmax"
+                        else _forward_class(tpe).ACTIVATION),
+            hyper=hyper, hyper_bias=hyper_bias, flags=flags,
+            weights_stddev=fwd.get("weights_stddev"),
+            bias_stddev=fwd.get("bias_stddev"),
+            weights_filling=fwd.get("weights_filling", "uniform"),
+            bias_filling=fwd.get("bias_filling", "uniform"),
+            include_bias=fwd.get("include_bias", True)))
+        n_in = n_out
+    return specs
+
+
+def init_params(specs, rand=None, dtype=numpy.float32):
+    """Host-side init with the unit path's exact draw order and fill
+    semantics (weights then bias per layer, all2all.py:119-127)."""
+    rand = rand or prng.get()
+    params = []
+    for spec in specs:
+        stddev = spec.init_stddev()
+        bias_stddev = spec.bias_stddev if spec.bias_stddev is not None \
+            else stddev
+        w = numpy.zeros((spec.n_out, spec.n_in), dtype=dtype)
+        _fill(rand, spec.weights_filling, w, stddev)
+        p = {"w": w}
+        if spec.include_bias:
+            b = numpy.zeros(spec.n_out, dtype=dtype)
+            _fill(rand, spec.bias_filling, b, bias_stddev)
+            p["b"] = b
+        params.append(p)
+    return params
+
+
+def _fill(rand, filling, array, stddev):
+    from znicz_tpu.units.nn_units import fill_array
+    fill_array(rand, filling, array, stddev)
+
+
+def init_opt_state(specs, params):
+    """Optimizer-state pytree mirroring the per-layer Arrays of the unit
+    path (vel = gradient_*_with_moment, acc, solver slots)."""
+    states = []
+    for spec, p in zip(specs, params):
+        st = {"w": gd_math.init_state(
+            p["w"], dict(spec.flags, need_vel=True))}
+        if "b" in p:
+            st["b"] = gd_math.init_state(
+                p["b"], dict(spec.flags, need_vel=True))
+        states.append(st)
+    return states
+
+
+def forward(params, x, specs, return_logits=False):
+    """Pure forward pass.  With ``return_logits`` the softmax head is left
+    un-normalized (for the CE loss); otherwise softmax is applied."""
+    y = x.reshape(x.shape[0], -1)
+    for p, spec in zip(params, specs):
+        y = y @ p["w"].T
+        if "b" in p:
+            y = y + p["b"]
+        if not spec.is_softmax:
+            y = activations.apply_jax(spec.activation, y)
+        elif not return_logits:
+            y = jax.nn.softmax(y, axis=1)
+    return y
+
+
+def _loss_and_stats(params, x, labels, specs):
+    """Mean softmax-CE loss (matches evaluator err_output scaling,
+    ops/evaluator.py) + error count."""
+    y = forward(params, x, specs, return_logits=True)
+    logp = jax.nn.log_softmax(y, axis=1)
+    valid = labels >= 0
+    lbl = jnp.maximum(labels, 0)
+    ce = -jnp.take_along_axis(logp, lbl[:, None], axis=1)[:, 0]
+    ce = jnp.where(valid, ce, 0.0)
+    loss = ce.sum() / jnp.maximum(valid.sum(), 1)
+    n_err = (valid & (jnp.argmax(y, axis=1) != lbl)).sum()
+    return loss, n_err
+
+
+class FusedMLP:
+    """Compiled trainer for an FC stack over an optional device mesh."""
+
+    def __init__(self, layers, input_sample_size, mesh=None, rand=None,
+                 dtype=numpy.float32, defaults=None):
+        self.specs = build_fc_specs(layers, input_sample_size, defaults)
+        if not self.specs[-1].is_softmax:
+            raise ValueError(
+                "FusedMLP trains a softmax-CE objective; the last layer "
+                "must be type 'softmax' (got %r). Use the unit-graph path "
+                "for other heads." % self.specs[-1].type)
+        self.mesh = mesh
+        params_host = init_params(self.specs, rand, dtype)
+        states_host = init_opt_state(self.specs, params_host)
+        self.params = self._place_params(params_host)
+        self.state = jax.tree.map(
+            lambda a: jax.device_put(a), states_host)
+        # specs close over the traced functions (they carry dicts, so they
+        # can't be hashable static args); hyperparameters bake in as XLA
+        # constants.
+        specs = tuple(self.specs)
+        self._step = jax.jit(
+            lambda p, s, x, l: _train_step(p, s, x, l, specs),
+            donate_argnums=(0, 1))
+        self._fwd = jax.jit(lambda p, x: forward(p, x, specs))
+
+    # -- sharding -----------------------------------------------------------
+    def _param_spec(self, spec, name):
+        """model-axis sharding for wide layers, replicated otherwise."""
+        if self.mesh is None:
+            return None
+        msize = self.mesh.shape["model"]
+        if msize > 1 and spec.n_out % msize == 0:
+            return P("model", None) if name == "w" else P("model")
+        return P()
+
+    def _place_params(self, params_host):
+        if self.mesh is None:
+            return jax.tree.map(jax.device_put, params_host)
+        placed = []
+        for spec, p in zip(self.specs, params_host):
+            q = {}
+            for name, arr in p.items():
+                ns = NamedSharding(self.mesh, self._param_spec(spec, name))
+                q[name] = jax.device_put(arr, ns)
+            placed.append(q)
+        return placed
+
+    def _place_batch(self, x, labels):
+        if self.mesh is None:
+            return jax.device_put(x), jax.device_put(labels)
+        dsize = self.mesh.shape["data"]
+        if x.shape[0] % dsize:
+            raise ValueError("batch %d not divisible by data-parallel %d"
+                             % (x.shape[0], dsize))
+        xs = NamedSharding(self.mesh, P("data", *([None] * (x.ndim - 1))))
+        ls = NamedSharding(self.mesh, P("data"))
+        return jax.device_put(x, xs), jax.device_put(labels, ls)
+
+    # -- public api ---------------------------------------------------------
+    def step(self, x, labels):
+        """One fused train step.  Returns {"loss": float, "n_err": int}."""
+        x, labels = self._place_batch(x, labels)
+        self.params, self.state, metrics = self._step(
+            self.params, self.state, x, labels)
+        return metrics
+
+    def predict(self, x):
+        x, _ = self._place_batch(x, numpy.zeros(x.shape[0], numpy.int32))
+        return self._fwd(self.params, x)
+
+    def host_params(self):
+        return jax.tree.map(lambda a: numpy.asarray(a), self.params)
+
+
+def _train_step(params, state, x, labels, specs):
+    (loss, n_err), grads = jax.value_and_grad(
+        lambda p: _loss_and_stats(p, x, labels, specs), has_aux=True)(params)
+    new_params, new_state = [], []
+    for spec, p, st, g in zip(specs, params, state, grads):
+        np_, nst = {}, {}
+        np_["w"], nst["w"], _ = gd_math.update(
+            jnp, p["w"], g["w"], st["w"], spec.hyper, spec.flags)
+        if "b" in p:
+            hyper_b = spec.hyper_bias
+            flags_b = dict(spec.flags, ortho=False)
+            np_["b"], nst["b"], _ = gd_math.update(
+                jnp, p["b"], g["b"], st["b"], hyper_b, flags_b)
+        new_params.append(np_)
+        new_state.append(nst)
+    return new_params, new_state, {"loss": loss, "n_err": n_err}

@@ -1,0 +1,38 @@
+"""Device-mesh construction.
+
+The scaling recipe (jax-ml.github.io/scaling-book): pick a mesh, annotate
+shardings, let XLA insert the collectives.  Axis names:
+
+* ``data``  — batch dimension (data parallelism; gradient psum rides ICI)
+* ``model`` — parameter dimension (tensor parallelism for wide layers)
+"""
+
+import numpy
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices=None, model_parallel=1, devices=None):
+    """Build a (data, model) mesh over the first ``n_devices`` devices.
+
+    ``model_parallel`` sets the model-axis extent; the rest goes to data.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError("requested %d devices, have %d" % (n, len(devices)))
+    if n % model_parallel:
+        raise ValueError("n_devices %d not divisible by model_parallel %d"
+                         % (n, model_parallel))
+    arr = numpy.array(devices[:n]).reshape(n // model_parallel,
+                                           model_parallel)
+    return Mesh(arr, ("data", "model"))
+
+
+def data_parallel_size(mesh):
+    return mesh.shape["data"] if mesh is not None else 1
+
+
+def model_parallel_size(mesh):
+    return mesh.shape["model"] if mesh is not None else 1

@@ -13,6 +13,7 @@ and fillings match reference all2all.py:106-127.
 import numpy
 
 from znicz_tpu.core.memory import Array
+from znicz_tpu.units import nn_units
 from znicz_tpu.units.nn_units import NNLayerBase, FullyConnectedOutput
 from znicz_tpu.ops import dense
 
@@ -31,11 +32,9 @@ class All2All(FullyConnectedOutput, NNLayerBase):
     def get_weights_magnitude(self):
         """Initial weight range such that activations start near maximum
         (reference all2all.py:106-117)."""
-        vle = numpy.sqrt(self.C / (self.input.sample_size +
-                                   numpy.prod(self.output_sample_shape)))
-        if self.weights_filling == "gaussian":
-            vle /= 3
-        return vle
+        return nn_units.weights_magnitude(
+            self.C, self.input.sample_size,
+            numpy.prod(self.output_sample_shape), self.weights_filling)
 
     def initialize(self, device=None, **kwargs):
         super(All2All, self).initialize(device=device, **kwargs)

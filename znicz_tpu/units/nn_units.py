@@ -84,6 +84,27 @@ class MatchingObject(type):
                 match._forward = cls
 
 
+def fill_array(rand, filling, array, stddev):
+    """Weight-init fillings (reference all2all.py:119-127) — shared by the
+    unit path and the fused path so init parity holds by construction."""
+    if filling == "uniform":
+        rand.fill(array, -stddev, stddev)
+    elif filling == "gaussian":
+        rand.fill_normal_real(array, 0, stddev)
+    elif filling == "constant":
+        array[:] = stddev
+    else:
+        raise ValueError("Invalid filling type %s" % filling)
+
+
+def weights_magnitude(c, n_in, n_out, filling="uniform"):
+    """Initial-weight range heuristic (reference all2all.py:106-117)."""
+    vle = numpy.sqrt(c / (n_in + n_out))
+    if filling == "gaussian":
+        vle /= 3
+    return vle
+
+
 class ForwardBase(AcceleratedUnit, metaclass=MatchingObject):
     """Base for forward-propagation units."""
     hide_from_registry = True
@@ -116,15 +137,7 @@ class Forward(ForwardBase, IDistributable):
                         "weights_transposed"]
 
     def fill_array(self, filling, array, stddev):
-        """Weight-init fillings (reference all2all.py:119-127)."""
-        if filling == "uniform":
-            self.rand.fill(array, -stddev, stddev)
-        elif filling == "gaussian":
-            self.rand.fill_normal_real(array, 0, stddev)
-        elif filling == "constant":
-            array[:] = stddev
-        else:
-            raise ValueError("Invalid filling type %s" % filling)
+        fill_array(self.rand, filling, array, stddev)
 
     def package_export(self):
         """Public-state dict for deployment packages
