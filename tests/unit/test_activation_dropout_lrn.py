@@ -1,0 +1,180 @@
+"""Standalone activations, dropout, and LRN: cross-validation + numdiff."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.backends import NumpyDevice, JaxDevice
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import TRAIN, VALID
+from znicz_tpu.ops import normalization as lrn_ops
+from znicz_tpu.units import activation as act_units
+from znicz_tpu.units import dropout as dropout_units
+from znicz_tpu.units import normalization as lrn_units
+
+ACT_PAIRS = [
+    (act_units.ForwardTanh, act_units.BackwardTanh),
+    (act_units.ForwardSigmoid, act_units.BackwardSigmoid),
+    (act_units.ForwardRELU, act_units.BackwardRELU),
+    (act_units.ForwardStrictRELU, act_units.BackwardStrictRELU),
+    (act_units.ForwardLog, act_units.BackwardLog),
+    (act_units.ForwardTanhLog, act_units.BackwardTanhLog),
+    (act_units.ForwardSinCos, act_units.BackwardSinCos),
+]
+
+
+def _run_pair(fwd_cls, bwd_cls, device, x, err):
+    wf = DummyWorkflow()
+    fwd = fwd_cls(wf)
+    fwd.input = Array(x.copy())
+    fwd.link_from(wf.start_point)
+    fwd.initialize(device=device)
+    fwd.run()
+    bwd = bwd_cls(wf)
+    bwd.err_output = Array(err.copy())
+    bwd.link_attrs(fwd, "input", "output")
+    bwd.initialize(device=device)
+    bwd.run()
+    return numpy.array(fwd.output.mem), numpy.array(bwd.err_input.mem)
+
+
+@pytest.mark.parametrize("fwd_cls,bwd_cls", ACT_PAIRS)
+def test_activation_jax_matches_numpy(fwd_cls, bwd_cls):
+    r = numpy.random.RandomState(1)
+    x = r.uniform(-2, 2, (3, 10)).astype(numpy.float64)
+    err = r.uniform(-1, 1, (3, 10)).astype(numpy.float64)
+    yn, en = _run_pair(fwd_cls, bwd_cls, NumpyDevice(), x, err)
+    yj, ej = _run_pair(fwd_cls, bwd_cls, JaxDevice(), x, err)
+    assert numpy.abs(yn - yj).max() < 1e-10, fwd_cls.__name__
+    assert numpy.abs(en - ej).max() < 1e-10, bwd_cls.__name__
+
+
+@pytest.mark.parametrize("fwd_cls,bwd_cls", ACT_PAIRS)
+def test_activation_backward_matches_numdiff(fwd_cls, bwd_cls):
+    """err_input == d/dx sum(err * f(x)) by five-point stencil."""
+    r = numpy.random.RandomState(2)
+    # keep away from tanhlog's |x|=3 kinks and strict_relu's 0 kink
+    x = r.uniform(0.3, 2.0, (2, 6)) * r.choice([-1, 1], (2, 6))
+    x = numpy.where(numpy.abs(numpy.abs(x) - 3.0) < 0.1, x * 1.2, x)
+    err = r.uniform(-1, 1, (2, 6))
+    _, e_ana = _run_pair(fwd_cls, bwd_cls, NumpyDevice(), x, err)
+
+    fwd = fwd_cls(DummyWorkflow())
+    h = 1e-6
+    coeffs = numpy.array([-1.0, 8.0, -8.0, 1.0]) / (12.0 * h)
+    points = (2 * h, h, -h, -2 * h)
+    flat = x.reshape(-1)
+    g = numpy.zeros_like(flat)
+    for i in range(flat.size):
+        orig = flat[i]
+        vals = []
+        for d in points:
+            flat[i] = orig + d
+            vals.append((err * fwd._apply_numpy(x)).sum())
+        flat[i] = orig
+        g[i] = (numpy.array(vals) * coeffs).sum()
+    assert numpy.abs(g.reshape(x.shape) - e_ana).max() < 1e-5, \
+        fwd_cls.__name__
+
+
+def test_mul_autoset_factor():
+    r = numpy.random.RandomState(3)
+    x = r.uniform(-2, 2, (3, 5)).astype(numpy.float64)
+    wf = DummyWorkflow()
+    fwd = act_units.ForwardMul(wf)
+    fwd.input = Array(x.copy())
+    fwd.link_from(wf.start_point)
+    fwd.initialize(device=NumpyDevice())
+    fwd.run()
+    expect = 0.75 / numpy.abs(x).max()
+    assert abs(fwd.factor - expect) < 1e-12
+    assert numpy.abs(fwd.output.mem - x * expect).max() < 1e-12
+
+
+def _dropout_net(device, minibatch_class, seed=13):
+    r = numpy.random.RandomState(4)
+    x = r.uniform(-1, 1, (4, 10)).astype(numpy.float64)
+    err = r.uniform(-1, 1, (4, 10)).astype(numpy.float64)
+    wf = DummyWorkflow()
+    fwd = dropout_units.DropoutForward(
+        wf, dropout_ratio=0.4, rand=prng.RandomGenerator().seed(seed))
+    fwd.input = Array(x.copy())
+    fwd.minibatch_class = minibatch_class
+    fwd.link_from(wf.start_point)
+    fwd.initialize(device=device)
+    fwd.run()
+    bwd = dropout_units.DropoutBackward(wf, dropout_ratio=0.4)
+    bwd.err_output = Array(err.copy())
+    bwd.link_attrs(fwd, "input", "mask", "minibatch_class")
+    bwd.initialize(device=device)
+    bwd.run()
+    return (x, err, numpy.array(fwd.output.mem),
+            numpy.array(fwd.mask.mem), numpy.array(bwd.err_input.mem))
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, JaxDevice])
+def test_dropout_train_mode(device_cls):
+    x, err, out, mask, err_in = _dropout_net(device_cls(), TRAIN)
+    leave = 1.0 - 0.4
+    vals = numpy.unique(mask)
+    assert set(numpy.round(vals, 10)) <= {0.0, round(1.0 / leave, 10)}
+    assert numpy.abs(out - x * mask).max() < 1e-12
+    assert numpy.abs(err_in - err * mask).max() < 1e-12
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, JaxDevice])
+def test_dropout_valid_passthrough(device_cls):
+    x, err, out, _, err_in = _dropout_net(device_cls(), VALID)
+    assert numpy.abs(out - x).max() == 0
+    assert numpy.abs(err_in - err).max() == 0
+
+
+def test_dropout_same_seed_same_mask_across_backends():
+    _, _, _, mask_np, _ = _dropout_net(NumpyDevice(), TRAIN, seed=77)
+    _, _, _, mask_jx, _ = _dropout_net(JaxDevice(), TRAIN, seed=77)
+    assert (mask_np == mask_jx).all()
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, JaxDevice])
+def test_lrn_units(device_cls):
+    device = device_cls()
+    r = numpy.random.RandomState(5)
+    x = r.uniform(-1, 1, (2, 4, 4, 8)).astype(numpy.float64)
+    err = r.uniform(-1, 1, (2, 4, 4, 8)).astype(numpy.float64)
+    wf = DummyWorkflow()
+    fwd = lrn_units.LRNormalizerForward(wf)
+    fwd.input = Array(x.copy())
+    fwd.link_from(wf.start_point)
+    fwd.initialize(device=device)
+    fwd.run()
+    bwd = lrn_units.LRNormalizerBackward(wf)
+    bwd.err_output = Array(err.copy())
+    bwd.link_attrs(fwd, "input")
+    bwd.initialize(device=device)
+    bwd.run()
+    yn = lrn_ops.lrn_forward_numpy(x)
+    assert numpy.abs(numpy.array(fwd.output.mem) - yn).max() < 1e-10
+    en = lrn_ops.lrn_backward_numpy(x, err)
+    assert numpy.abs(numpy.array(bwd.err_input.mem) - en).max() < 1e-10
+
+
+def test_lrn_backward_matches_numdiff():
+    r = numpy.random.RandomState(6)
+    x = r.uniform(-1, 1, (1, 2, 2, 7))
+    err = r.uniform(-1, 1, (1, 2, 2, 7))
+    e_ana = lrn_ops.lrn_backward_numpy(x, err)
+    h = 1e-6
+    coeffs = numpy.array([-1.0, 8.0, -8.0, 1.0]) / (12.0 * h)
+    points = (2 * h, h, -h, -2 * h)
+    flat = x.reshape(-1)
+    g = numpy.zeros_like(flat)
+    for i in range(flat.size):
+        orig = flat[i]
+        vals = []
+        for d in points:
+            flat[i] = orig + d
+            vals.append((err * lrn_ops.lrn_forward_numpy(x)).sum())
+        flat[i] = orig
+        g[i] = (numpy.array(vals) * coeffs).sum()
+    assert numpy.abs(g.reshape(x.shape) - e_ana).max() < 1e-5

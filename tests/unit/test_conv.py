@@ -1,0 +1,143 @@
+"""Conv: jax-vs-numpy cross-validation + numeric gradient checks.
+
+Reference pattern: tests/unit/test_conv.py + gd_numdiff harness
+(tests/unit/test_gd_conv.py) — numpy is the executable spec, float64
+numdiff validates the analytic gradients.
+"""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.backends import NumpyDevice, JaxDevice
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.ops import conv as conv_ops
+from znicz_tpu.units import conv as conv_units
+from znicz_tpu.units import gd_conv
+
+GEOMS = [
+    # (sy, sx, c, k, ky, kx, padding, sliding)
+    (6, 7, 3, 4, 3, 3, (0, 0, 0, 0), (1, 1)),
+    (8, 8, 2, 5, 3, 3, (1, 1, 1, 1), (2, 2)),
+    (7, 6, 1, 2, 2, 4, (2, 1, 0, 3), (1, 2)),
+]
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+@pytest.mark.parametrize("activation", ["linear", "tanh", "strict_relu"])
+def test_forward_jax_matches_numpy(geom, activation):
+    sy, sx, c, k, ky, kx, padding, sliding = geom
+    r = numpy.random.RandomState(3)
+    x = r.uniform(-1, 1, (3, sy, sx, c)).astype(numpy.float32)
+    w = r.uniform(-1, 1, (k, ky * kx * c)).astype(numpy.float32)
+    b = r.uniform(-1, 1, (k,)).astype(numpy.float32)
+    yn = conv_ops.forward_numpy(x, w, b, ky, kx, padding, sliding,
+                                activation=activation)
+    yj = conv_ops.forward_jax(x, w, b, ky, kx, padding, sliding,
+                              activation=activation)
+    assert numpy.abs(yn - numpy.asarray(yj)).max() < 1e-4
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_backward_jax_matches_numpy(geom):
+    sy, sx, c, k, ky, kx, padding, sliding = geom
+    r = numpy.random.RandomState(4)
+    x = r.uniform(-1, 1, (3, sy, sx, c)).astype(numpy.float64)
+    w = r.uniform(-1, 1, (k, ky * kx * c)).astype(numpy.float64)
+    ny, nx = conv_ops.output_spatial(sy, sx, ky, kx, padding, sliding)
+    err = r.uniform(-1, 1, (3, ny, nx, k)).astype(numpy.float64)
+    en, gwn, gbn = conv_ops.backward_numpy(x, err, w, ky, kx, padding,
+                                           sliding)
+    ej, gwj, gbj = conv_ops.backward_jax(x, err, w, ky, kx, padding, sliding)
+    assert numpy.abs(en - numpy.asarray(ej)).max() < 1e-8
+    assert numpy.abs(gwn - numpy.asarray(gwj)).max() < 1e-8
+    assert numpy.abs(gbn - numpy.asarray(gbj)).max() < 1e-8
+
+
+def test_backward_matches_numdiff():
+    """Five-point numeric differentiation of sum-of-squares loss through
+    the conv (float64) — validates grad_w, grad_b and err_input."""
+    sy, sx, c, k, ky, kx = 5, 5, 2, 3, 3, 3
+    padding, sliding = (1, 0, 1, 2), (2, 1)
+    r = numpy.random.RandomState(5)
+    x = r.uniform(-1, 1, (2, sy, sx, c))
+    w = r.uniform(-1, 1, (k, ky * kx * c))
+    b = r.uniform(-1, 1, (k,))
+
+    def loss():
+        y = conv_ops.forward_numpy(x, w, b, ky, kx, padding, sliding)
+        return 0.5 * (y ** 2).sum()
+
+    y = conv_ops.forward_numpy(x, w, b, ky, kx, padding, sliding)
+    err_in, gw, gb = conv_ops.backward_numpy(x, y, w, ky, kx, padding,
+                                             sliding)
+
+    h = 1e-5
+    coeffs = numpy.array([-1.0, 8.0, -8.0, 1.0]) / (12.0 * h)
+    points = (2 * h, h, -h, -2 * h)
+
+    def numdiff(arr):
+        g = numpy.zeros_like(arr)
+        flat, gf = arr.reshape(-1), g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            vals = []
+            for d in points:
+                flat[i] = orig + d
+                vals.append(loss())
+            flat[i] = orig
+            gf[i] = (numpy.array(vals) * coeffs).sum()
+        return g
+
+    assert numpy.abs(numdiff(w) - gw).max() < 1e-5
+    assert numpy.abs(numdiff(b) - gb).max() < 1e-5
+    assert numpy.abs(numdiff(x) - err_in).max() < 1e-5
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, JaxDevice])
+def test_conv_unit_roundtrip(device_cls):
+    """Conv + GradientDescentConv units wired the workflow way."""
+    device = device_cls()
+    r = numpy.random.RandomState(7)
+    x = r.uniform(-1, 1, (2, 6, 6, 2)).astype(numpy.float64)
+
+    wf = DummyWorkflow()
+    fwd = conv_units.ConvTanh(wf, n_kernels=3, kx=3, ky=3,
+                              padding=(1, 1, 1, 1), sliding=(2, 2),
+                              weights_stddev=0.1, bias_stddev=0.1)
+    fwd.rand = prng.RandomGenerator().seed(9)
+    fwd.input = Array(x.copy())
+    fwd.link_from(wf.start_point)
+    fwd.initialize(device=device)
+    fwd.run()
+    assert fwd.output.shape == (2, 3, 3, 3)
+
+    err = r.uniform(-0.1, 0.1, fwd.output.shape).astype(numpy.float64)
+    bwd = gd_conv.GDTanhConv(wf, learning_rate=0.1, weights_decay=0.0)
+    bwd.err_output = Array(err.copy())
+    bwd.link_attrs(fwd, "output", "input", "weights", "bias",
+                   "n_kernels", "kx", "ky", "padding", "sliding")
+    bwd.initialize(device=device)
+    w_before = numpy.array(fwd.weights.mem)
+    bwd.run()
+    assert bwd.err_input.shape == x.shape
+    assert numpy.abs(fwd.weights.mem - w_before).max() > 0
+
+
+def test_conv_unit_jax_matches_numpy():
+    outs = {}
+    for device in (NumpyDevice(), JaxDevice()):
+        r = numpy.random.RandomState(7)
+        x = r.uniform(-1, 1, (2, 6, 6, 2)).astype(numpy.float32)
+        wf = DummyWorkflow()
+        fwd = conv_units.ConvStrictRELU(
+            wf, n_kernels=4, kx=3, ky=3, weights_stddev=0.1,
+            bias_stddev=0.1)
+        fwd.rand = prng.RandomGenerator().seed(11)
+        fwd.input = Array(x.copy())
+        fwd.link_from(wf.start_point)
+        fwd.initialize(device=device)
+        fwd.run()
+        outs[device.backend_name] = numpy.array(fwd.output.mem)
+    assert numpy.abs(outs["numpy"] - outs["jax"]).max() < 1e-4

@@ -1,0 +1,140 @@
+"""Pooling: jax-vs-numpy cross-validation incl. ceil-mode overhang windows,
+offset parity, and backward scatter checks (reference tests/unit/
+test_pooling.py pattern)."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.backends import NumpyDevice, JaxDevice
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.ops import pooling as pool_ops
+from znicz_tpu.units import pooling as pool_units
+from znicz_tpu.units import gd_pooling
+
+GEOMS = [
+    # (sy, sx, c, ky, kx, sliding) — second has overhanging windows
+    (6, 6, 3, 2, 2, (2, 2)),
+    (5, 7, 2, 3, 2, (2, 3)),
+    (4, 4, 1, 3, 3, (3, 3)),
+]
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+@pytest.mark.parametrize("use_abs", [False, True])
+def test_max_pooling_jax_matches_numpy(geom, use_abs):
+    sy, sx, c, ky, kx, sliding = geom
+    r = numpy.random.RandomState(1)
+    x = r.uniform(-1, 1, (3, sy, sx, c)).astype(numpy.float32)
+    on, offn = pool_ops.max_pooling_numpy(x, ky, kx, sliding, use_abs)
+    oj, offj = pool_ops.max_pooling_jax(x, ky, kx, sliding, use_abs)
+    assert numpy.abs(on - numpy.asarray(oj)).max() == 0
+    assert (offn == numpy.asarray(offj)).all()
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_avg_pooling_jax_matches_numpy(geom):
+    sy, sx, c, ky, kx, sliding = geom
+    r = numpy.random.RandomState(2)
+    x = r.uniform(-1, 1, (3, sy, sx, c)).astype(numpy.float64)
+    on = pool_ops.avg_pooling_numpy(x, ky, kx, sliding)
+    oj = pool_ops.avg_pooling_jax(x, ky, kx, sliding)
+    assert numpy.abs(on - numpy.asarray(oj)).max() < 1e-12
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+@pytest.mark.parametrize("use_abs", [False, True])
+def test_stochastic_pooling_jax_matches_numpy(geom, use_abs):
+    sy, sx, c, ky, kx, sliding = geom
+    r = numpy.random.RandomState(3)
+    x = r.uniform(-1, 1, (2, sy, sx, c)).astype(numpy.float64)
+    ny, nx = pool_ops.output_spatial(sy, sx, ky, kx, sliding)
+    rand = r.randint(0, 1 << 16, 2 * ny * nx * c).astype(numpy.uint16)
+    on, offn = pool_ops.stochastic_pooling_numpy(x, rand, ky, kx, sliding,
+                                                 use_abs)
+    oj, offj = pool_ops.stochastic_pooling_jax(x, rand, ky, kx, sliding,
+                                               use_abs)
+    assert (offn == numpy.asarray(offj)).all()
+    assert numpy.abs(on - numpy.asarray(oj)).max() == 0
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_max_backward_scatter(geom):
+    sy, sx, c, ky, kx, sliding = geom
+    r = numpy.random.RandomState(4)
+    x = r.uniform(-1, 1, (2, sy, sx, c)).astype(numpy.float64)
+    _, offs = pool_ops.max_pooling_numpy(x, ky, kx, sliding)
+    err = r.uniform(-1, 1, offs.shape).astype(numpy.float64)
+    en = pool_ops.max_pooling_backward_numpy(err, offs, x.shape)
+    ej = pool_ops.max_pooling_backward_jax(err, offs, x.size, x.shape)
+    assert numpy.abs(en - numpy.asarray(ej)).max() < 1e-12
+    assert abs(en.sum() - err.sum()) < 1e-9  # scatter conserves mass
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_avg_backward_matches_vjp_and_numpy(geom):
+    sy, sx, c, ky, kx, sliding = geom
+    r = numpy.random.RandomState(5)
+    x = r.uniform(-1, 1, (2, sy, sx, c)).astype(numpy.float64)
+    out = pool_ops.avg_pooling_numpy(x, ky, kx, sliding)
+    err = r.uniform(-1, 1, out.shape).astype(numpy.float64)
+    en = pool_ops.avg_pooling_backward_numpy(err, ky, kx, sliding, x.shape)
+    ej = pool_ops.avg_pooling_backward_jax(err, ky, kx, sliding, x.shape)
+    assert numpy.abs(en - numpy.asarray(ej)).max() < 1e-12
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, JaxDevice])
+def test_pooling_units_graph(device_cls):
+    """MaxPooling + GDMaxPooling and AvgPooling + GDAvgPooling units."""
+    device = device_cls()
+    r = numpy.random.RandomState(6)
+    x = r.uniform(-1, 1, (2, 5, 5, 2)).astype(numpy.float64)
+
+    wf = DummyWorkflow()
+    fwd = pool_units.MaxPooling(wf, kx=2, ky=2)
+    fwd.input = Array(x.copy())
+    fwd.link_from(wf.start_point)
+    fwd.initialize(device=device)
+    fwd.run()
+    assert fwd.output.shape == (2, 3, 3, 2)
+
+    err = r.uniform(-1, 1, fwd.output.shape).astype(numpy.float64)
+    bwd = gd_pooling.GDMaxPooling(wf)
+    bwd.err_output = Array(err.copy())
+    bwd.link_attrs(fwd, "input", "input_offset", "kx", "ky", "sliding")
+    bwd.initialize(device=device)
+    bwd.run()
+    assert bwd.err_input.shape == x.shape
+    assert abs(numpy.asarray(bwd.err_input.mem).sum() - err.sum()) < 1e-9
+
+    fwd2 = pool_units.AvgPooling(wf, kx=3, ky=3, sliding=(2, 2))
+    fwd2.input = Array(x.copy())
+    fwd2.link_from(wf.start_point)
+    fwd2.initialize(device=device)
+    fwd2.run()
+    bwd2 = gd_pooling.GDAvgPooling(wf)
+    err2 = r.uniform(-1, 1, fwd2.output.shape).astype(numpy.float64)
+    bwd2.err_output = Array(err2.copy())
+    bwd2.link_attrs(fwd2, "input", "kx", "ky", "sliding")
+    bwd2.initialize(device=device)
+    bwd2.run()
+    assert bwd2.err_input.shape == x.shape
+
+
+def test_stochastic_units_same_seed_same_result():
+    outs = {}
+    for device in (NumpyDevice(), JaxDevice()):
+        r = numpy.random.RandomState(7)
+        x = r.uniform(-1, 1, (2, 4, 4, 2)).astype(numpy.float64)
+        wf = DummyWorkflow()
+        fwd = pool_units.StochasticPooling(
+            wf, kx=2, ky=2, uniform=prng.RandomGenerator().seed(21))
+        fwd.input = Array(x.copy())
+        fwd.link_from(wf.start_point)
+        fwd.initialize(device=device)
+        fwd.run()
+        outs[device.backend_name] = (numpy.array(fwd.output.mem),
+                                     numpy.array(fwd.input_offset.mem))
+    assert (outs["numpy"][1] == outs["jax"][1]).all()
+    assert numpy.abs(outs["numpy"][0] - outs["jax"][0]).max() == 0

@@ -20,6 +20,11 @@ TANH_B = 0.6666
 TANH_DA = 1.14381894     # A * B
 TANH_DB = -0.388484177   # -(B / A)
 
+# TanhLog hybrid constants (reference activation.py:525-532)
+TANHLOG_D = 3
+TANHLOG_A = 0.242528761112
+TANHLOG_B = 305.459953195
+
 
 # -- jax twins --------------------------------------------------------------
 
@@ -35,6 +40,64 @@ def apply_jax(name, x):
     if name == "sigmoid":
         return 1.0 / (1.0 + jnp.exp(-x))
     raise ValueError("unknown activation %r" % name)
+
+
+def _ext_apply(xp, name, x):
+    """Standalone-unit activations (reference activation.py:477-626).
+
+    ``log``/``sincos``/``tanhlog`` exist only as standalone units, not as
+    fused layer epilogues.
+    """
+    if name == "log":
+        return xp.log(x + xp.sqrt(xp.square(x) + 1))
+    if name == "tanhlog":
+        return xp.where(
+            x > TANHLOG_D, xp.log(xp.abs(x) * TANHLOG_B + 1e-30) * TANHLOG_A,
+            xp.where(x < -TANHLOG_D,
+                     -xp.log(xp.abs(x) * TANHLOG_B + 1e-30) * TANHLOG_A,
+                     TANH_A * xp.tanh(TANH_B * x)))
+    if name == "sincos":
+        flat = x.reshape(-1)
+        idx = numpy.arange(flat.shape[0]) if xp is numpy \
+            else jnp.arange(flat.shape[0])
+        out = xp.where(idx % 2 == 1, xp.sin(flat), xp.cos(flat))
+        return out.reshape(x.shape)
+    raise ValueError("unknown activation %r" % name)
+
+
+def _ext_derivative(xp, name, x, y):
+    """d/dx of the standalone activations, from input x (and output y for
+    tanhlog) — reference backward formulas (activation.py:499-626)."""
+    if name == "log":
+        return 1.0 / xp.sqrt(xp.square(x) + 1)
+    if name == "tanhlog":
+        return xp.where(
+            x > TANHLOG_D, TANHLOG_A / x,
+            xp.where(x < -TANHLOG_D, -TANHLOG_A / x,
+                     xp.square(y) * TANH_DB + TANH_DA))
+    if name == "sincos":
+        flat = x.reshape(-1)
+        idx = numpy.arange(flat.shape[0]) if xp is numpy \
+            else jnp.arange(flat.shape[0])
+        d = xp.where(idx % 2 == 1, xp.cos(flat), -xp.sin(flat))
+        return d.reshape(x.shape)
+    raise ValueError("unknown activation %r" % name)
+
+
+def ext_apply_jax(name, x):
+    return _ext_apply(jnp, name, x)
+
+
+def ext_apply_numpy(name, x):
+    return _ext_apply(numpy, name, x)
+
+
+def ext_derivative_jax(name, x, y):
+    return _ext_derivative(jnp, name, x, y)
+
+
+def ext_derivative_numpy(name, x, y):
+    return _ext_derivative(numpy, name, x, y)
 
 
 def derivative_jax(name, y):

@@ -1,0 +1,134 @@
+"""Convolution ops.
+
+TPU-era equivalent of the reference conv kernel stack (conv.py:185-313:
+im2col ``Unpack1D`` + GEMM + bias/activation kernel; gd_conv.py:313-452:
+col2im scatter + GEMM).  On TPU the forward lowers to
+``lax.conv_general_dilated`` — XLA picks the im2col-equivalent internally
+and tiles it onto the MXU (SURVEY.md §7: do not port Unpack1D) — and the
+backward comes from ``jax.vjp`` of that same forward, which reproduces the
+reference's hand-written col2im/GEMM math exactly.
+
+Geometry (reference conv.py:57-140):
+* layout NHWC — ``input`` (batch, sy, sx, n_channels);
+* ``weights`` (n_kernels, ky*kx*n_channels), flattened from (ky, kx, C);
+* ``padding`` (left, top, right, bottom) — zero padding;
+* ``sliding`` (x, y) strides;
+* output (batch, ny, nx, n_kernels) with
+  ``nx = (left + sx + right - kx) // sliding[0] + 1`` (same for y).
+"""
+
+from functools import partial
+
+import numpy
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from znicz_tpu.ops import activations
+
+
+def output_spatial(sy, sx, ky, kx, padding, sliding):
+    left, top, right, bottom = padding
+    nx = (left + sx + right - kx) // sliding[0] + 1
+    ny = (top + sy + bottom - ky) // sliding[1] + 1
+    return ny, nx
+
+
+def _conv_linear_jax(x, w, padding, sliding):
+    """x NHWC, w (K, ky*kx*C) -> (B, ny, nx, K), no bias/activation."""
+    k, ky, kx, c = w.shape
+    left, top, right, bottom = padding
+    dn = lax.conv_dimension_numbers(x.shape, (ky, kx, c, k),
+                                    ("NHWC", "HWIO", "NHWC"))
+    return lax.conv_general_dilated(
+        x, jnp.transpose(w, (1, 2, 3, 0)),
+        window_strides=(sliding[1], sliding[0]),
+        padding=((top, bottom), (left, right)),
+        dimension_numbers=dn)
+
+
+def _w4(weights, ky, kx, n_channels):
+    return weights.reshape(weights.shape[0], ky, kx, n_channels)
+
+
+@partial(jax.jit, static_argnames=("ky", "kx", "padding", "sliding",
+                                   "activation", "include_bias"))
+def forward_jax(x, weights, bias, ky, kx, padding, sliding,
+                activation="linear", include_bias=True):
+    w4 = _w4(weights, ky, kx, x.shape[3])
+    y = _conv_linear_jax(x, w4, padding, sliding)
+    if include_bias:
+        y = y + bias
+    return activations.apply_jax(activation, y)
+
+
+@partial(jax.jit, static_argnames=("ky", "kx", "padding", "sliding",
+                                   "need_err_input", "include_bias"))
+def backward_jax(inp, err_output, weights, ky, kx, padding, sliding,
+                 need_err_input=True, include_bias=True):
+    """Returns (err_input, gradient_weights, gradient_bias).
+
+    The VJP of the linear conv reproduces the reference col2im scatter
+    (gd_conv.py:313-378) and im2col weights-gradient GEMM (379-452).
+    """
+    w4 = _w4(weights, ky, kx, inp.shape[3])
+    _, vjp = jax.vjp(
+        lambda x, w: _conv_linear_jax(x, w, padding, sliding), inp, w4)
+    gx, gw4 = vjp(err_output)
+    grad_w = gw4.reshape(weights.shape)
+    grad_b = err_output.sum(axis=(0, 1, 2)) if include_bias else None
+    return (gx if need_err_input else None), grad_w, grad_b
+
+
+# -- numpy twins (the executable spec) --------------------------------------
+
+def _pad_numpy(x, padding):
+    left, top, right, bottom = padding
+    return numpy.pad(x, ((0, 0), (top, bottom), (left, right), (0, 0)))
+
+
+def _patches_numpy(xp, ky, kx, sliding, ny, nx):
+    """im2col: (B, ny, nx, ky*kx*C) from the padded input."""
+    b, _, _, c = xp.shape
+    out = numpy.empty((b, ny, nx, ky * kx * c), dtype=xp.dtype)
+    for i in range(ny):
+        y1 = i * sliding[1]
+        for j in range(nx):
+            x1 = j * sliding[0]
+            out[:, i, j, :] = xp[:, y1:y1 + ky, x1:x1 + kx, :].reshape(b, -1)
+    return out
+
+
+def forward_numpy(x, weights, bias, ky, kx, padding, sliding,
+                  activation="linear", include_bias=True):
+    ny, nx = output_spatial(x.shape[1], x.shape[2], ky, kx, padding, sliding)
+    xp = _pad_numpy(x, padding)
+    patches = _patches_numpy(xp, ky, kx, sliding, ny, nx)
+    y = patches @ weights.T
+    if include_bias:
+        y = y + bias
+    return activations.apply_numpy(activation, y)
+
+
+def backward_numpy(inp, err_output, weights, ky, kx, padding, sliding,
+                   need_err_input=True, include_bias=True):
+    b, sy, sx, c = inp.shape
+    ny, nx = err_output.shape[1], err_output.shape[2]
+    left, top = padding[0], padding[1]
+    xp = _pad_numpy(inp, padding)
+    patches = _patches_numpy(xp, ky, kx, sliding, ny, nx)
+    e2 = err_output.reshape(-1, err_output.shape[3])
+    grad_w = e2.T @ patches.reshape(-1, patches.shape[3])
+    grad_b = err_output.sum(axis=(0, 1, 2)) if include_bias else None
+    err_input = None
+    if need_err_input:
+        gxp = numpy.zeros_like(xp)
+        contrib = err_output @ weights  # (B, ny, nx, ky*kx*C)
+        for i in range(ny):
+            y1 = i * sliding[1]
+            for j in range(nx):
+                x1 = j * sliding[0]
+                gxp[:, y1:y1 + ky, x1:x1 + kx, :] += \
+                    contrib[:, i, j, :].reshape(b, ky, kx, c)
+        err_input = gxp[:, top:top + sy, left:left + sx, :]
+    return err_input, grad_w, grad_b

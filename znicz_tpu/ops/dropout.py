@@ -1,0 +1,29 @@
+"""Dropout mask generation and application.
+
+Reference dropout.py:84-190: ``mask = ceil(max(U(-ratio, 1-ratio), 0)) /
+(1-ratio)`` — i.e. Bernoulli(keep=1-ratio) scaled by 1/(1-ratio) —
+regenerated each TRAIN minibatch; forward multiplies, backward multiplies
+``err`` by the same mask; testing/validation passes through unchanged.
+"""
+
+import numpy
+import jax
+
+
+def mask_from_uniform(u, dropout_ratio, dtype):
+    """Build the mask from U(0,1) draws with the reference's formula
+    (dropout.py:147-153): exact same keep/drop decision boundary."""
+    xp = jax.numpy if not isinstance(u, numpy.ndarray) else numpy
+    leave_ratio = 1.0 - dropout_ratio
+    # U(-ratio, 1-ratio) = u * 1 - ratio; ceil(max(., 0)) -> {0, 1}
+    shifted = u - dropout_ratio
+    keep = (shifted > 0).astype(dtype)
+    return keep / xp.asarray(leave_ratio, dtype=dtype)
+
+
+def apply_jax(x, mask):
+    return x * mask
+
+
+def apply_numpy(x, mask):
+    return x * mask

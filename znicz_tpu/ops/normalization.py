@@ -1,0 +1,72 @@
+"""Local response normalization (AlexNet/Caffe cross-channel LRN).
+
+Reference normalization.py:49-287: with ``s_i = k + alpha *
+sum_{j in window(i)} x_j^2`` over the channel window ``[i-n//2, i+n//2]``,
+
+* forward:  ``y_i = x_i / s_i^beta``  (normalization.py:143-154)
+* backward: ``dL/dx_i = sum_{j in window(i)} (delta_ij * s_j
+  - 2 beta alpha x_i x_j) * err_j / s_j^(beta+1)``
+  (normalization.py:223-262)
+
+Defaults alpha=1e-4, beta=0.75, k=2, n=5.
+"""
+
+from functools import partial
+
+import numpy
+import jax
+import jax.numpy as jnp
+
+
+def _subsums_jax(x2, n):
+    """Windowed channel sums (reference _subsums, normalization.py:64-78)."""
+    c = x2.shape[3]
+    half = n // 2
+    padded = jnp.pad(x2, ((0, 0), (0, 0), (0, 0), (half, half)))
+    csum = jnp.cumsum(padded, axis=3)
+    csum = jnp.pad(csum, ((0, 0), (0, 0), (0, 0), (1, 0)))
+    upper = jnp.arange(c) + 2 * half + 1
+    lower = jnp.arange(c)
+    return csum[:, :, :, upper] - csum[:, :, :, lower]
+
+
+@partial(jax.jit, static_argnames=("alpha", "beta", "k", "n"))
+def lrn_forward_jax(x, alpha=1e-4, beta=0.75, k=2, n=5):
+    s = k + alpha * _subsums_jax(jnp.square(x), n)
+    return x / jnp.power(s, beta)
+
+
+@partial(jax.jit, static_argnames=("alpha", "beta", "k", "n"))
+def lrn_backward_jax(x, err_output, alpha=1e-4, beta=0.75, k=2, n=5):
+    s = k + alpha * _subsums_jax(jnp.square(x), n)
+    sp = jnp.power(s, beta + 1)
+    t = err_output / sp  # (B, H, W, C)
+    # err_i = s_i * t_i - 2 beta alpha x_i * window_sum_j(x_j t_j)
+    xt = _subsums_jax(x * t, n)
+    return s * t - 2.0 * beta * alpha * x * xt
+
+
+def _subsums_numpy(src, n):
+    c = src.shape[3]
+    out = numpy.empty_like(src)
+    half = n // 2
+    for i in range(c):
+        lo = max(0, i - half)
+        hi = min(i + half, c - 1)
+        out[:, :, :, i] = src[:, :, :, lo:hi + 1].sum(axis=3)
+    return out
+
+
+def lrn_forward_numpy(x, alpha=1e-4, beta=0.75, k=2, n=5):
+    s = k + alpha * _subsums_numpy(numpy.square(x), n)
+    return x / numpy.power(s, beta)
+
+
+def lrn_backward_numpy(x, err_output, alpha=1e-4, beta=0.75, k=2, n=5):
+    """Direct port of the reference double loop (normalization.py:223-262),
+    vectorized over the window offset."""
+    s = k + alpha * _subsums_numpy(numpy.square(x), n)
+    sp = numpy.power(s, beta + 1)
+    t = err_output / sp
+    xt = _subsums_numpy(x * t, n)
+    return s * t - 2.0 * beta * alpha * x * xt

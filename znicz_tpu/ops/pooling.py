@@ -1,0 +1,248 @@
+"""Pooling ops — max / maxabs / avg / stochastic, forward + backward.
+
+Reference semantics (pooling.py:67-548, gd_pooling.py:58-287):
+* layout NHWC; ``sliding`` (x, y); ceil-mode output size
+  ``out = ceil((s - k) / stride) + 1`` — windows may overhang the
+  right/bottom edge and are then truncated (pooling.py:96-105);
+* max/maxabs record ``input_offset``: the FLAT index into the input array
+  of the winning element (pooling.py:303-312); backward scatters
+  ``err_output`` additively to those offsets (gd_pooling.py:233-247);
+* avg divides by the TRUNCATED window size (pooling.py:548) and backward
+  spreads err/(window size) over the truncated window (gd_pooling.py:272);
+* stochastic pooling samples an element with probability proportional to
+  its (abs) value using a uint16 random stream (pooling.py:368-480);
+  samples uniformly when the window sums to zero.
+
+The jax paths build strided window views via advanced indexing (the
+patches are fused away by XLA) and use masked argmax/segment-sum —
+one jitted computation per op, no host round-trips.
+"""
+
+from functools import partial
+
+import numpy
+import jax
+import jax.numpy as jnp
+
+
+def output_spatial(sy, sx, ky, kx, sliding):
+    """Ceil-mode output geometry (reference pooling.py:96-105)."""
+    outs = []
+    for last, stride in ((sx - kx, sliding[0]), (sy - ky, sliding[1])):
+        o = last // stride + 1
+        if last % stride != 0:
+            o += 1
+        outs.append(o)
+    return outs[1], outs[0]  # ny, nx
+
+
+def _window_view_jax(x, ky, kx, sliding, fill):
+    """(B, ny, nx, ky*kx, C) window view + validity mask (ky*kx,) grids.
+
+    Overhanging cells are filled with ``fill`` and masked invalid.
+    """
+    b, sy, sx, c = x.shape
+    ny, nx = output_spatial(sy, sx, ky, kx, sliding)
+    # pad right/bottom so every window index is in range
+    pad_y = (ny - 1) * sliding[1] + ky - sy
+    pad_x = (nx - 1) * sliding[0] + kx - sx
+    xp = jnp.pad(x, ((0, 0), (0, pad_y), (0, pad_x), (0, 0)),
+                 constant_values=fill)
+    rows = (jnp.arange(ny) * sliding[1])[:, None] + jnp.arange(ky)[None, :]
+    cols = (jnp.arange(nx) * sliding[0])[:, None] + jnp.arange(kx)[None, :]
+    # (B, ny, ky, nx, kx, C) -> (B, ny, nx, ky, kx, C)
+    win = xp[:, rows[:, None, :, None], cols[None, :, None, :], :]
+    valid = ((rows < sy)[:, None, :, None] &
+             (cols < sx)[None, :, None, :])  # (ny, nx, ky, kx)
+    return (win.reshape(b, ny, nx, ky * kx, c),
+            valid.reshape(ny, nx, ky * kx), ny, nx)
+
+
+def _flat_offsets_jax(shape, ny, nx, ky, kx, sliding, q):
+    """Flat input index for window cell q (B, ny, nx, C) of each output."""
+    b, sy, sx, c = shape
+    dy, dx = q // kx, q % kx  # (B, ny, nx, C)
+    y = jnp.arange(ny).reshape(1, ny, 1, 1) * sliding[1] + dy
+    x = jnp.arange(nx).reshape(1, 1, nx, 1) * sliding[0] + dx
+    bi = jnp.arange(b).reshape(b, 1, 1, 1)
+    ci = jnp.arange(c).reshape(1, 1, 1, c)
+    return ((bi * sy + y) * sx + x) * c + ci
+
+
+@partial(jax.jit, static_argnames=("ky", "kx", "sliding", "use_abs"))
+def max_pooling_jax(x, ky, kx, sliding, use_abs=False):
+    """Returns (output, input_offset) — offsets are flat input indices."""
+    win, valid, ny, nx = _window_view_jax(x, ky, kx, sliding, 0.0)
+    key = jnp.abs(win) if use_abs else win
+    key = jnp.where(valid[None, :, :, :, None], key, -jnp.inf)
+    q = jnp.argmax(key, axis=3)  # (B, ny, nx, C) in (dy, dx) C-order
+    val = jnp.take_along_axis(win, q[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+    offs = _flat_offsets_jax(x.shape, ny, nx, ky, kx, sliding, q)
+    return val, offs.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("ky", "kx", "sliding"))
+def avg_pooling_jax(x, ky, kx, sliding):
+    win, valid, ny, nx = _window_view_jax(x, ky, kx, sliding, 0.0)
+    s = jnp.sum(win * valid[None, :, :, :, None], axis=3)
+    cnt = valid.sum(axis=2).astype(x.dtype)
+    return s / cnt[None, :, :, None]
+
+
+@partial(jax.jit, static_argnames=("ky", "kx", "sliding", "use_abs"))
+def stochastic_pooling_jax(x, rand_u16, ky, kx, sliding, use_abs=False):
+    """rand_u16: uint16 stream of size >= output size (row-major order).
+
+    Reference pooling.py:434-480: position = rnd * vsum / 65536 over the
+    running prefix of positive (abs) values; uniform window index when the
+    window sum is zero.
+    """
+    b, sy, sx, c = x.shape
+    win, valid, ny, nx = _window_view_jax(x, ky, kx, sliding, 0.0)
+    key = jnp.abs(win) if use_abs else jnp.maximum(win, 0.0)
+    key = key * valid[None, :, :, :, None]
+    vsum = key.sum(axis=3)  # (B, ny, nx, C)
+    rnd = rand_u16[:b * ny * nx * c].reshape(b, ny, nx, c).astype(x.dtype)
+    position = rnd * vsum / 65536.0
+    csum = jnp.cumsum(key, axis=3)
+    # first q with position <= csum[q] (and a positive contribution)
+    hit = position[:, :, :, None, :] <= csum
+    q_prop = jnp.argmax(hit, axis=3)
+    # zero-sum window: uniform index into the TRUNCATED window
+    # (reference indexes the truncated cut, pooling.py:437-440)
+    ty = jnp.minimum(ky, sy - jnp.arange(ny) * sliding[1]).reshape(
+        1, ny, 1, 1)
+    tx = jnp.minimum(kx, sx - jnp.arange(nx) * sliding[0]).reshape(
+        1, 1, nx, 1)
+    rnd32 = rand_u16[:b * ny * nx * c].reshape(b, ny, nx, c).astype(
+        jnp.uint32)
+    k_trunc = (rnd32 * (ty * tx).astype(jnp.uint32) >> 16).astype(jnp.int32)
+    q_unif = (k_trunc // tx) * kx + k_trunc % tx
+    q = jnp.where(vsum > 0, q_prop, q_unif)
+    val = jnp.take_along_axis(win, q[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+    offs = _flat_offsets_jax(x.shape, ny, nx, ky, kx, sliding, q)
+    return val, offs.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("input_size", "input_shape"))
+def max_pooling_backward_jax(err_output, input_offset, input_size,
+                             input_shape):
+    """Scatter-add err to the winning offsets (gd_pooling.py:233-247)."""
+    flat = jnp.zeros((input_size,), dtype=err_output.dtype)
+    flat = flat.at[input_offset.reshape(-1)].add(err_output.reshape(-1))
+    return flat.reshape(input_shape)
+
+
+@partial(jax.jit, static_argnames=("ky", "kx", "sliding", "input_shape"))
+def avg_pooling_backward_jax(err_output, ky, kx, sliding, input_shape):
+    """Spread err/(truncated window size) over each window
+    (gd_pooling.py:272-287) — via VJP of the forward average."""
+    zeros = jnp.zeros(input_shape, dtype=err_output.dtype)
+    _, vjp = jax.vjp(
+        lambda x: avg_pooling_jax(x, ky, kx, sliding), zeros)
+    return vjp(err_output)[0]
+
+
+# -- numpy twins (the executable spec) --------------------------------------
+
+def max_pooling_numpy(x, ky, kx, sliding, use_abs=False):
+    b, sy, sx, c = x.shape
+    ny, nx = output_spatial(sy, sx, ky, kx, sliding)
+    out = numpy.empty((b, ny, nx, c), dtype=x.dtype)
+    offs = numpy.empty((b, ny, nx, c), dtype=numpy.int32)
+    for bi in range(b):
+        for ci in range(c):
+            for i in range(ny):
+                y1 = i * sliding[1]
+                y2 = min(y1 + ky, sy)
+                for j in range(nx):
+                    x1 = j * sliding[0]
+                    x2 = min(x1 + kx, sx)
+                    cut = x[bi, y1:y2, x1:x2, ci]
+                    k = numpy.abs(cut).argmax() if use_abs else cut.argmax()
+                    di, dj = numpy.unravel_index(k, cut.shape)
+                    out[bi, i, j, ci] = cut[di, dj]
+                    offs[bi, i, j, ci] = numpy.ravel_multi_index(
+                        (bi, y1 + di, x1 + dj, ci), x.shape)
+    return out, offs
+
+
+def avg_pooling_numpy(x, ky, kx, sliding):
+    b, sy, sx, c = x.shape
+    ny, nx = output_spatial(sy, sx, ky, kx, sliding)
+    out = numpy.empty((b, ny, nx, c), dtype=x.dtype)
+    for i in range(ny):
+        y1 = i * sliding[1]
+        y2 = min(y1 + ky, sy)
+        for j in range(nx):
+            x1 = j * sliding[0]
+            x2 = min(x1 + kx, sx)
+            cut = x[:, y1:y2, x1:x2, :]
+            out[:, i, j, :] = cut.sum(axis=(1, 2)) / (
+                (y2 - y1) * (x2 - x1))
+    return out
+
+
+def stochastic_pooling_numpy(x, rand_u16, ky, kx, sliding, use_abs=False):
+    """Bit-exact port of the reference selection loop
+    (pooling.py:434-480)."""
+    b, sy, sx, c = x.shape
+    ny, nx = output_spatial(sy, sx, ky, kx, sliding)
+    out = numpy.empty((b, ny, nx, c), dtype=x.dtype)
+    offs = numpy.empty((b, ny, nx, c), dtype=numpy.int32)
+    oshape = (b, ny, nx, c)
+    for bi in range(b):
+        for i in range(ny):
+            y1 = i * sliding[1]
+            y2 = min(y1 + ky, sy)
+            for j in range(nx):
+                x1 = j * sliding[0]
+                x2 = min(x1 + kx, sx)
+                for ci in range(c):
+                    cut = x[bi, y1:y2, x1:x2, ci]
+                    index = numpy.ravel_multi_index((bi, i, j, ci), oshape)
+                    rnd = int(rand_u16[index])
+                    vals = cut.ravel()
+                    key = numpy.abs(vals) if use_abs else \
+                        numpy.where(vals > 0, vals, 0)
+                    vsum = key.sum()
+                    if vsum == 0:
+                        k = int(rnd * vals.size) >> 16
+                    else:
+                        position = rnd * vsum / 65536.0
+                        acc = 0.0
+                        k = vals.size - 1
+                        for t in range(vals.size):
+                            acc += key[t]
+                            if position <= acc:
+                                k = t
+                                break
+                    di, dj = numpy.unravel_index(k, cut.shape)
+                    out[bi, i, j, ci] = cut[di, dj]
+                    offs[bi, i, j, ci] = numpy.ravel_multi_index(
+                        (bi, y1 + di, x1 + dj, ci), x.shape)
+    return out, offs
+
+
+def max_pooling_backward_numpy(err_output, input_offset, input_shape):
+    err_input = numpy.zeros(input_shape, dtype=err_output.dtype)
+    flat = err_input.reshape(-1)
+    for err, off in numpy.nditer([err_output, input_offset]):
+        flat[off] += err
+    return err_input
+
+
+def avg_pooling_backward_numpy(err_output, ky, kx, sliding, input_shape):
+    b, sy, sx, c = input_shape
+    err_input = numpy.zeros(input_shape, dtype=err_output.dtype)
+    ny, nx = err_output.shape[1], err_output.shape[2]
+    for i in range(ny):
+        y1 = i * sliding[1]
+        y2 = min(y1 + ky, sy)
+        for j in range(nx):
+            x1 = j * sliding[0]
+            x2 = min(x1 + kx, sx)
+            err_input[:, y1:y2, x1:x2, :] += (
+                err_output[:, i:i + 1, j:j + 1, :] /
+                ((y2 - y1) * (x2 - x1)))
+    return err_input

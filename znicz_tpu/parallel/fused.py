@@ -124,7 +124,7 @@ def build_fc_specs(layers, input_sample_size, defaults=None):
         flags = dict(accumulate=bool(bwd.get("accumulate_gradient", False)),
                      apply=True,
                      solvers=frozenset(bwd.get("solvers", ())),
-                     ortho=bool(bwd.get("factor_ortho", 0)),
+                     ortho=bool(hyper["factor_ortho"]),
                      variant_moment=bwd.get("variant_moment_gradient", True))
         specs.append(FCSpec(
             type=tpe, n_in=n_in, n_out=n_out,
@@ -219,19 +219,39 @@ class FusedMLP:
                 "FusedMLP trains a softmax-CE objective; the last layer "
                 "must be type 'softmax' (got %r). Use the unit-graph path "
                 "for other heads." % self.specs[-1].type)
+        if any(s.is_softmax for s in self.specs[:-1]):
+            raise ValueError(
+                "softmax is only supported as the head of a FusedMLP")
         self.mesh = mesh
         params_host = init_params(self.specs, rand, dtype)
         states_host = init_opt_state(self.specs, params_host)
         self.params = self._place_params(params_host)
-        self.state = jax.tree.map(
-            lambda a: jax.device_put(a), states_host)
+        # state slots shard exactly like their parameter (vel mirrors w);
+        # mismatched initial placement would force a second full compile
+        # when the donated step returns GSPMD-sharded state.
+        self.state = self._place_state(states_host)
         # specs close over the traced functions (they carry dicts, so they
         # can't be hashable static args); hyperparameters bake in as XLA
         # constants.
         specs = tuple(self.specs)
-        self._step = jax.jit(
-            lambda p, s, x, l: _train_step(p, s, x, l, specs),
-            donate_argnums=(0, 1))
+        step_fn = lambda p, s, x, l: _train_step(p, s, x, l, specs)  # noqa
+        if mesh is not None:
+            # Pin output shardings to the input placements: GSPMD would
+            # otherwise return spec variants (P('model',) vs
+            # P('model', None)) that hash differently and force a second
+            # full compile of the donated step.
+            pshard = [{k: NamedSharding(mesh, self._param_spec(s, k))
+                       for k in p} for s, p in zip(self.specs, self.params)]
+            sshard = [{k: {kk: NamedSharding(mesh, self._param_spec(s, k))
+                           for kk in slots.keys()}
+                       for k, slots in st.items()}
+                      for s, st in zip(self.specs, self.state)]
+            mshard = {"loss": NamedSharding(mesh, P()),
+                      "n_err": NamedSharding(mesh, P())}
+            self._step = jax.jit(step_fn, donate_argnums=(0, 1),
+                                 out_shardings=(pshard, sshard, mshard))
+        else:
+            self._step = jax.jit(step_fn, donate_argnums=(0, 1))
         self._fwd = jax.jit(lambda p, x: forward(p, x, specs))
 
     # -- sharding -----------------------------------------------------------
@@ -253,6 +273,19 @@ class FusedMLP:
             for name, arr in p.items():
                 ns = NamedSharding(self.mesh, self._param_spec(spec, name))
                 q[name] = jax.device_put(arr, ns)
+            placed.append(q)
+        return placed
+
+    def _place_state(self, states_host):
+        if self.mesh is None:
+            return jax.tree.map(jax.device_put, states_host)
+        placed = []
+        for spec, st in zip(self.specs, states_host):
+            q = {}
+            for name, slots in st.items():
+                ns = NamedSharding(self.mesh, self._param_spec(spec, name))
+                q[name] = {k: jax.device_put(v, ns)
+                           for k, v in slots.items()}
             placed.append(q)
         return placed
 

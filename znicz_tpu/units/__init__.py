@@ -9,5 +9,12 @@ unused — exactly like the reference (standard_workflow_base.py:44-51).
 from znicz_tpu.units import nn_units  # noqa: F401
 from znicz_tpu.units import all2all  # noqa: F401
 from znicz_tpu.units import gd  # noqa: F401
+from znicz_tpu.units import conv  # noqa: F401
+from znicz_tpu.units import gd_conv  # noqa: F401
+from znicz_tpu.units import pooling  # noqa: F401
+from znicz_tpu.units import gd_pooling  # noqa: F401
+from znicz_tpu.units import activation  # noqa: F401
+from znicz_tpu.units import dropout  # noqa: F401
+from znicz_tpu.units import normalization  # noqa: F401
 from znicz_tpu.units import evaluator  # noqa: F401
 from znicz_tpu.units import decision  # noqa: F401

@@ -1,0 +1,259 @@
+"""Standalone activation units (forward + backward pairs).
+
+TPU-era equivalent of reference activation.py (626 LoC — SURVEY.md §2.2).
+Type strings: activation_tanh, activation_sigmoid, activation_mul,
+activation_relu, activation_str, activation_log, activation_tanhlog,
+activation_sincos.  ``Mul`` carries a learnable/auto-set scalar factor with
+its own master-slave protocol (reference activation.py:272-384).
+"""
+
+import numpy
+
+from znicz_tpu.units.nn_units import Forward, GradientDescentBase
+from znicz_tpu.ops import activations as act_ops
+
+
+class ActivationForward(Forward):
+    """Base forward: y = f(x) elementwise (reference activation.py:59-123).
+
+    ``kind``: "core" activations share the layer-epilogue implementations
+    (apply/derivative by output); "ext" ones (log/tanhlog/sincos) have their
+    own formulas with input-based derivatives.
+    """
+
+    MAPPING = set()
+    hide_from_registry = True
+    ACTIVATION = None
+    KIND = "core"
+
+    def __init__(self, workflow, **kwargs):
+        super(ActivationForward, self).__init__(workflow, **kwargs)
+        self.weights.reset()
+        self.bias.reset()
+        self.include_bias = False
+
+    def initialize(self, device=None, **kwargs):
+        super(ActivationForward, self).initialize(device=device, **kwargs)
+        if self.output:
+            assert self.output.shape[1:] == self.input.shape[1:]
+        if not self.output or self.output.shape[0] != self.input.shape[0]:
+            self.output.reset(numpy.zeros_like(self.input.mem))
+
+    def _apply_numpy(self, x):
+        if self.KIND == "core":
+            return act_ops.apply_numpy(self.ACTIVATION, x)
+        return act_ops.ext_apply_numpy(self.ACTIVATION, x)
+
+    def _apply_jax(self, x):
+        if self.KIND == "core":
+            return act_ops.apply_jax(self.ACTIVATION, x)
+        return act_ops.ext_apply_jax(self.ACTIVATION, x)
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self._apply_numpy(self.input.mem)
+
+    def jax_run(self):
+        self.output.set_dev(self._apply_jax(self.input.dev))
+
+
+class ActivationBackward(GradientDescentBase):
+    """Base backward: err_input = err_output * f'
+    (reference activation.py:126-216)."""
+
+    MAPPING = set()
+    hide_from_registry = True
+    ACTIVATION = None
+    KIND = "core"
+    NEEDS_INPUT = False  # ext activations differentiate via the input
+
+    def __init__(self, workflow, **kwargs):
+        super(ActivationBackward, self).__init__(workflow, **kwargs)
+        self.demand("output")
+        if self.NEEDS_INPUT:
+            self.demand("input")
+
+    def _derivative_numpy(self):
+        if self.KIND == "core":
+            return act_ops.derivative_numpy(self.ACTIVATION, self.output.mem)
+        return act_ops.ext_derivative_numpy(
+            self.ACTIVATION, self.input.mem,
+            self.output.mem if self.output else None)
+
+    def _derivative_jax(self):
+        if self.KIND == "core":
+            return act_ops.derivative_jax(self.ACTIVATION, self.output.dev)
+        return act_ops.ext_derivative_jax(
+            self.ACTIVATION, self.input.dev,
+            self.output.dev if self.output else None)
+
+    def numpy_run(self):
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        d = self._derivative_numpy()
+        self.err_input.mem[...] = self.err_output.mem * \
+            d.reshape(self.err_output.shape)
+
+    def jax_run(self):
+        d = self._derivative_jax()
+        self.err_input.set_dev(
+            self.err_output.dev * d.reshape(self.err_output.shape))
+
+
+class ForwardTanh(ActivationForward):
+    """y = 1.7159 tanh(0.6666 x) (reference activation.py:218-230)."""
+    MAPPING = {"activation_tanh"}
+    ACTIVATION = "tanh"
+
+
+class BackwardTanh(ActivationBackward):
+    MAPPING = {"activation_tanh"}
+    ACTIVATION = "tanh"
+
+
+class ForwardSigmoid(ActivationForward):
+    MAPPING = {"activation_sigmoid"}
+    ACTIVATION = "sigmoid"
+
+
+class BackwardSigmoid(ActivationBackward):
+    MAPPING = {"activation_sigmoid"}
+    ACTIVATION = "sigmoid"
+
+
+class ForwardRELU(ActivationForward):
+    """Softplus (reference activation.py:385-401)."""
+    MAPPING = {"activation_relu"}
+    ACTIVATION = "relu"
+
+
+class BackwardRELU(ActivationBackward):
+    MAPPING = {"activation_relu"}
+    ACTIVATION = "relu"
+
+
+class ForwardStrictRELU(ActivationForward):
+    """y = max(0, x) (reference activation.py:416-443)."""
+    MAPPING = {"activation_str"}
+    ACTIVATION = "strict_relu"
+
+
+class BackwardStrictRELU(ActivationBackward):
+    MAPPING = {"activation_str"}
+    ACTIVATION = "strict_relu"
+
+
+class ForwardLog(ActivationForward):
+    """y = log(x + sqrt(x^2+1)) (reference activation.py:477-497)."""
+    MAPPING = {"activation_log"}
+    ACTIVATION = "log"
+    KIND = "ext"
+
+
+class BackwardLog(ActivationBackward):
+    """f' = 1/sqrt(x^2+1) (reference activation.py:499-523)."""
+    MAPPING = {"activation_log"}
+    ACTIVATION = "log"
+    KIND = "ext"
+    NEEDS_INPUT = True
+
+
+class ForwardTanhLog(ActivationForward):
+    """Hybrid tanh/log (reference activation.py:525-551)."""
+    MAPPING = {"activation_tanhlog"}
+    ACTIVATION = "tanhlog"
+    KIND = "ext"
+
+
+class BackwardTanhLog(ActivationBackward):
+    MAPPING = {"activation_tanhlog"}
+    ACTIVATION = "tanhlog"
+    KIND = "ext"
+    NEEDS_INPUT = True
+
+
+class ForwardSinCos(ActivationForward):
+    """y = sin(x) at odd flat indices, cos(x) at even
+    (reference activation.py:589-607)."""
+    MAPPING = {"activation_sincos"}
+    ACTIVATION = "sincos"
+    KIND = "ext"
+
+
+class BackwardSinCos(ActivationBackward):
+    MAPPING = {"activation_sincos"}
+    ACTIVATION = "sincos"
+    KIND = "ext"
+    NEEDS_INPUT = True
+
+
+class ForwardMul(ActivationForward):
+    """y = k x with auto-set factor (reference activation.py:272-340)."""
+
+    MAPPING = {"activation_mul"}
+    ACTIVATION = "mul"
+
+    def __init__(self, workflow, **kwargs):
+        super(ForwardMul, self).__init__(workflow, **kwargs)
+        self._factor = kwargs.get("factor")
+
+    @property
+    def factor(self):
+        return self._factor
+
+    @factor.setter
+    def factor(self, value):
+        self._factor = None if value is None else float(value)
+
+    def run(self):
+        if self.factor is None:  # autoset from first minibatch
+            self.input.map_read()
+            mx = numpy.fabs(self.input.mem).max()
+            factor = 0.75 / mx if mx else 0.75
+            self.info("Autosetting factor to %f", factor)
+            self.factor = factor
+        return super(ForwardMul, self).run()
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self.input.mem * self.factor
+
+    def jax_run(self):
+        self.output.set_dev(self.input.dev * self.factor)
+
+    # master-slave factor protocol (reference activation.py:285-302)
+    def generate_data_for_slave(self, slave=None):
+        return self.factor
+
+    def apply_data_from_master(self, data):
+        if self.factor != data:
+            self.factor = data
+
+    def generate_data_for_master(self):
+        return self.factor
+
+    def apply_data_from_slave(self, data, slave=None):
+        if data is None:
+            return
+        self.factor = data if self.factor is None else min(self.factor, data)
+
+
+class BackwardMul(ActivationBackward):
+    """err_input = err_output * k (reference activation.py:342-383)."""
+
+    MAPPING = {"activation_mul"}
+    ACTIVATION = "mul"
+
+    def __init__(self, workflow, **kwargs):
+        super(BackwardMul, self).__init__(workflow, **kwargs)
+        self.factor = float(kwargs.get("factor", 1.0))
+
+    def numpy_run(self):
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = self.err_output.mem * self.factor
+
+    def jax_run(self):
+        self.err_input.set_dev(self.err_output.dev * self.factor)

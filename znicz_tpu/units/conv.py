@@ -1,0 +1,159 @@
+"""Convolutional forward units.
+
+TPU-era equivalent of reference conv.py (568 LoC — SURVEY.md §2.2).
+Type strings: conv, conv_tanh, conv_sigmoid, conv_relu, conv_str.
+Layout NHWC; weights (n_kernels, ky*kx*n_channels); padding LTRB;
+sliding (x, y) — see :mod:`znicz_tpu.ops.conv`.
+"""
+
+import numpy
+
+from znicz_tpu.units.nn_units import NNLayerBase
+from znicz_tpu.ops import conv as conv_ops
+
+
+class ConvolutionalBase(object):
+    """CONV_ATTRS carrier (reference conv.py:57-67)."""
+
+    CONV_ATTRS = ("n_kernels", "kx", "ky", "sliding", "padding",
+                  "unpack_size")
+
+    def link_conv_attrs(self, other):
+        self.link_attrs(other, *self.CONV_ATTRS)
+        return self
+
+
+class Conv(ConvolutionalBase, NNLayerBase):
+    """Convolution with linear activation (reference conv.py:71-475)."""
+
+    MAPPING = {"conv"}
+    ACTIVATION = "linear"
+    #: max activation value this layer's output can reasonably reach —
+    #: consumed by the NEXT conv layer's weight-magnitude heuristic
+    #: (reference sets output.max_supposed, conv.py:487,510,532,558).
+    OUTPUT_MAX_SUPPOSED = None  # linear: passes the input's through
+
+    def __init__(self, workflow, **kwargs):
+        super(Conv, self).__init__(workflow, **kwargs)
+        try:
+            self.n_kernels = kwargs["n_kernels"]
+            self.kx = kwargs["kx"]
+            self.ky = kwargs["ky"]
+        except KeyError:
+            raise KeyError("n_kernels, kx and ky are required parameters")
+        self.padding = tuple(kwargs.get("padding", (0, 0, 0, 0)))  # L T R B
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))  # X Y
+        # im2col staging quantum of the reference GPU path (conv.py:128);
+        # meaningless under XLA but part of the CONV_ATTRS contract.
+        self.unpack_size = kwargs.get("unpack_size", 16)
+        self.max_supposed = kwargs.get("input_max_supposed", 1.0)
+        self.exports.extend(("kx", "ky", "n_kernels", "padding", "sliding"))
+
+    @property
+    def output_max_supposed(self):
+        """What the next layer should use as input_max_supposed."""
+        return self.OUTPUT_MAX_SUPPOSED if self.OUTPUT_MAX_SUPPOSED \
+            is not None else self.max_supposed
+
+    def get_weights_magnitude(self):
+        """Reference conv.py:137-146."""
+        n_channels = self.input.shape[3]
+        vle = 1.0 / (self.max_supposed *
+                     numpy.sqrt(self.kx * self.ky * n_channels))
+        if self.weights_filling == "gaussian":
+            vle /= 3
+        return vle
+
+    def initialize(self, device=None, **kwargs):
+        super(Conv, self).initialize(device=device, **kwargs)
+        if len(self.input.shape) != 4:
+            raise ValueError("conv input must be NHWC, got shape %s"
+                             % (self.input.shape,))
+        if self.weights_stddev is None:
+            self.weights_stddev = min(self.get_weights_magnitude(), 0.05)
+        if self.bias_stddev is None:
+            self.bias_stddev = self.weights_stddev
+
+        n_channels = self.input.shape[3]
+        kernel_size = self.kx * self.ky * n_channels
+        if not self.weights:
+            w = numpy.zeros((self.n_kernels, kernel_size),
+                            dtype=self.input.dtype)
+            self.fill_array(self.weights_filling, w, self.weights_stddev)
+            if self.weights_transposed:
+                w = w.T.copy()
+            self.weights.reset(w)
+        if self.include_bias and not self.bias:
+            b = numpy.zeros(self.n_kernels, dtype=self.input.dtype)
+            self.fill_array(self.bias_filling, b, self.bias_stddev)
+            self.bias.reset(b)
+
+        ny, nx = conv_ops.output_spatial(
+            self.input.shape[1], self.input.shape[2], self.ky, self.kx,
+            self.padding, self.sliding)
+        out_shape = (self.input.shape[0], ny, nx, self.n_kernels)
+        if self.output:
+            assert self.output.shape[1:] == out_shape[1:]
+        if not self.output or self.output.shape[0] != out_shape[0]:
+            self.output.reset(numpy.zeros(out_shape, self.input.dtype))
+
+    @property
+    def _weights2d(self):
+        """(n_kernels, ky*kx*C) host view honoring weights_transposed."""
+        w = self.weights.mem
+        # True transpose (matching the jax path / cuBLAS transa semantics),
+        # not the reference numpy path's reshape_transposed reinterpretation
+        # (conv.py:335) which disagrees with its own GPU path.
+        return w.T if self.weights_transposed else w
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.weights.map_read()
+        if self.include_bias:
+            self.bias.map_read()
+        self.output.map_invalidate()
+        y = conv_ops.forward_numpy(
+            self.input.mem, self._weights2d,
+            self.bias.mem if self.include_bias else None,
+            self.ky, self.kx, self.padding, self.sliding,
+            activation=self.ACTIVATION, include_bias=self.include_bias)
+        self.output.mem[...] = y
+
+    def jax_run(self):
+        w = self.weights.dev
+        if self.weights_transposed:
+            w = w.T
+        y = conv_ops.forward_jax(
+            self.input.dev, w,
+            self.bias.dev if self.include_bias else None,
+            self.ky, self.kx, self.padding, self.sliding,
+            activation=self.ACTIVATION, include_bias=self.include_bias)
+        self.output.set_dev(y)
+
+
+class ConvTanh(Conv):
+    """f(x) = 1.7159 tanh(0.6666 x) (reference conv.py:478-497)."""
+    MAPPING = {"conv_tanh"}
+    ACTIVATION = "tanh"
+    OUTPUT_MAX_SUPPOSED = 1.7159
+
+
+class ConvSigmoid(Conv):
+    """f(x) = 1/(1+e^-x) (reference conv.py:500-519)."""
+    MAPPING = {"conv_sigmoid"}
+    ACTIVATION = "sigmoid"
+    OUTPUT_MAX_SUPPOSED = 1.0
+
+
+class ConvRELU(Conv):
+    """Softplus f(x) = log(1 + e^x) (reference conv.py:522-544)."""
+    MAPPING = {"conv_relu"}
+    ACTIVATION = "relu"
+    OUTPUT_MAX_SUPPOSED = 10.0
+
+
+class ConvStrictRELU(Conv):
+    """f(x) = max(x, 0) (reference conv.py:547-568, Caffe-style)."""
+    MAPPING = {"conv_str"}
+    ACTIVATION = "strict_relu"
+    OUTPUT_MAX_SUPPOSED = 10.0

@@ -1,0 +1,133 @@
+"""Dropout units.
+
+TPU-era equivalent of reference dropout.py (266 LoC — SURVEY.md §2.2).
+Forward multiplies by a Bernoulli(1-ratio)/(1-ratio) mask regenerated each
+TRAIN minibatch; VALID/TEST and forward_mode pass through.  Backward
+multiplies err by the saved mask.  The mask is drawn from the seeded host
+PRNG with the reference's exact formula (dropout.py:147-153) and uploaded —
+bit-identical across the numpy and jax paths for a given seed.
+"""
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.units.nn_units import Forward, GradientDescentBase
+
+
+class Dropout(object):
+    """dropout_ratio property carrier (reference dropout.py:55-81)."""
+
+    def init_ratio(self, kwargs):
+        self.dropout_ratio = kwargs.get("dropout_ratio")
+
+    @property
+    def dropout_ratio(self):
+        return self._dropout_ratio
+
+    @dropout_ratio.setter
+    def dropout_ratio(self, value):
+        if value is not None and not 0 < value < 1:
+            raise ValueError("dropout_ratio must be in (0, 1)")
+        self._dropout_ratio = value
+
+
+class DropoutForward(Dropout, Forward):
+    """(reference dropout.py:84-190)."""
+
+    MAPPING = {"dropout"}
+
+    def __init__(self, workflow, **kwargs):
+        super(DropoutForward, self).__init__(workflow, **kwargs)
+        self.init_ratio(kwargs)
+        self.mask = Array(name="mask")
+        self.rand = kwargs.get("rand", prng.get())
+        self.demand("minibatch_class")
+        # dropout has no weights/bias
+        self.weights.reset()
+        self.bias.reset()
+        self.include_bias = False
+
+    def initialize(self, device=None, **kwargs):
+        super(DropoutForward, self).initialize(device=device, **kwargs)
+        if self.dropout_ratio is None:
+            raise ValueError("dropout_ratio must be set")
+        self.mask.reset(numpy.zeros(self.input.shape,
+                                    dtype=self.input.dtype))
+        if self.output:
+            assert self.output.shape[1:] == self.input.shape[1:]
+        if not self.output or self.output.shape[0] != self.input.shape[0]:
+            self.output.reset(numpy.zeros_like(self.input.mem))
+
+    def calc_mask(self):
+        """Reference formula (dropout.py:147-153)."""
+        leave_ratio = 1.0 - self.dropout_ratio
+        self.mask.map_invalidate()
+        self.rand.fill(self.mask.mem, -self.dropout_ratio, leave_ratio)
+        numpy.maximum(self.mask.mem, 0, self.mask.mem)
+        numpy.ceil(self.mask.mem, self.mask.mem)
+        self.mask.mem[...] = self.mask.mem / leave_ratio
+
+    @property
+    def _active(self):
+        return not self.forward_mode and int(self.minibatch_class) == TRAIN
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        if self._active:
+            self.calc_mask()
+            self.output.mem[...] = self.input.mem * self.mask.mem
+        else:
+            self.output.mem[...] = self.input.mem
+
+    def jax_run(self):
+        if self._active:
+            self.calc_mask()
+            self.output.set_dev(self.input.dev * self.mask.dev)
+        else:
+            self.output.set_dev(self.input.dev)
+
+
+class DropoutBackward(Dropout, GradientDescentBase):
+    """(reference dropout.py:191-248)."""
+
+    MAPPING = {"dropout"}
+
+    def __init__(self, workflow, **kwargs):
+        super(DropoutBackward, self).__init__(workflow, **kwargs)
+        self.init_ratio(kwargs)
+        self.demand("mask", "minibatch_class")
+
+    @property
+    def _active(self):
+        return int(self.minibatch_class) == TRAIN
+
+    def numpy_run(self):
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        if self._active:
+            self.mask.map_read()
+            self.err_input.mem[...] = self.err_output.mem * self.mask.mem
+        else:
+            self.err_input.mem[...] = self.err_output.mem
+
+    def jax_run(self):
+        if self._active:
+            self.err_input.set_dev(self.err_output.dev * self.mask.dev)
+        else:
+            self.err_input.set_dev(self.err_output.dev)
+
+
+class DropoutFixer(object):
+    """Parity stub for reference DropoutFixer (dropout.py:250-266): sets
+    all DropoutForward units' forward_mode when switching to inference."""
+
+    def __init__(self, workflow):
+        self._workflow = workflow
+
+    def fix(self, forward_mode=True):
+        for unit in self._workflow.units:
+            if isinstance(unit, DropoutForward):
+                unit.forward_mode = forward_mode

@@ -1,0 +1,125 @@
+"""Convolutional backward (gradient-descent) units.
+
+TPU-era equivalent of reference gd_conv.py (750 LoC — SURVEY.md §2.3).
+Registered under the conv type strings.  The err_input col2im scatter and
+the im2col weights-gradient GEMM both come from the VJP of the forward conv
+(:func:`znicz_tpu.ops.conv.backward_jax`); the update algebra is the shared
+:mod:`znicz_tpu.ops.gd_math`.
+"""
+
+from znicz_tpu.units.conv import ConvolutionalBase
+from znicz_tpu.units.nn_units import (
+    GradientDescentBase, GradientDescentWithActivation)
+from znicz_tpu.ops import conv as conv_ops
+from znicz_tpu.ops import activations
+
+
+class GradientDescentConv(ConvolutionalBase, GradientDescentBase):
+    """Backward for Conv (reference gd_conv.py:60-644)."""
+
+    MAPPING = {"conv"}
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, **kwargs):
+        super(GradientDescentConv, self).__init__(workflow, **kwargs)
+        self.demand("weights", "n_kernels", "kx", "ky", "padding", "sliding")
+        if self.include_bias:
+            self.demand("bias")
+
+    def numpy_err_output_update(self):
+        if self.ACTIVATION == "linear":
+            return
+        self.err_output.map_write()
+        self.err_output.mem *= activations.derivative_numpy(
+            self.ACTIVATION,
+            self.output.mem.reshape(self.err_output.shape))
+
+    def jax_err_output_update(self):
+        if self.ACTIVATION == "linear":
+            return
+        d = activations.derivative_jax(
+            self.ACTIVATION, self.output.dev.reshape(self.err_output.shape))
+        self.err_output.set_dev(self.err_output.dev * d)
+
+    @property
+    def _weights2d(self):
+        w = self.weights.mem
+        # True transpose (matching the jax path / cuBLAS transa semantics),
+        # not the reference numpy path's reshape_transposed reinterpretation
+        # (conv.py:335) which disagrees with its own GPU path.
+        return w.T if self.weights_transposed else w
+
+    def numpy_run(self):
+        self.numpy_err_output_update()
+        self.input.map_read()
+        self.weights.map_read()
+        self.err_output.map_read()
+        err_in, grad_w, grad_b = conv_ops.backward_numpy(
+            self.input.mem, self.err_output.mem, self._weights2d,
+            self.ky, self.kx, self.padding, self.sliding,
+            need_err_input=self.need_err_input,
+            include_bias=self.include_bias and self.bias is not None)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            bp = err_in * self.err_input_alpha
+            if self.err_input_beta:
+                bp = bp + self.err_input_beta * self.err_input.mem
+            self.err_input.mem[...] = bp
+        if self.need_gradient_weights:
+            if self.weights_transposed:
+                grad_w = grad_w.T.reshape(self.weights.shape)
+            self.gradient_weights.map_write()
+            self.gradient_weights.mem[...] = grad_w
+            self._numpy_apply_update("weights")
+            if self.include_bias and self.bias:
+                self.gradient_bias.map_write()
+                self.gradient_bias.mem[...] = grad_b
+                self._numpy_apply_update("bias")
+
+    def jax_run(self):
+        self.jax_err_output_update()
+        w = self.weights.dev
+        if self.weights_transposed:
+            w = w.T
+        err_in, grad_w, grad_b = conv_ops.backward_jax(
+            self.input.dev, self.err_output.dev, w,
+            self.ky, self.kx, self.padding, self.sliding,
+            need_err_input=self.need_err_input,
+            include_bias=self.include_bias and self.bias is not None)
+        if self.need_err_input:
+            bp = err_in * self.err_input_alpha
+            if self.err_input_beta:
+                bp = bp + self.err_input_beta * self.err_input.dev
+            self.err_input.set_dev(bp)
+        if self.need_gradient_weights:
+            if self.weights_transposed:
+                grad_w = grad_w.T.reshape(self.weights.shape)
+            self.gradient_weights.set_dev(grad_w)
+            self._jax_apply_update("weights", grad_w)
+            if self.include_bias and self.bias:
+                self.gradient_bias.set_dev(grad_b)
+                self._jax_apply_update("bias", grad_b)
+
+
+class GDTanhConv(GradientDescentWithActivation, GradientDescentConv):
+    """f'(y) = 1.14381894 - 0.388484177 y^2 (reference gd_conv.py:645)."""
+    MAPPING = {"conv_tanh"}
+    ACTIVATION = "tanh"
+
+
+class GDSigmoidConv(GradientDescentWithActivation, GradientDescentConv):
+    """f'(y) = y (1 - y) (reference gd_conv.py:675)."""
+    MAPPING = {"conv_sigmoid"}
+    ACTIVATION = "sigmoid"
+
+
+class GDRELUConv(GradientDescentWithActivation, GradientDescentConv):
+    """f'(y) = 1 - e^-y (reference gd_conv.py:701)."""
+    MAPPING = {"conv_relu"}
+    ACTIVATION = "relu"
+
+
+class GDStrictRELUConv(GradientDescentWithActivation, GradientDescentConv):
+    """f'(y) = [y > 0] (reference gd_conv.py:726)."""
+    MAPPING = {"conv_str"}
+    ACTIVATION = "strict_relu"

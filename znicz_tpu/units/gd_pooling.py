@@ -1,0 +1,90 @@
+"""Pooling backward units.
+
+TPU-era equivalent of reference gd_pooling.py (287 LoC — SURVEY.md §2.3).
+Max variants scatter-add err_output to the recorded input offsets;
+avg spreads err/(truncated window size).
+"""
+
+import numpy
+
+from znicz_tpu.units.nn_units import GradientDescentBase
+from znicz_tpu.units.pooling import PoolingBase
+from znicz_tpu.ops import pooling as pool_ops
+
+
+class GDPooling(PoolingBase, GradientDescentBase):
+    """(reference gd_pooling.py:58-180)."""
+
+    MAPPING = set()
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(GDPooling, self).__init__(workflow, **kwargs)
+        self.kx = kwargs.get("kx")
+        self.ky = kwargs.get("ky")
+        self.sliding = kwargs.get("sliding")
+        if self.kx is None or self.ky is None:
+            self.demand("kx", "ky")
+        if self.sliding is None:
+            self.demand("sliding")
+
+    def initialize(self, device=None, **kwargs):
+        out_size = int(numpy.prod(self.output_shape))
+        if self.err_output.size != out_size:
+            raise ValueError(
+                "err_output size %d differs from the size computed from "
+                "kx/ky and input shape (%d)"
+                % (self.err_output.size, out_size))
+        super(GDPooling, self).initialize(device=device, **kwargs)
+
+
+class GDMaxPooling(GDPooling):
+    """Scatter err to recorded winners (reference gd_pooling.py:182-247)."""
+
+    MAPPING = {"max_pooling", "stochastic_pooling", "stochastic_pool_depool",
+               "stochastic_abs_pool_depool"}
+
+    def __init__(self, workflow, **kwargs):
+        super(GDMaxPooling, self).__init__(workflow, **kwargs)
+        self.demand("input_offset")
+
+    def initialize(self, device=None, **kwargs):
+        super(GDMaxPooling, self).initialize(device=device, **kwargs)
+        if self.err_output.size != self.input_offset.size:
+            raise ValueError("err_output size differs from input_offset's")
+
+    def numpy_run(self):
+        self.err_output.map_read()
+        self.input_offset.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = pool_ops.max_pooling_backward_numpy(
+            self.err_output.mem, self.input_offset.mem,
+            self.err_input.shape)
+
+    def jax_run(self):
+        self.err_input.set_dev(pool_ops.max_pooling_backward_jax(
+            self.err_output.dev, self.input_offset.dev,
+            int(numpy.prod(self.input.shape)), tuple(self.input.shape)))
+
+
+class GDMaxAbsPooling(GDMaxPooling):
+    """Same scatter as GDMaxPooling (reference gd_pooling.py:249-252)."""
+    MAPPING = {"maxabs_pooling", "stochastic_abs_pooling"}
+
+
+class GDAvgPooling(GDPooling):
+    """(reference gd_pooling.py:255-287)."""
+
+    MAPPING = {"avg_pooling"}
+
+    def numpy_run(self):
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = pool_ops.avg_pooling_backward_numpy(
+            self.err_output.mem, self.ky, self.kx, self.sliding,
+            self.err_input.shape)
+
+    def jax_run(self):
+        self.err_input.set_dev(pool_ops.avg_pooling_backward_jax(
+            self.err_output.dev, self.ky, self.kx, tuple(self.sliding),
+            tuple(self.input.shape)))

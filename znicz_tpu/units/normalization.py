@@ -1,0 +1,75 @@
+"""Local response normalization units (AlexNet/Caffe-style cross-channel).
+
+TPU-era equivalent of reference normalization.py (287 LoC — SURVEY.md §2.2).
+Type string: "norm".  Math in :mod:`znicz_tpu.ops.normalization`.
+"""
+
+import numpy
+
+from znicz_tpu.units.nn_units import Forward, GradientDescentBase
+from znicz_tpu.ops import normalization as lrn_ops
+
+
+class LRNParams(object):
+    def init_lrn(self, kwargs):
+        self.alpha = kwargs.get("alpha", 0.0001)
+        self.beta = kwargs.get("beta", 0.75)
+        self.k = kwargs.get("k", 2)
+        self.n = kwargs.get("n", 5)
+
+    @property
+    def _lrn_kwargs(self):
+        return dict(alpha=self.alpha, beta=self.beta, k=self.k, n=self.n)
+
+
+class LRNormalizerForward(LRNParams, Forward):
+    """(reference normalization.py:97-182)."""
+
+    MAPPING = {"norm"}
+
+    def __init__(self, workflow, **kwargs):
+        super(LRNormalizerForward, self).__init__(workflow, **kwargs)
+        self.init_lrn(kwargs)
+        self.weights.reset()
+        self.bias.reset()
+        self.include_bias = False
+
+    def initialize(self, device=None, **kwargs):
+        super(LRNormalizerForward, self).initialize(device=device, **kwargs)
+        if len(self.input.shape) != 4:
+            raise ValueError("LRN input must be NHWC")
+        if self.output:
+            assert self.output.shape[1:] == self.input.shape[1:]
+        if not self.output or self.output.shape[0] != self.input.shape[0]:
+            self.output.reset(numpy.zeros_like(self.input.mem))
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = lrn_ops.lrn_forward_numpy(
+            self.input.mem, **self._lrn_kwargs)
+
+    def jax_run(self):
+        self.output.set_dev(lrn_ops.lrn_forward_jax(
+            self.input.dev, **self._lrn_kwargs))
+
+
+class LRNormalizerBackward(LRNParams, GradientDescentBase):
+    """(reference normalization.py:184-287)."""
+
+    MAPPING = {"norm"}
+
+    def __init__(self, workflow, **kwargs):
+        super(LRNormalizerBackward, self).__init__(workflow, **kwargs)
+        self.init_lrn(kwargs)
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = lrn_ops.lrn_backward_numpy(
+            self.input.mem, self.err_output.mem, **self._lrn_kwargs)
+
+    def jax_run(self):
+        self.err_input.set_dev(lrn_ops.lrn_backward_jax(
+            self.input.dev, self.err_output.dev, **self._lrn_kwargs))

@@ -1,0 +1,210 @@
+"""Pooling forward units.
+
+TPU-era equivalent of reference pooling.py (548 LoC — SURVEY.md §2.2).
+Type strings: max_pooling, maxabs_pooling, stochastic_pooling,
+stochastic_abs_pooling, avg_pooling.  Geometry and offset semantics in
+:mod:`znicz_tpu.ops.pooling` (ceil-mode windows, flat input offsets).
+"""
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.units.nn_units import Forward
+from znicz_tpu.ops import pooling as pool_ops
+
+
+class PoolingBase(object):
+    """POOL_ATTRS carrier + geometry (reference pooling.py:67-117)."""
+
+    POOL_ATTRS = ("kx", "ky", "sliding")
+
+    @property
+    def input_batch_size(self):
+        return self.input.shape[0]
+
+    @property
+    def sy(self):
+        return self.input.shape[1]
+
+    @property
+    def sx(self):
+        return self.input.shape[2]
+
+    @property
+    def n_channels(self):
+        return self.input.size // (self.input_batch_size *
+                                   self.sx * self.sy)
+
+    @property
+    def out_sxy(self):
+        ny, nx = pool_ops.output_spatial(self.sy, self.sx, self.ky, self.kx,
+                                         self.sliding)
+        return nx, ny
+
+    @property
+    def out_sx(self):
+        return self.out_sxy[0]
+
+    @property
+    def out_sy(self):
+        return self.out_sxy[1]
+
+    @property
+    def output_shape(self):
+        return (self.input_batch_size, self.out_sy, self.out_sx,
+                self.n_channels)
+
+    def link_pool_attrs(self, other):
+        self.link_attrs(other, *self.POOL_ATTRS)
+        return self
+
+
+class Pooling(PoolingBase, Forward):
+    """Pooling forward base (reference pooling.py:122-246)."""
+
+    MAPPING = set()
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(Pooling, self).__init__(workflow, **kwargs)
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self.sliding = tuple(kwargs.get("sliding") or (self.kx, self.ky))
+        self.exports.extend(self.POOL_ATTRS)
+        # pooling has no weights/bias
+        self.weights.reset()
+        self.bias.reset()
+        self.include_bias = False
+
+    def initialize(self, device=None, **kwargs):
+        super(Pooling, self).initialize(device=device, **kwargs)
+        if len(self.input.shape) != 4:
+            raise ValueError("pooling input must be NHWC")
+        shape = self.output_shape
+        if self.output:
+            assert self.output.shape[1:] == shape[1:]
+        if not self.output or self.output.shape[0] != shape[0]:
+            self.output.reset(numpy.zeros(shape, self.input.dtype))
+
+    def generate_data_for_slave(self, slave=None):  # TriviallyDistributable
+        return None
+
+    def apply_data_from_master(self, data):
+        pass
+
+
+class OffsetPooling(Pooling):
+    """Records flat input offsets of passed-through elements
+    (reference pooling.py:249-312)."""
+
+    MAPPING = set()
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(OffsetPooling, self).__init__(workflow, **kwargs)
+        self.input_offset = Array(name="input_offset")
+
+    def initialize(self, device=None, **kwargs):
+        super(OffsetPooling, self).initialize(device=device, **kwargs)
+        if self.input_offset:
+            assert self.input_offset.shape[1:] == self.output.shape[1:]
+        if (not self.input_offset or
+                self.input_offset.shape[0] != self.output.shape[0]):
+            self.input_offset.reset(numpy.zeros(self.output.shape,
+                                                dtype=numpy.int32))
+
+
+class MaxPooling(OffsetPooling):
+    """(reference pooling.py:333-341)."""
+
+    MAPPING = {"max_pooling"}
+    USE_ABS = False
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.input_offset.map_invalidate()
+        out, offs = pool_ops.max_pooling_numpy(
+            self.input.mem, self.ky, self.kx, self.sliding,
+            use_abs=self.USE_ABS)
+        self.output.mem[...] = out
+        self.input_offset.mem[...] = offs
+
+    def jax_run(self):
+        out, offs = pool_ops.max_pooling_jax(
+            self.input.dev, self.ky, self.kx, self.sliding,
+            use_abs=self.USE_ABS)
+        self.output.set_dev(out)
+        self.input_offset.set_dev(offs)
+
+
+class MaxAbsPooling(MaxPooling):
+    """Winner is max |x|; passes the SIGNED value
+    (reference pooling.py:343-366)."""
+
+    MAPPING = {"maxabs_pooling"}
+    USE_ABS = True
+
+
+class StochasticPoolingBase(OffsetPooling):
+    """Samples proportionally to (abs) value using a uint16 stream from the
+    seeded PRNG (reference pooling.py:368-440)."""
+
+    MAPPING = set()
+    hide_from_registry = True
+    USE_ABS = False
+
+    def __init__(self, workflow, **kwargs):
+        super(StochasticPoolingBase, self).__init__(workflow, **kwargs)
+        self.uniform = kwargs.get("uniform") or prng.get()
+
+    def _rand_u16(self):
+        size = int(numpy.prod(self.output.shape))
+        return self.uniform.randint(0, 1 << 16, size=size,
+                                    dtype=numpy.uint16)
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.input_offset.map_invalidate()
+        out, offs = pool_ops.stochastic_pooling_numpy(
+            self.input.mem, self._rand_u16(), self.ky, self.kx,
+            self.sliding, use_abs=self.USE_ABS)
+        self.output.mem[...] = out
+        self.input_offset.mem[...] = offs
+
+    def jax_run(self):
+        # host-drawn randoms keep jax == numpy bit-wise for the same seed
+        out, offs = pool_ops.stochastic_pooling_jax(
+            self.input.dev, self._rand_u16(), self.ky, self.kx,
+            self.sliding, use_abs=self.USE_ABS)
+        self.output.set_dev(out)
+        self.input_offset.set_dev(offs)
+
+
+class StochasticPooling(StochasticPoolingBase):
+    """(reference pooling.py:443-460)."""
+    MAPPING = {"stochastic_pooling"}
+
+
+class StochasticAbsPooling(StochasticPoolingBase):
+    """(reference pooling.py:462-480)."""
+    MAPPING = {"stochastic_abs_pooling"}
+    USE_ABS = True
+
+
+class AvgPooling(Pooling):
+    """Mean over the (truncated) window (reference pooling.py:522-548)."""
+
+    MAPPING = {"avg_pooling"}
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = pool_ops.avg_pooling_numpy(
+            self.input.mem, self.ky, self.kx, self.sliding)
+
+    def jax_run(self):
+        self.output.set_dev(pool_ops.avg_pooling_jax(
+            self.input.dev, self.ky, self.kx, self.sliding))
