@@ -1,0 +1,120 @@
+"""MNIST functional tests via StandardWorkflow (reference pattern:
+tests/functional/test_mnist_all2all.py — train a few epochs, assert error,
+then resume from the snapshot and continue)."""
+
+import os
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import prng
+from znicz_tpu.core.snapshotter import SnapshotterToFile
+from znicz_tpu.units.nn_units import load_snapshot_into_workflow
+from znicz_tpu.samples import mnist
+
+LOADER_CFG = {"synthetic_train": 600, "synthetic_valid": 200,
+              "minibatch_size": 60}
+
+
+def _seed():
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+
+
+def test_mnist_mlp_trains_and_resumes(tmp_path):
+    _seed()
+    wf = mnist.run_sample(
+        loader_config=dict(LOADER_CFG),
+        decision_config={"max_epochs": 4, "fail_iterations": 20},
+        snapshotter_config={"prefix": "mnist-test", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": str(tmp_path)})
+    assert wf.loader.epoch_number == 4
+    # synthetic MNIST is easy: close to zero validation error in 4 epochs
+    assert wf.decision.best_n_err_pt[1] < 5.0
+    files = sorted(os.listdir(str(tmp_path)),
+                   key=lambda f: os.path.getmtime(
+                       os.path.join(str(tmp_path), f)))
+    assert files, "snapshotter produced no files"
+
+    # resume: rebuild, load the snapshot, train 2 more epochs
+    _seed()
+    wf2 = mnist.build(
+        loader_config=dict(LOADER_CFG),
+        decision_config={"max_epochs": 6, "fail_iterations": 20},
+        snapshotter_config={"prefix": "mnist-test2", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": str(tmp_path)})
+    wf2.initialize()
+    state = SnapshotterToFile.import_(
+        os.path.join(str(tmp_path), files[-1]))
+    load_snapshot_into_workflow(state, wf2)
+    w_loaded = numpy.array(wf2.forwards[0].weights.mem)
+    assert numpy.abs(w_loaded -
+                     numpy.asarray(wf.forwards[0].weights.mem)).max() < 1e-6
+    wf2.run()
+    assert wf2.decision.best_n_err_pt[1] < 5.0
+
+
+def test_mnist_conv_builds_correct_graph():
+    """LeNet-style conv topology constructs with the right shapes."""
+    _seed()
+    wf = mnist.build(
+        layers=root.mnistr_conv.layers,
+        loader_config={"synthetic_train": 120, "synthetic_valid": 60,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 1, "fail_iterations": 5})
+    wf.initialize()
+    shapes = [tuple(f.output.shape) for f in wf.forwards]
+    assert shapes[0] == (30, 24, 24, 64)    # conv1 5x5 on 28x28
+    assert shapes[1] == (30, 12, 12, 64)    # pool1
+    assert shapes[2] == (30, 8, 8, 87)      # conv2
+    assert shapes[3] == (30, 4, 4, 87)      # pool2
+    assert shapes[4] == (30, 791)           # fc_relu3
+    assert shapes[5] == (30, 10)            # softmax
+    assert len(wf.gds) == 6
+    assert wf.gds[0].need_err_input is False
+    wf.run()
+    assert wf.loader.epoch_number == 1
+
+
+def test_mcdnnic_topology_parser():
+    from znicz_tpu.standard_workflow_base import StandardWorkflowBase
+    wf = StandardWorkflowBase(
+        None, mcdnnic_topology="12x28x28-32C5-MP2-100N-10N",
+        preprocessing=True)
+    layers = wf.layers
+    assert layers[0] == {"type": "conv",
+                         "->": {"n_kernels": 32, "kx": 5, "ky": 5},
+                         "<-": {}}
+    assert layers[1] == {"type": "max_pooling",
+                         "->": {"kx": 2, "ky": 2}, "<-": {}}
+    assert layers[2]["type"] == "all2all"
+    assert layers[3]["type"] == "softmax"
+    kwargs = StandardWorkflowBase._update_loader_kwargs_from_mcdnnic(
+        {}, "12x28x28-32C5-MP2-100N-10N")
+    assert kwargs == {"minibatch_size": 12, "scale": (28, 28)}
+
+
+def test_softmax_width_autoset_from_loader():
+    """Head width comes from the loader's label count when the config
+    shape disagrees (reference standard_workflow_base.py:324-334)."""
+    _seed()
+    layers = [dict(l) for l in root.mnistr.layers]
+    layers[1] = dict(layers[1])
+    layers[1]["->"] = dict(layers[1]["->"], output_sample_shape=7)
+    wf = mnist.build(
+        layers=layers,
+        loader_config={"synthetic_train": 100, "synthetic_valid": 50,
+                       "minibatch_size": 25},
+        decision_config={"max_epochs": 1, "fail_iterations": 5})
+    wf.initialize()
+    assert wf.forwards[-1].output.shape == (25, 10)
+
+
+@pytest.mark.parametrize("loss", ["bogus"])
+def test_unknown_loss_rejected(loss):
+    with pytest.raises(ValueError):
+        mnist.build(loss_function=loss,
+                    loader_config=dict(LOADER_CFG))

@@ -77,6 +77,11 @@ class Config(object):
             out[k] = v.as_dict() if isinstance(v, Config) else v
         return out
 
+    @property
+    def __content__(self):
+        """Reference-compatible dict view (StandardWorkflowBase.dictify)."""
+        return self.as_dict()
+
     # -- presentation -------------------------------------------------------
     def __repr__(self):
         return "<Config %s: %s>" % (self._path_, sorted(self.__dict__))

@@ -119,9 +119,22 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         raise NotImplementedError
 
     # -- common ------------------------------------------------------------
+    #: optional hook called after load_data during initialize (reference:
+    #: real_loader.on_initialized, standard_workflow_base.py:334-336)
+    on_initialized = None
+
     @property
     def total_samples(self):
         return int(sum(self.class_lengths))
+
+    @property
+    def unique_labels_count(self):
+        """Number of distinct labels — sets the softmax head width
+        (reference standard_workflow_base.py:324-334)."""
+        labels = getattr(self, "original_labels", None)
+        if labels is not None and len(labels):
+            return len(set(labels))
+        raise AttributeError("loader cannot derive unique_labels_count")
 
     @property
     def effective_class_lengths(self):
@@ -166,6 +179,8 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self._segment = 0
         self._offset_in_class = 0
         self._global_offset = 0
+        if self.on_initialized is not None:
+            self.on_initialized()
         self.info(
             "%s: %d samples (test %d, validation %d, train %d), mb=%d",
             self.name, self.total_samples, self.class_lengths[TEST],

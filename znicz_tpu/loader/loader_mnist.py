@@ -1,0 +1,131 @@
+"""MNIST loader.
+
+TPU-era equivalent of reference samples/MNIST/loader_mnist.py (186 LoC) —
+parses the original IDX files (magic 2049/2051, big-endian headers) from
+``data_path``.  Dataset layout: [VALID 10000 | TRAIN 60000]
+(loader_mnist.py:163-183); pixels as float32, normalized by the loader's
+normalizer.
+
+**Deviation for the zero-egress environment:** the reference downloads from
+yann.lecun.com when files are missing (loader_mnist.py:77-107).  Here,
+``synthetic="auto"`` (default) falls back to a deterministic synthetic
+MNIST-like dataset — per-class prototype blobs + noise, seeded from the
+loader's PRNG — sized by ``synthetic_train``/``synthetic_valid``.  Set
+``synthetic=False`` to require the real files, ``synthetic=True`` to force
+the fallback.
+"""
+
+import os
+import struct
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.base import (
+    FullBatchLoader, TEST, VALID, TRAIN)
+
+
+class MnistLoader(FullBatchLoader):
+    MAPPING = "mnist_loader"
+
+    TEST_IMAGES = "t10k-images.idx3-ubyte"
+    TEST_LABELS = "t10k-labels.idx1-ubyte"
+    TRAIN_IMAGES = "train-images.idx3-ubyte"
+    TRAIN_LABELS = "train-labels.idx1-ubyte"
+
+    def __init__(self, workflow, **kwargs):
+        super(MnistLoader, self).__init__(workflow, **kwargs)
+        self.data_path = kwargs.get(
+            "data_path", os.path.join(root.common.dirs.datasets, "MNIST"))
+        self.synthetic = kwargs.get("synthetic", "auto")
+        self.synthetic_train = kwargs.get("synthetic_train", 2000)
+        self.synthetic_valid = kwargs.get("synthetic_valid", 500)
+
+    # -- IDX parsing (reference loader_mnist.py:109-160) --------------------
+    def _load_idx_labels(self, path, count):
+        with open(path, "rb") as fin:
+            header, = struct.unpack(">i", fin.read(4))
+            if header != 2049:
+                raise ValueError("Wrong header in %s" % path)
+            n_labels, = struct.unpack(">i", fin.read(4))
+            if n_labels != count:
+                raise ValueError("Wrong number of labels in %s" % path)
+            arr = numpy.frombuffer(fin.read(n_labels), dtype=numpy.uint8)
+            if len(arr) != n_labels:
+                raise ValueError("EOF while reading labels from %s" % path)
+        return arr.astype(numpy.int32)
+
+    def _load_idx_images(self, path, count):
+        with open(path, "rb") as fin:
+            header, = struct.unpack(">i", fin.read(4))
+            if header != 2051:
+                raise ValueError("Wrong header in %s" % path)
+            n_images, = struct.unpack(">i", fin.read(4))
+            if n_images != count:
+                raise ValueError("Wrong number of images in %s" % path)
+            n_rows, n_cols = struct.unpack(">2i", fin.read(8))
+            if n_rows != 28 or n_cols != 28:
+                raise ValueError("Images in %s should be 28x28" % path)
+            pixels = numpy.frombuffer(
+                fin.read(n_images * n_rows * n_cols), dtype=numpy.uint8)
+            if len(pixels) != n_images * n_rows * n_cols:
+                raise ValueError("EOF while reading images from %s" % path)
+        return pixels.astype(numpy.float32).reshape(n_images, 28, 28)
+
+    def _real_files_present(self):
+        return all(os.access(os.path.join(self.data_path, f), os.R_OK)
+                   for f in (self.TEST_IMAGES, self.TEST_LABELS,
+                             self.TRAIN_IMAGES, self.TRAIN_LABELS))
+
+    def _load_real(self):
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = 10000
+        self.class_lengths[TRAIN] = 60000
+        data = numpy.zeros((70000, 28, 28), dtype=numpy.float32)
+        labels = numpy.zeros(70000, dtype=numpy.int32)
+        labels[:10000] = self._load_idx_labels(
+            os.path.join(self.data_path, self.TEST_LABELS), 10000)
+        data[:10000] = self._load_idx_images(
+            os.path.join(self.data_path, self.TEST_IMAGES), 10000)
+        labels[10000:] = self._load_idx_labels(
+            os.path.join(self.data_path, self.TRAIN_LABELS), 60000)
+        data[10000:] = self._load_idx_images(
+            os.path.join(self.data_path, self.TRAIN_IMAGES), 60000)
+        self.original_data.reset(data)
+        self._original_labels[:] = labels.tolist()
+
+    def _load_synthetic(self):
+        """Deterministic MNIST-like set: 10 class-prototype blobs + noise."""
+        n_valid, n_train = self.synthetic_valid, self.synthetic_train
+        total = n_valid + n_train
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = n_valid
+        self.class_lengths[TRAIN] = n_train
+        r = numpy.random.RandomState(20260729)
+        protos = r.uniform(0, 255, (10, 28, 28)).astype(numpy.float32)
+        # smooth the prototypes so they have digit-like large-scale structure
+        for _ in range(2):
+            protos = (protos +
+                      numpy.roll(protos, 1, 1) + numpy.roll(protos, -1, 1) +
+                      numpy.roll(protos, 1, 2) + numpy.roll(protos, -1, 2)
+                      ) / 5.0
+        labels = r.randint(0, 10, total).astype(numpy.int32)
+        noise = r.normal(0, 32.0, (total, 28, 28)).astype(numpy.float32)
+        data = numpy.clip(protos[labels] + noise, 0, 255)
+        self.original_data.reset(data)
+        self._original_labels[:] = labels.tolist()
+
+    def load_data(self):
+        if self._real_files_present() and self.synthetic is not True:
+            self.info("Loading original MNIST files from %s", self.data_path)
+            self._load_real()
+        elif self.synthetic in (True, "auto"):
+            self.info("MNIST files absent (zero-egress environment); "
+                      "using the deterministic synthetic fallback "
+                      "(%d train / %d validation)",
+                      self.synthetic_train, self.synthetic_valid)
+            self._load_synthetic()
+        else:
+            raise OSError(
+                "No MNIST data in %s and synthetic fallback disabled; "
+                "download the IDX files manually" % self.data_path)

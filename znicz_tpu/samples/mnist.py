@@ -1,0 +1,118 @@
+"""MNIST sample — MLP and conv workflows via StandardWorkflow.
+
+Parity targets: reference samples/MNIST/mnist.py + mnist_config.py (MLP
+all2all_tanh(100) -> softmax(10), lr 0.03 — baseline 1.92% val err) and
+mnist_conv_config.py (conv 64C5 -> MP2 -> conv 87C5 -> MP2 ->
+all2all_relu(791) -> softmax, baseline 0.75% val err).  Built entirely by
+StandardWorkflow.create_workflow from the declarative layers config.
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.standard_workflow import StandardWorkflow
+import znicz_tpu.loader.loader_mnist  # noqa: F401 (registers mnist_loader)
+
+
+root.mnistr.update({
+    "decision": {"fail_iterations": 50, "max_epochs": 1000000000},
+    "loss_function": "softmax",
+    "loader_name": "mnist_loader",
+    "snapshotter": {"prefix": "mnist", "interval": 1, "time_interval": 0,
+                    "compression": ""},
+    "loader": {"minibatch_size": 60, "normalization_type": "linear"},
+    "layers": [
+        {"name": "fc_tanh1",
+         "type": "all2all_tanh",
+         "->": {"output_sample_shape": 100,
+                "weights_filling": "uniform", "weights_stddev": 0.05,
+                "bias_filling": "uniform", "bias_stddev": 0.05},
+         "<-": {"learning_rate": 0.03, "weights_decay": 0.0,
+                "learning_rate_bias": 0.03, "weights_decay_bias": 0.0,
+                "gradient_moment": 0.0, "gradient_moment_bias": 0.0,
+                "factor_ortho": 0.001}},
+        {"name": "fc_softmax2",
+         "type": "softmax",
+         "->": {"output_sample_shape": 10,
+                "weights_filling": "uniform", "weights_stddev": 0.05,
+                "bias_filling": "uniform", "bias_stddev": 0.05},
+         "<-": {"learning_rate": 0.03, "learning_rate_bias": 0.03,
+                "weights_decay": 0.0, "weights_decay_bias": 0.0,
+                "gradient_moment": 0.0, "gradient_moment_bias": 0.0}}],
+})
+
+#: LeNet-style conv topology (reference mnist_conv_config.py:61-118)
+root.mnistr_conv.update({
+    "layers": [
+        {"name": "conv1", "type": "conv",
+         "->": {"n_kernels": 64, "kx": 5, "ky": 5, "sliding": (1, 1),
+                "weights_filling": "uniform", "weights_stddev": 0.0944569801138958,
+                "bias_filling": "constant", "bias_stddev": 0.048000},
+         "<-": {"learning_rate": 0.03, "learning_rate_bias": 0.358000,
+                "gradient_moment": 0.36508255921752014,
+                "gradient_moment_bias": 0.385000,
+                "weights_decay": 0.0005, "weights_decay_bias": 0.1980997902551238,
+                "factor_ortho": 0.001}},
+        {"name": "pool1", "type": "max_pooling",
+         "->": {"kx": 2, "ky": 2, "sliding": (2, 2)}},
+        {"name": "conv2", "type": "conv",
+         "->": {"n_kernels": 87, "kx": 5, "ky": 5, "sliding": (1, 1),
+                "weights_filling": "uniform", "weights_stddev": 0.067834,
+                "bias_filling": "constant", "bias_stddev": 0.444372},
+         "<-": {"learning_rate": 0.03, "learning_rate_bias": 0.381000,
+                "gradient_moment": 0.115000, "gradient_moment_bias": 0.741000,
+                "weights_decay": 0.0005, "weights_decay_bias": 0.039,
+                "factor_ortho": 0.001}},
+        {"name": "pool2", "type": "max_pooling",
+         "->": {"kx": 2, "ky": 2, "sliding": (2, 2)}},
+        {"name": "fc_relu3", "type": "all2all_relu",
+         "->": {"output_sample_shape": 791,
+                "weights_filling": "uniform", "weights_stddev": 0.039858,
+                "bias_filling": "constant", "bias_stddev": 1.000000},
+         "<-": {"learning_rate": 0.03, "learning_rate_bias": 0.196000,
+                "gradient_moment": 0.810000, "gradient_moment_bias": 0.619000,
+                "weights_decay": 0.0005, "weights_decay_bias": 0.1162,
+                "factor_ortho": 0.001}},
+        {"name": "fc_softmax4", "type": "softmax",
+         "->": {"output_sample_shape": 10,
+                "weights_filling": "uniform", "weights_stddev": 0.024518,
+                "bias_filling": "constant", "bias_stddev": 0.255735},
+         "<-": {"learning_rate": 0.03, "learning_rate_bias": 0.488000,
+                "gradient_moment": 0.133000, "gradient_moment_bias": 0.8422,
+                "weights_decay": 0.0005, "weights_decay_bias": 0.476}}],
+})
+
+
+class MnistWorkflow(StandardWorkflow):
+    """Model created for digits recognition (reference mnist.py:54)."""
+
+
+def build(layers=None, loader_config=None, decision_config=None,
+          snapshotter_config=None, **kwargs):
+    cfg = root.mnistr
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    snap_cfg = cfg.snapshotter.as_dict()
+    snap_cfg.update(snapshotter_config or {})
+    kwargs.setdefault("loss_function", cfg.loss_function)
+    return MnistWorkflow(
+        layers=layers if layers is not None else cfg.layers,
+        loader_name=cfg.loader_name,
+        loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=snap_cfg,
+        **kwargs)
+
+
+def run_sample(device=None, conv=False, **kwargs):
+    if conv and "layers" not in kwargs:
+        kwargs["layers"] = root.mnistr_conv.layers
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+if __name__ == "__main__":
+    wf = run_sample()
+    print("best validation/train err%:", wf.decision.best_n_err_pt)

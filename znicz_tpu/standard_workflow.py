@@ -1,0 +1,197 @@
+"""StandardWorkflow — the one-stop training-graph builder.
+
+TPU-era equivalent of reference standard_workflow.py (1201 LoC — SURVEY.md
+§2.1).  ``create_workflow`` assembles the canonical train graph::
+
+    repeater -> loader -> forwards[0..n] -> evaluator -> decision
+      -> snapshotter -> gds[n..0] -> (loop back to repeater) -> end_point
+
+from the declarative ``layers`` config, pairing each forward with its
+registered backward (reference standard_workflow.py:173-208, 289-374).
+"""
+
+from znicz_tpu.standard_workflow_base import StandardWorkflowBase
+from znicz_tpu.core.snapshotter import SnapshotterRegistry
+from znicz_tpu.units.conv import ConvolutionalBase
+from znicz_tpu.units.gd_pooling import GDPooling
+from znicz_tpu.units.decision import DecisionsRegistry
+from znicz_tpu.units.evaluator import EvaluatorsRegistry
+# Importing the units package registers every layer type — keep even if
+# it looks unused (reference standard_workflow.py:58-60).
+import znicz_tpu.units  # noqa: F401
+
+
+class StandardWorkflow(StandardWorkflowBase):
+    """(reference standard_workflow.py:81-1172)"""
+
+    def __init__(self, workflow=None, **kwargs):
+        super(StandardWorkflow, self).__init__(workflow, **kwargs)
+        self.loss_function = kwargs.get("loss_function", "softmax")
+        if self.loss_function not in EvaluatorsRegistry.evaluators:
+            raise ValueError("Unknown loss_function %r (known: %s)" % (
+                self.loss_function,
+                sorted(EvaluatorsRegistry.evaluators)))
+        self.decision_name = kwargs.get(
+            "decision_name",
+            "decision_gd" if self.loss_function == "softmax"
+            else "decision_mse")
+        self.snapshotter_name = kwargs.get("snapshotter_name", "nnfile")
+        self.evaluator_config = self.config2kwargs(
+            kwargs.get("evaluator_config"))
+        self.decision_config = self.config2kwargs(
+            kwargs.get("decision_config"))
+        self.snapshotter_config = self.config2kwargs(
+            kwargs.get("snapshotter_config"))
+        if not self.preprocessing:
+            self.create_workflow()
+
+    # -- canonical graph (reference 173-208) --------------------------------
+    def create_workflow(self):
+        self.link_repeater(self.start_point)
+        self.link_loader(self.repeater)
+        self.link_forwards(("input", "minibatch_data"), self.loader)
+        self.link_evaluator(self.forwards[-1])
+        self.link_decision(self.evaluator)
+        self.link_snapshotter(self.decision)
+        last_gd = self.link_gds(self.snapshotter)
+        self.link_loop(last_gd)
+        self.link_end_point(last_gd)
+
+    # -- backward chain (reference 289-374) ---------------------------------
+    def link_gds(self, *parents):
+        if not isinstance(self.layers, (tuple, list)):
+            raise ValueError("layers should be a list of dicts")
+        self.gds[:] = [None] * len(self.layers)
+        first_gd = None
+        units_to_delete = []
+        for i, layer in reversed(list(enumerate(self.layers))):
+            tpe, _, kwargs = self._get_layer_type_kwargs(layer)
+            if not isinstance(self.forwards[i], self.layer_map[tpe].forward):
+                raise TypeError(
+                    "Forward layer %s at position %d is not an instance "
+                    "of %s" % (self.forwards[i], i,
+                               self.layer_map[tpe].forward))
+            try:
+                unit = next(self.layer_map[tpe].backwards)(self, **kwargs)
+            except StopIteration:
+                units_to_delete.append(i)
+                continue
+            self.gds[i] = unit
+
+            if first_gd is not None:
+                unit.link_from(first_gd) \
+                    .link_attrs(first_gd, ("err_output", "err_input"))
+            else:
+                unit.link_from(*parents) \
+                    .link_attrs(self.evaluator, "err_output")
+            first_gd = unit
+
+            try_link = {"input", "weights", "bias", "input_offset",
+                        "mask", "output"}
+            if isinstance(unit, ConvolutionalBase):
+                try_link.update(ConvolutionalBase.CONV_ATTRS)
+            if isinstance(unit, GDPooling):
+                try_link.update(GDPooling.POOL_ATTRS)
+            attrs = [a for a in sorted(try_link)
+                     if getattr(self.forwards[i], a, None) is not None]
+            unit.link_attrs(self.forwards[i], *attrs)
+            unit.link_attrs(self.loader, ("batch_size", "minibatch_size"))
+            if getattr(unit, "mask", None) is not None or "mask" in attrs:
+                unit.link_attrs(self.loader, "minibatch_class")
+            unit.gate_skip = self.decision.gd_skip
+
+        for i in units_to_delete:
+            del self.gds[i]
+        self.gds[0].need_err_input = False
+        return first_gd
+
+    # -- evaluator (reference 413-448) --------------------------------------
+    def link_evaluator(self, *parents):
+        self.evaluator = EvaluatorsRegistry.evaluators[self.loss_function](
+            self, name="evaluator", **self.evaluator_config)
+        self.evaluator.link_from(*parents) \
+            .link_attrs(self.forwards[-1], "output") \
+            .link_attrs(self.loader,
+                        ("batch_size", "minibatch_size"),
+                        ("labels", "minibatch_labels"),
+                        ("max_samples_per_epoch", "total_samples"),
+                        "class_lengths",
+                        ("offset", "minibatch_offset"))
+        if self.loss_function == "softmax":
+            self.evaluator.link_attrs(self.forwards[-1], "max_idx")
+        elif self.loss_function == "mse":
+            self.evaluator.link_attrs(
+                self.loader, ("target", "minibatch_targets"))
+            if getattr(self.loader, "class_targets", None) is not None:
+                self.evaluator.link_attrs(self.loader, "class_targets",
+                                          ("labels", "minibatch_labels"))
+        return self.evaluator
+
+    # -- decision (reference 451-490) ---------------------------------------
+    def link_decision(self, *parents):
+        self.decision = DecisionsRegistry.decisions[self.decision_name](
+            self, name="decision", **self.decision_config)
+        self.decision.link_from(*parents) \
+            .link_attrs(self.loader, "minibatch_class", "last_minibatch",
+                        "minibatch_size", "class_lengths", "epoch_ended",
+                        "epoch_number")
+        self.decision.link_attrs(self.evaluator,
+                                 ("minibatch_n_err", "n_err"))
+        if self.decision_name == "decision_gd":
+            self.decision.link_attrs(
+                self.evaluator,
+                ("minibatch_confusion_matrix", "confusion_matrix"),
+                ("minibatch_max_err_y_sum", "max_err_output_sum"))
+        elif self.decision_name == "decision_mse":
+            self.decision.link_attrs(self.loader, "minibatch_offset")
+            self.decision.link_attrs(self.evaluator,
+                                     ("minibatch_metrics", "metrics"),
+                                     ("minibatch_mse", "mse"))
+        self.repeater.gate_block = self.decision.complete
+        self.real_loader.gate_block = self.decision.complete
+        return self.decision
+
+    # -- snapshotter (reference 493-516) ------------------------------------
+    def link_snapshotter(self, *parents):
+        name = self.snapshotter_name or "nnfile"
+        self.snapshotter = SnapshotterRegistry.mapping[name](
+            self, name="snapshotter", **self.snapshotter_config)
+        self.snapshotter.link_from(*parents) \
+            .link_attrs(self.decision, ("suffix", "snapshot_suffix"))
+        self.snapshotter.gate_skip = ~self.loader.epoch_ended
+        self.snapshotter.skip = ~self.decision.improved
+        return self.snapshotter
+
+    def link_loop(self, *parents):
+        """Close the training loop back into the repeater."""
+        self.repeater.link_from(*parents)
+        return self.repeater
+
+    def link_end_point(self, *parents):
+        self.end_point.link_from(*parents)
+        self.end_point.gate_block = ~self.decision.complete
+        return self.end_point
+
+    # -- inference extraction (reference 210-286) ---------------------------
+    def extract_forward_workflow(self, loader_name=None, loader_config=None,
+                                 loader_factory=None):
+        """Build a forward-only workflow with this one's weights copied in
+        via the master-slave broadcast protocol
+        (reference standard_workflow.py:282-286)."""
+        kwargs = dict(layers=self.layers, preprocessing=False)
+        if loader_name is not None:
+            kwargs["loader_name"] = loader_name
+        elif loader_factory is not None:
+            kwargs["loader_factory"] = loader_factory
+        else:
+            kwargs["loader_factory"] = self.loader_factory
+        if loader_config is not None:
+            kwargs["loader_config"] = loader_config
+        fwd_wf = StandardWorkflowBase(None, **kwargs)
+        fwd_wf.create_workflow()
+        for fwd_exp, fwd_imp in zip(self.forwards, fwd_wf.forwards):
+            data = fwd_exp.generate_data_for_slave(None)
+            if data is not None:
+                fwd_imp.apply_data_from_master(data)
+            fwd_imp.forward_mode = True
+        return fwd_wf

@@ -1,0 +1,255 @@
+"""Self-constructing workflow base — forward chain from declarative config.
+
+TPU-era equivalent of reference standard_workflow_base.py (456 LoC —
+SURVEY.md §2.1).  A ``layers`` config is a list of dicts::
+
+    {"type": "conv", "->": {forward kwargs}, "<-": {backward kwargs},
+     other: shared kwargs}
+
+plus the mcdnnic topology shorthand ``"784x28x28-32C5-MP2-100N-10N"``
+(reference standard_workflow_base.py:72-270).  Forward units are created
+from the type-string registry and auto-chained; the softmax head's width is
+auto-set from the loader's label count.
+"""
+
+import re
+
+import numpy
+
+from znicz_tpu.loader.base import UserLoaderRegistry
+from znicz_tpu.units import nn_units
+from znicz_tpu.units.all2all import All2AllSoftmax
+from znicz_tpu.units.dropout import DropoutForward
+
+
+class StandardWorkflowBase(nn_units.NNWorkflow):
+    """Builds the forward chain from the ``layers`` config
+    (reference standard_workflow_base.py:59-456)."""
+
+    mcdnnic_layer_pattern = re.compile(
+        r"(?P<C>\d+C\d+)|(?P<MP>MP\d+)|(?P<N>\d+N)")
+
+    def __init__(self, workflow=None, **kwargs):
+        super(StandardWorkflowBase, self).__init__(workflow, **kwargs)
+        self.layer_map = nn_units.mapping
+        self.preprocessing = kwargs.get("preprocessing", False)
+        self.mcdnnic_topology = kwargs.get("mcdnnic_topology", None)
+        self.mcdnnic_parameters = kwargs.get("mcdnnic_parameters", None)
+        self.layers = kwargs.get("layers", [{}])
+        self.loader_config = dict(self.dictify(
+            kwargs.get("loader_config", {})))
+        self._loader_name = None
+        self._loader_factory = None
+        self.real_loader = None
+        if "loader_name" in kwargs:
+            self.loader_name = kwargs["loader_name"]
+        elif "loader_factory" in kwargs:
+            self.loader_factory = kwargs["loader_factory"]
+
+    # -- config plumbing ----------------------------------------------------
+    @staticmethod
+    def dictify(obj):
+        return getattr(obj, "__content__", obj)
+
+    def config2kwargs(self, unit_config):
+        return {} if unit_config is None else dict(self.dictify(unit_config))
+
+    @property
+    def loader_name(self):
+        return self._loader_name
+
+    @loader_name.setter
+    def loader_name(self, value):
+        if value is None:
+            self._loader_name = None
+            return
+        kwargs = dict(self.loader_config)
+        if self.mcdnnic_topology is not None:
+            kwargs = self._update_loader_kwargs_from_mcdnnic(
+                kwargs, self.mcdnnic_topology)
+        kls = UserLoaderRegistry.get_factory(value)
+        self._loader_factory = lambda wf: kls(wf, name="loader", **kwargs)
+        self._loader_name = value
+
+    @property
+    def loader_factory(self):
+        return self._loader_factory
+
+    @loader_factory.setter
+    def loader_factory(self, value):
+        if not callable(value):
+            raise TypeError("loader_factory must be callable")
+        self._loader_name = None
+        self._loader_factory = value
+
+    # -- layers config ------------------------------------------------------
+    @property
+    def layers(self):
+        if self.mcdnnic_topology is not None:
+            return self._get_layers_from_mcdnnic(self.mcdnnic_topology)
+        return self._layers
+
+    @layers.setter
+    def layers(self, value):
+        if self.mcdnnic_topology is not None and value != [{}]:
+            raise ValueError(
+                "Do not set mcdnnic_topology and layers at the same time")
+        if not isinstance(value, list) or \
+                any(not isinstance(l, dict) for l in value):
+            raise ValueError("layers should be a list of dicts")
+        if (value == [{}] and self.mcdnnic_topology is None and
+                not self.preprocessing):
+            raise ValueError(
+                "layers is empty and mcdnnic_topology is not defined")
+        self._layers = value
+
+    # -- mcdnnic topology parser (reference 218-270) ------------------------
+    def _get_mcdnnic_parameters(self, arrow):
+        params = self.mcdnnic_parameters or {}
+        return dict(params.get(arrow, {}))
+
+    @staticmethod
+    def _parse_mcdnnic_c(is_last, value):
+        kernels, kx = value.split("C")
+        return {"type": "conv",
+                "->": {"n_kernels": int(kernels), "kx": int(kx),
+                       "ky": int(kx)}}
+
+    @staticmethod
+    def _parse_mcdnnic_mp(is_last, value):
+        _, kx = value.split("MP")
+        return {"type": "max_pooling", "->": {"kx": int(kx), "ky": int(kx)}}
+
+    @staticmethod
+    def _parse_mcdnnic_n(is_last, value):
+        neurons, _ = value.split("N")
+        tpe = "softmax" if is_last else "all2all"
+        return {"type": tpe, "->": {"output_sample_shape": int(neurons)}}
+
+    def _get_layers_from_mcdnnic(self, description):
+        layers = []
+        fwd_params = self._get_mcdnnic_parameters("->")
+        bwd_params = self._get_mcdnnic_parameters("<-")
+        parse = {"C": self._parse_mcdnnic_c, "MP": self._parse_mcdnnic_mp,
+                 "N": self._parse_mcdnnic_n}
+        matches = tuple(re.finditer(self.mcdnnic_layer_pattern, description))
+        for index, match in enumerate(matches):
+            name = next(n for n, v in match.groupdict().items() if v)
+            cfg = parse[name](index == len(matches) - 1, match.group(name))
+            cfg["->"].update(fwd_params)
+            cfg["<-"] = dict(bwd_params)
+            layers.append(cfg)
+        return layers
+
+    @staticmethod
+    def _update_loader_kwargs_from_mcdnnic(kwargs, description):
+        inp = description.split("-")[0]
+        minibatch_size, y_size, x_size = inp.split("x")
+        kwargs["minibatch_size"] = int(minibatch_size)
+        kwargs["scale"] = (int(y_size), int(x_size))
+        return kwargs
+
+    # -- layer instantiation ------------------------------------------------
+    def _get_layer_type_kwargs(self, layer):
+        """Split one layer dict into (type, forward kwargs, backward kwargs)
+        (reference standard_workflow_base.py:406-422)."""
+        tpe = layer.get("type", "").strip()
+        if not tpe:
+            raise ValueError("layer type must not be an empty string")
+        if tpe not in self.layer_map:
+            raise ValueError("Unknown layer type %r" % tpe)
+        kwargs_forward = dict(layer.get("->", {}))
+        kwargs_backward = dict(layer.get("<-", {}))
+        others = {k: v for k, v in layer.items()
+                  if k not in ("type", "->", "<-", "name")}
+        kwargs_forward.update(others)
+        kwargs_backward.update(others)
+        if "name" in layer:
+            kwargs_forward["name"] = layer["name"] + "_forward"
+            kwargs_backward["name"] = "gd_" + layer["name"]
+        return tpe, kwargs_forward, kwargs_backward
+
+    # -- graph construction -------------------------------------------------
+    def link_repeater(self, *parents):
+        self.repeater.link_from(*parents)
+        return self.repeater
+
+    def link_loader(self, *parents):
+        if self.loader_factory is None:
+            raise ValueError(
+                "no loader: pass loader_name= or loader_factory=")
+        self.loader = self.loader_factory(self)
+        self.loader.link_from(*parents)
+        self.real_loader = self.loader
+        return self.loader
+
+    def link_forwards(self, init_attrs, *parents):
+        """Create + chain forward units (reference 272-336)."""
+        del self.forwards[:]
+        for layer in self.layers:
+            tpe, kwargs, _ = self._get_layer_type_kwargs(layer)
+            if not self.layer_map[tpe].has_forward:
+                raise ValueError("no Forward registered for %r" % tpe)
+            unit = self.layer_map[tpe].forward(self, **kwargs)
+            self._add_forward_unit(unit, init_attrs, *parents)
+
+        # ZeroFiller-style units mask the NEXT layer's weights
+        for prev_fwd, fwd in zip(self.forwards, self.forwards[1:]):
+            if getattr(prev_fwd, "LINKS_NEXT_WEIGHTS", False):
+                prev_fwd.link_attrs(fwd, "weights")
+
+        last_fwd = self.forwards[-1]
+        if isinstance(last_fwd, All2AllSoftmax) and \
+                self.real_loader is not None:
+            loader = self.real_loader
+
+            def on_initialized():
+                ulc = loader.unique_labels_count
+                oss = last_fwd.output_sample_shape
+                if oss != tuple() and numpy.prod(oss) != ulc:
+                    self.warning(
+                        "Overriding %s.output_sample_shape %s with (%d,)",
+                        last_fwd.name, oss, ulc)
+                else:
+                    self.info("Setting %s.output_sample_shape to %d",
+                              last_fwd.name, ulc)
+                last_fwd.output_sample_shape = ulc
+
+            loader.on_initialized = on_initialized
+        return last_fwd
+
+    def _add_forward_unit(self, new_unit, init_attrs=None, *parents):
+        """(reference 424-452)"""
+        if self.forwards:
+            prev = (self.forwards[-1],)
+        else:
+            if not parents:
+                raise ValueError(
+                    "No parent units were specified for the first forward!")
+            prev = parents
+        new_unit.link_from(*prev)
+        if isinstance(new_unit, DropoutForward):
+            new_unit.link_attrs(self.loader, "minibatch_class")
+        self.forwards.append(new_unit)
+
+        if "input" not in new_unit._demanded and \
+                getattr(new_unit, "input", None) is None and \
+                not new_unit.has_linked_attr("input"):
+            return
+        for fwd in reversed(self.forwards[:-1]):
+            if getattr(fwd, "output", None) is not None:
+                new_unit.link_attrs(fwd, ("input", "output"))
+                break
+        else:
+            new_unit.link_attrs(parents[0], init_attrs)
+
+    def link_end_point(self, *parents):
+        self.repeater.link_from(*parents)
+        self.end_point.link_from(*parents)
+        return self.end_point
+
+    def create_workflow(self):
+        self.link_repeater(self.start_point)
+        self.link_loader(self.repeater)
+        self.link_forwards(("input", "minibatch_data"), self.loader)
+        self.end_point.gate_block = ~self.loader.complete

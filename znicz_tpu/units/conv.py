@@ -8,7 +8,7 @@ sliding (x, y) — see :mod:`znicz_tpu.ops.conv`.
 
 import numpy
 
-from znicz_tpu.units.nn_units import NNLayerBase
+from znicz_tpu.units.nn_units import NNLayerBase, as_nhwc
 from znicz_tpu.ops import conv as conv_ops
 
 
@@ -55,26 +55,32 @@ class Conv(ConvolutionalBase, NNLayerBase):
         return self.OUTPUT_MAX_SUPPOSED if self.OUTPUT_MAX_SUPPOSED \
             is not None else self.max_supposed
 
+    @property
+    def n_channels(self):
+        """Implicit single channel for 3D (B, H, W) input — reference
+        computes channels from size (conv.py:159-160)."""
+        s = self.input.shape
+        return self.input.size // (s[0] * s[1] * s[2])
+
     def get_weights_magnitude(self):
         """Reference conv.py:137-146."""
-        n_channels = self.input.shape[3]
         vle = 1.0 / (self.max_supposed *
-                     numpy.sqrt(self.kx * self.ky * n_channels))
+                     numpy.sqrt(self.kx * self.ky * self.n_channels))
         if self.weights_filling == "gaussian":
             vle /= 3
         return vle
 
     def initialize(self, device=None, **kwargs):
         super(Conv, self).initialize(device=device, **kwargs)
-        if len(self.input.shape) != 4:
-            raise ValueError("conv input must be NHWC, got shape %s"
+        if len(self.input.shape) not in (3, 4):
+            raise ValueError("conv input must be (B,H,W[,C]), got shape %s"
                              % (self.input.shape,))
         if self.weights_stddev is None:
             self.weights_stddev = min(self.get_weights_magnitude(), 0.05)
         if self.bias_stddev is None:
             self.bias_stddev = self.weights_stddev
 
-        n_channels = self.input.shape[3]
+        n_channels = self.n_channels
         kernel_size = self.kx * self.ky * n_channels
         if not self.weights:
             w = numpy.zeros((self.n_kernels, kernel_size),
@@ -113,7 +119,7 @@ class Conv(ConvolutionalBase, NNLayerBase):
             self.bias.map_read()
         self.output.map_invalidate()
         y = conv_ops.forward_numpy(
-            self.input.mem, self._weights2d,
+            as_nhwc(self.input.mem), self._weights2d,
             self.bias.mem if self.include_bias else None,
             self.ky, self.kx, self.padding, self.sliding,
             activation=self.ACTIVATION, include_bias=self.include_bias)
@@ -124,7 +130,7 @@ class Conv(ConvolutionalBase, NNLayerBase):
         if self.weights_transposed:
             w = w.T
         y = conv_ops.forward_jax(
-            self.input.dev, w,
+            as_nhwc(self.input.dev), w,
             self.bias.dev if self.include_bias else None,
             self.ky, self.kx, self.padding, self.sliding,
             activation=self.ACTIVATION, include_bias=self.include_bias)

@@ -9,7 +9,7 @@ the im2col weights-gradient GEMM both come from the VJP of the forward conv
 
 from znicz_tpu.units.conv import ConvolutionalBase
 from znicz_tpu.units.nn_units import (
-    GradientDescentBase, GradientDescentWithActivation)
+    GradientDescentBase, GradientDescentWithActivation, as_nhwc)
 from znicz_tpu.ops import conv as conv_ops
 from znicz_tpu.ops import activations
 
@@ -55,13 +55,14 @@ class GradientDescentConv(ConvolutionalBase, GradientDescentBase):
         self.weights.map_read()
         self.err_output.map_read()
         err_in, grad_w, grad_b = conv_ops.backward_numpy(
-            self.input.mem, self.err_output.mem, self._weights2d,
+            as_nhwc(self.input.mem), self.err_output.mem,
+            self._weights2d,
             self.ky, self.kx, self.padding, self.sliding,
             need_err_input=self.need_err_input,
             include_bias=self.include_bias and self.bias is not None)
         if self.need_err_input:
             self.err_input.map_invalidate()
-            bp = err_in * self.err_input_alpha
+            bp = err_in.reshape(self.input.shape) * self.err_input_alpha
             if self.err_input_beta:
                 bp = bp + self.err_input_beta * self.err_input.mem
             self.err_input.mem[...] = bp
@@ -82,12 +83,12 @@ class GradientDescentConv(ConvolutionalBase, GradientDescentBase):
         if self.weights_transposed:
             w = w.T
         err_in, grad_w, grad_b = conv_ops.backward_jax(
-            self.input.dev, self.err_output.dev, w,
+            as_nhwc(self.input.dev), self.err_output.dev, w,
             self.ky, self.kx, self.padding, self.sliding,
             need_err_input=self.need_err_input,
             include_bias=self.include_bias and self.bias is not None)
         if self.need_err_input:
-            bp = err_in * self.err_input_alpha
+            bp = err_in.reshape(self.input.shape) * self.err_input_alpha
             if self.err_input_beta:
                 bp = bp + self.err_input_beta * self.err_input.dev
             self.err_input.set_dev(bp)

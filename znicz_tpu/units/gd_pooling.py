@@ -80,11 +80,18 @@ class GDAvgPooling(GDPooling):
     def numpy_run(self):
         self.err_output.map_read()
         self.err_input.map_invalidate()
+        shape4 = tuple(self.err_input.shape)
+        if len(shape4) == 3:
+            shape4 = shape4 + (1,)
         self.err_input.mem[...] = pool_ops.avg_pooling_backward_numpy(
             self.err_output.mem, self.ky, self.kx, self.sliding,
-            self.err_input.shape)
+            shape4).reshape(self.err_input.shape)
 
     def jax_run(self):
-        self.err_input.set_dev(pool_ops.avg_pooling_backward_jax(
+        shape4 = tuple(self.input.shape)
+        if len(shape4) == 3:
+            shape4 = shape4 + (1,)
+        err_in = pool_ops.avg_pooling_backward_jax(
             self.err_output.dev, self.ky, self.kx, tuple(self.sliding),
-            tuple(self.input.shape)))
+            shape4)
+        self.err_input.set_dev(err_in.reshape(self.input.shape))

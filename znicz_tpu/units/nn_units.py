@@ -105,6 +105,15 @@ def weights_magnitude(c, n_in, n_out, filling="uniform"):
     return vle
 
 
+def as_nhwc(arr):
+    """4D NHWC view of a 3D (B, H, W) or 4D array — the implicit
+    single-channel convention shared by every spatial unit (the reference
+    derives channels from size, conv.py:159-160)."""
+    if arr.ndim == 3:
+        return arr.reshape(arr.shape + (1,))
+    return arr
+
+
 class ForwardBase(AcceleratedUnit, metaclass=MatchingObject):
     """Base for forward-propagation units."""
     hide_from_registry = True
@@ -372,11 +381,12 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
                     gd_alpha=self.gd_alpha, gd_beta=self.gd_beta,
                     factor_ortho=float(self.factor_ortho))
 
-    def _flags(self):
+    def _flags(self, bias=False):
         return dict(accumulate=bool(self.accumulate_gradient),
                     apply=bool(self.apply_gradient),
                     solvers=self.solvers,
-                    ortho=bool(self.factor_ortho),
+                    # ortho regularizes weight ROWS — never the 1-D bias
+                    ortho=bool(self.factor_ortho) and not bias,
                     variant_moment=self.variant_moment_gradient)
 
     def _numpy_apply_update(self, which):
@@ -391,7 +401,8 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
         hyper = self._hyper(bias=(which == "bias"))
         vec.map_write()
         new_w, new_state = gd_math.update_numpy(
-            vec.mem, grad.mem, state, hyper, self._flags())
+            vec.mem, grad.mem, state, hyper,
+            self._flags(bias=(which == "bias")))
         vec.mem[...] = new_w
         if acc and new_state.get("acc") is not None:
             acc.map_write()
@@ -417,7 +428,8 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
                 state[k] = jax.device_put(v)
         hyper = self._hyper(bias=(which == "bias"))
         new_w, new_state = gd_math.update_jax(
-            vec.dev, grad_dev, state, hyper, self._flags())
+            vec.dev, grad_dev, state, hyper,
+            self._flags(bias=(which == "bias")))
         if self.apply_gradient:
             vec.set_dev(new_w)
         setattr(self, stash_attr, new_state)

@@ -10,7 +10,7 @@ import numpy
 
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core import prng
-from znicz_tpu.units.nn_units import Forward
+from znicz_tpu.units.nn_units import Forward, as_nhwc
 from znicz_tpu.ops import pooling as pool_ops
 
 
@@ -79,8 +79,8 @@ class Pooling(PoolingBase, Forward):
 
     def initialize(self, device=None, **kwargs):
         super(Pooling, self).initialize(device=device, **kwargs)
-        if len(self.input.shape) != 4:
-            raise ValueError("pooling input must be NHWC")
+        if len(self.input.shape) not in (3, 4):
+            raise ValueError("pooling input must be (B,H,W[,C])")
         shape = self.output_shape
         if self.output:
             assert self.output.shape[1:] == shape[1:]
@@ -126,14 +126,14 @@ class MaxPooling(OffsetPooling):
         self.output.map_invalidate()
         self.input_offset.map_invalidate()
         out, offs = pool_ops.max_pooling_numpy(
-            self.input.mem, self.ky, self.kx, self.sliding,
+            as_nhwc(self.input.mem), self.ky, self.kx, self.sliding,
             use_abs=self.USE_ABS)
         self.output.mem[...] = out
         self.input_offset.mem[...] = offs
 
     def jax_run(self):
         out, offs = pool_ops.max_pooling_jax(
-            self.input.dev, self.ky, self.kx, self.sliding,
+            as_nhwc(self.input.dev), self.ky, self.kx, self.sliding,
             use_abs=self.USE_ABS)
         self.output.set_dev(out)
         self.input_offset.set_dev(offs)
@@ -169,7 +169,7 @@ class StochasticPoolingBase(OffsetPooling):
         self.output.map_invalidate()
         self.input_offset.map_invalidate()
         out, offs = pool_ops.stochastic_pooling_numpy(
-            self.input.mem, self._rand_u16(), self.ky, self.kx,
+            as_nhwc(self.input.mem), self._rand_u16(), self.ky, self.kx,
             self.sliding, use_abs=self.USE_ABS)
         self.output.mem[...] = out
         self.input_offset.mem[...] = offs
@@ -177,7 +177,7 @@ class StochasticPoolingBase(OffsetPooling):
     def jax_run(self):
         # host-drawn randoms keep jax == numpy bit-wise for the same seed
         out, offs = pool_ops.stochastic_pooling_jax(
-            self.input.dev, self._rand_u16(), self.ky, self.kx,
+            as_nhwc(self.input.dev), self._rand_u16(), self.ky, self.kx,
             self.sliding, use_abs=self.USE_ABS)
         self.output.set_dev(out)
         self.input_offset.set_dev(offs)
@@ -203,8 +203,8 @@ class AvgPooling(Pooling):
         self.input.map_read()
         self.output.map_invalidate()
         self.output.mem[...] = pool_ops.avg_pooling_numpy(
-            self.input.mem, self.ky, self.kx, self.sliding)
+            as_nhwc(self.input.mem), self.ky, self.kx, self.sliding)
 
     def jax_run(self):
         self.output.set_dev(pool_ops.avg_pooling_jax(
-            self.input.dev, self.ky, self.kx, self.sliding))
+            as_nhwc(self.input.dev), self.ky, self.kx, self.sliding))
