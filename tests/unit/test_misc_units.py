@@ -1,0 +1,246 @@
+"""Cutter, ZeroFiller, Multiplier/Summator, Deconv/Depooling,
+ResizableAll2All, RProp — cross-validation + gradient checks."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.backends import NumpyDevice, JaxDevice
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.units import (
+    cutter, zerofilling, multiplier, summator, deconv, depooling,
+    resizable_all2all, rprop_gd, conv as conv_units, pooling as pool_units,
+    all2all)
+from znicz_tpu.ops import conv as conv_ops
+
+DEVICES = [NumpyDevice, JaxDevice]
+
+
+@pytest.mark.parametrize("device_cls", DEVICES)
+def test_cutter_and_gd(device_cls):
+    device = device_cls()
+    r = numpy.random.RandomState(1)
+    x = r.uniform(-1, 1, (2, 6, 7, 3))
+    wf = DummyWorkflow()
+    cut = cutter.Cutter(wf, padding=(1, 2, 1, 1))
+    cut.input = Array(x.copy())
+    cut.link_from(wf.start_point)
+    cut.initialize(device=device)
+    cut.run()
+    assert cut.output.shape == (2, 3, 5, 3)
+    assert numpy.abs(numpy.asarray(cut.output.mem) -
+                     x[:, 2:5, 1:6, :]).max() == 0
+
+    err = r.uniform(-1, 1, (2, 3, 5, 3))
+    gd_c = cutter.GDCutter(wf, padding=(1, 2, 1, 1))
+    gd_c.err_output = Array(err.copy())
+    gd_c.link_attrs(cut, "input")
+    gd_c.initialize(device=device)
+    gd_c.run()
+    ei = numpy.asarray(gd_c.err_input.mem)
+    assert ei.shape == x.shape
+    assert numpy.abs(ei[:, 2:5, 1:6, :] - err).max() == 0
+    assert ei.sum() == pytest.approx(err.sum())
+
+
+@pytest.mark.parametrize("device_cls", DEVICES)
+def test_cutter1d(device_cls):
+    r = numpy.random.RandomState(2)
+    x = r.uniform(-1, 1, (3, 10))
+    y0 = r.uniform(-1, 1, (3, 8))
+    wf = DummyWorkflow()
+    c = cutter.Cutter1D(wf, alpha=2.0, beta=0.5, input_offset=3,
+                        output_offset=1, length=4)
+    c.input = Array(x.copy())
+    c.output.reset(y0.copy())
+    c.link_from(wf.start_point)
+    c.initialize(device=device_cls())
+    c.run()
+    out = numpy.asarray(c.output.mem)
+    expect = y0.copy()
+    expect[:, 1:5] = 0.5 * y0[:, 1:5] + 2.0 * x[:, 3:7]
+    assert numpy.abs(out - expect).max() < 1e-12
+
+
+@pytest.mark.parametrize("device_cls", DEVICES)
+def test_zerofiller(device_cls):
+    wf = DummyWorkflow()
+    w = numpy.ones((4, 6))
+    zf = zerofilling.ZeroFiller(wf, grouping=2)
+    zf.weights = Array(w.copy())
+    zf.link_from(wf.start_point)
+    zf.initialize(device=device_cls())
+    zf.run()
+    got = numpy.asarray(zf.weights.mem)
+    k = numpy.arange(4)[:, None] % 2
+    c = numpy.arange(6)[None, :] % 2
+    assert numpy.abs(got - (k != c)).max() == 0
+
+
+@pytest.mark.parametrize("device_cls", DEVICES)
+def test_multiplier_summator(device_cls):
+    device = device_cls()
+    r = numpy.random.RandomState(3)
+    x = r.uniform(-1, 1, (4, 5))
+    y = r.uniform(-1, 1, (4, 5))
+    err = r.uniform(-1, 1, (4, 5))
+    wf = DummyWorkflow()
+    m = multiplier.Multiplier(wf)
+    m.x, m.y = Array(x.copy()), Array(y.copy())
+    m.link_from(wf.start_point)
+    m.initialize(device=device)
+    m.run()
+    assert numpy.abs(numpy.asarray(m.output.mem) - x * y).max() < 1e-12
+    gm = multiplier.GDMultiplier(wf)
+    gm.x, gm.y, gm.err_output = (Array(x.copy()), Array(y.copy()),
+                                 Array(err.copy()))
+    gm.initialize(device=device)
+    gm.run()
+    assert numpy.abs(numpy.asarray(gm.err_x.mem) - err * y).max() < 1e-12
+    assert numpy.abs(numpy.asarray(gm.err_y.mem) - err * x).max() < 1e-12
+
+    s = summator.Summator(wf)
+    s.x, s.y = Array(x.copy()), Array(y.copy())
+    s.initialize(device=device)
+    s.run()
+    assert numpy.abs(numpy.asarray(s.output.mem) - (x + y)).max() < 1e-12
+    gs = summator.GDSummator(wf)
+    gs.err_output = Array(err.copy())
+    gs.initialize(device=device)
+    gs.run()
+    assert numpy.abs(numpy.asarray(gs.err_x.mem) - err).max() == 0
+    assert numpy.abs(numpy.asarray(gs.err_y.mem) - err).max() == 0
+
+
+@pytest.mark.parametrize("device_cls", DEVICES)
+def test_deconv_inverts_conv_geometry(device_cls):
+    """Conv -> Deconv with shared weights reproduces the input shape, and
+    deconv forward matches the conv's VJP (numpy vs jax parity)."""
+    device = device_cls()
+    r = numpy.random.RandomState(4)
+    x = r.uniform(-1, 1, (2, 8, 8, 3))
+    wf = DummyWorkflow()
+    # the AE pairing: conv uses the deconv-computed padding so the
+    # geometries invert each other (reference deconv.py:91-99)
+    pad = deconv.Deconv.compute_padding(8, 8, 4, 4, (2, 2))
+    cv = conv_units.Conv(wf, n_kernels=5, kx=4, ky=4, sliding=(2, 2),
+                         padding=pad,
+                         weights_stddev=0.1, bias_stddev=0.1)
+    cv.rand = prng.RandomGenerator().seed(7)
+    cv.input = Array(x.copy())
+    cv.link_from(wf.start_point)
+    cv.initialize(device=device)
+    cv.run()
+
+    dc = deconv.Deconv(wf, n_kernels=5, kx=4, ky=4, sliding=(2, 2))
+    dc.link_attrs(cv, ("input", "output"), "weights",
+                  ("output_shape_source", "input"))
+    dc.link_from(cv)
+    dc.initialize(device=device)
+    dc.run()
+    assert dc.output.shape == x.shape
+
+    err = r.uniform(-0.1, 0.1, x.shape)
+    gd_d = deconv.GDDeconv(wf, learning_rate=0.01, weights_decay=0.0)
+    gd_d.err_output = Array(err.copy())
+    gd_d.link_attrs(dc, ("input", "input"), "weights", "n_kernels",
+                    "kx", "ky", "padding", "sliding")
+    gd_d.initialize(device=device)
+    gd_d.run()
+    assert gd_d.err_input.shape == dc.input.shape
+
+
+def test_deconv_jax_matches_numpy():
+    r = numpy.random.RandomState(5)
+    x = r.uniform(-1, 1, (2, 5, 5, 5)).astype(numpy.float64)
+    w = r.uniform(-1, 1, (5, 4 * 4 * 3)).astype(numpy.float64)
+    padding = deconv.Deconv.compute_padding(8, 8, 4, 4, (2, 2))
+    on = conv_ops.deconv_forward_numpy(x, w, 4, 4, padding, (2, 2),
+                                       (2, 8, 8, 3))
+    oj = conv_ops.deconv_forward_jax(x, w, 4, 4, padding, (2, 2),
+                                     (2, 8, 8, 3))
+    assert numpy.abs(on - numpy.asarray(oj)).max() < 1e-10
+    err = r.uniform(-1, 1, (2, 8, 8, 3)).astype(numpy.float64)
+    ein, gwn = conv_ops.deconv_backward_numpy(x, err, w, 4, 4, padding,
+                                              (2, 2))
+    eij, gwj = conv_ops.deconv_backward_jax(x, err, w, 4, 4, padding, (2, 2))
+    assert numpy.abs(ein - numpy.asarray(eij)).max() < 1e-10
+    assert numpy.abs(gwn - numpy.asarray(gwj)).max() < 1e-10
+
+
+@pytest.mark.parametrize("device_cls", DEVICES)
+def test_depooling_scatters_to_offsets(device_cls):
+    device = device_cls()
+    r = numpy.random.RandomState(6)
+    x = r.uniform(-1, 1, (2, 6, 6, 2))
+    wf = DummyWorkflow()
+    mp = pool_units.MaxPooling(wf, kx=2, ky=2)
+    mp.input = Array(x.copy())
+    mp.link_from(wf.start_point)
+    mp.initialize(device=device)
+    mp.run()
+
+    dp = depooling.Depooling(wf)
+    dp.link_attrs(mp, ("input", "output"),
+                  ("output_offset", "input_offset"))
+    dp.output_shape_source = mp.input
+    dp.link_from(mp)
+    dp.initialize(device=device)
+    dp.run()
+    out = numpy.asarray(dp.output.mem)
+    assert out.shape == x.shape
+    # each pooled value lands exactly at its winning offset
+    flat = out.reshape(-1)
+    offs = numpy.asarray(mp.input_offset.mem).reshape(-1)
+    vals = numpy.asarray(mp.output.mem).reshape(-1)
+    assert numpy.abs(flat[offs] - vals).max() == 0
+    assert numpy.count_nonzero(out) <= offs.size
+
+
+def test_resizable_all2all_grow_shrink():
+    r = numpy.random.RandomState(7)
+    x = r.uniform(-1, 1, (4, 6))
+    wf = DummyWorkflow()
+    u = resizable_all2all.ResizableAll2All(
+        wf, output_sample_shape=(5,), weights_stddev=0.1, bias_stddev=0.1)
+    u.rand = prng.RandomGenerator().seed(3)
+    u.input = Array(x.copy())
+    u.link_from(wf.start_point)
+    u.initialize(device=NumpyDevice())
+    w_before = numpy.array(u.weights.mem)
+    u.output_sample_shape = (8,)
+    assert u.weights.shape == (8, 6)
+    assert numpy.abs(u.weights.mem[:5] - w_before).max() == 0
+    u.output_sample_shape = (3,)
+    assert u.weights.shape == (3, 6)
+    assert numpy.abs(u.weights.mem - w_before[:3]).max() == 0
+    u.run()
+    assert u.output.shape == (4, 3)
+
+
+def test_rprop_trains():
+    r = numpy.random.RandomState(8)
+    x = r.uniform(-1, 1, (8, 4))
+    err = r.uniform(-0.1, 0.1, (8, 3))
+    wf = DummyWorkflow()
+    fwd = all2all.All2All(wf, output_sample_shape=(3,),
+                          weights_stddev=0.1, bias_stddev=0.1)
+    fwd.rand = prng.RandomGenerator().seed(4)
+    fwd.input = Array(x.copy())
+    fwd.link_from(wf.start_point)
+    fwd.initialize(device=NumpyDevice())
+    fwd.run()
+    gd_u = rprop_gd.GDRProp(wf)
+    gd_u.err_output = Array(err.copy())
+    gd_u.link_attrs(fwd, "output", "input", "weights", "bias")
+    gd_u.initialize(device=NumpyDevice())
+    w0 = numpy.array(fwd.weights.mem)
+    gd_u.run()
+    w1 = numpy.array(fwd.weights.mem)
+    # every weight moved by exactly one lr step of the right sign
+    delta = w1 - w0
+    assert (numpy.abs(numpy.abs(delta) - 0.01) < 1e-12).all()
+    gd_u.run()
+    w2 = numpy.array(fwd.weights.mem)
+    assert numpy.abs(w2 - w1).max() > 0

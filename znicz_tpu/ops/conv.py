@@ -80,6 +80,75 @@ def backward_jax(inp, err_output, weights, ky, kx, padding, sliding,
     return (gx if need_err_input else None), grad_w, grad_b
 
 
+# -- deconv (transposed conv) -----------------------------------------------
+
+@partial(jax.jit, static_argnames=("ky", "kx", "padding", "sliding",
+                                   "out_shape"))
+def deconv_forward_jax(x, weights, ky, kx, padding, sliding, out_shape):
+    """Transposed conv: the col2im scatter of ``x @ W`` (reference
+    deconv.py — the forward is the conv's err_input computation)."""
+    w4 = _w4(weights, ky, kx, out_shape[3])
+    zeros = jnp.zeros(out_shape, dtype=x.dtype)
+    _, vjp = jax.vjp(
+        lambda z: _conv_linear_jax(z, w4, padding, sliding), zeros)
+    return vjp(x)[0]
+
+
+@partial(jax.jit, static_argnames=("batch_ny_nx", "ky", "kx", "padding",
+                                   "sliding", "out_shape"))
+def deconv_hits_jax(batch_ny_nx, ky, kx, padding, sliding, out_shape):
+    """Overlap counts per output cell (reference Deconv ``hits`` array for
+    unsafe padding)."""
+    b, ny, nx = batch_ny_nx
+    w1 = jnp.ones((1, ky, kx, 1))
+    zeros = jnp.zeros((b, out_shape[1], out_shape[2], 1))
+    _, vjp = jax.vjp(
+        lambda z: _conv_linear_jax(z, w1, padding, sliding), zeros)
+    return vjp(jnp.ones((b, ny, nx, 1)))[0][:, :, :, 0]
+
+
+def deconv_forward_numpy(x, weights, ky, kx, padding, sliding, out_shape):
+    b, ny, nx, k = x.shape
+    c = out_shape[3]
+    left, top = padding[0], padding[1]
+    gxp = numpy.zeros((b, top + out_shape[1] + padding[3],
+                       left + out_shape[2] + padding[2], c), dtype=x.dtype)
+    contrib = x @ weights  # (B, ny, nx, ky*kx*C)
+    for i in range(ny):
+        y1 = i * sliding[1]
+        for j in range(nx):
+            x1 = j * sliding[0]
+            gxp[:, y1:y1 + ky, x1:x1 + kx, :] += \
+                contrib[:, i, j, :].reshape(b, ky, kx, c)
+    return gxp[:, top:top + out_shape[1], left:left + out_shape[2], :]
+
+
+@partial(jax.jit, static_argnames=("ky", "kx", "padding", "sliding"))
+def deconv_backward_jax(inp, err_output, weights, ky, kx, padding, sliding):
+    """VJP of the transposed conv: returns (err_input, gradient_weights).
+
+    ``inp`` is the deconv's input (B, ny, nx, K); ``err_output`` lives in
+    the deconv's output space (B, sy, sx, C).
+    """
+    out_shape = tuple(err_output.shape)
+    _, vjp = jax.vjp(
+        lambda x, w: deconv_forward_jax(x, w, ky, kx, padding, sliding,
+                                        out_shape),
+        inp, weights)
+    return vjp(err_output)
+
+
+def deconv_backward_numpy(inp, err_output, weights, ky, kx, padding,
+                          sliding):
+    # err_input = conv(err_output, W); grad_w: roles of input/err swap
+    err_in = forward_numpy(err_output, weights, None, ky, kx, padding,
+                           sliding, include_bias=False)
+    _, grad_w, _ = backward_numpy(err_output, inp, weights, ky, kx, padding,
+                                  sliding, need_err_input=False,
+                                  include_bias=False)
+    return err_in, grad_w
+
+
 # -- numpy twins (the executable spec) --------------------------------------
 
 def _pad_numpy(x, padding):

@@ -72,10 +72,11 @@ class StandardWorkflow(StandardWorkflowBase):
                     "of %s" % (self.forwards[i], i,
                                self.layer_map[tpe].forward))
             try:
-                unit = next(self.layer_map[tpe].backwards)(self, **kwargs)
+                backward_cls = next(self.layer_map[tpe].backwards)
             except StopIteration:
                 units_to_delete.append(i)
                 continue
+            unit = backward_cls(self, **kwargs)
             self.gds[i] = unit
 
             if first_gd is not None:

@@ -16,5 +16,13 @@ from znicz_tpu.units import gd_pooling  # noqa: F401
 from znicz_tpu.units import activation  # noqa: F401
 from znicz_tpu.units import dropout  # noqa: F401
 from znicz_tpu.units import normalization  # noqa: F401
+from znicz_tpu.units import cutter  # noqa: F401
+from znicz_tpu.units import zerofilling  # noqa: F401
+from znicz_tpu.units import deconv  # noqa: F401
+from znicz_tpu.units import depooling  # noqa: F401
+from znicz_tpu.units import multiplier  # noqa: F401
+from znicz_tpu.units import summator  # noqa: F401
+from znicz_tpu.units import resizable_all2all  # noqa: F401
+from znicz_tpu.units import rprop_gd  # noqa: F401
 from znicz_tpu.units import evaluator  # noqa: F401
 from znicz_tpu.units import decision  # noqa: F401

@@ -22,6 +22,22 @@ class ConvolutionalBase(object):
         self.link_attrs(other, *self.CONV_ATTRS)
         return self
 
+    @property
+    def weights2d_host(self):
+        """(n_kernels, ky*kx*C) host view honoring weights_transposed.
+
+        True transpose (matching the jax path / cuBLAS transa semantics),
+        not the reference numpy path's reshape_transposed reinterpretation
+        (conv.py:335) which disagrees with its own GPU path.
+        """
+        w = self.weights.mem
+        return w.T if self.weights_transposed else w
+
+    @property
+    def weights2d_dev(self):
+        w = self.weights.dev
+        return w.T if self.weights_transposed else w
+
 
 class Conv(ConvolutionalBase, NNLayerBase):
     """Convolution with linear activation (reference conv.py:71-475)."""
@@ -103,15 +119,6 @@ class Conv(ConvolutionalBase, NNLayerBase):
         if not self.output or self.output.shape[0] != out_shape[0]:
             self.output.reset(numpy.zeros(out_shape, self.input.dtype))
 
-    @property
-    def _weights2d(self):
-        """(n_kernels, ky*kx*C) host view honoring weights_transposed."""
-        w = self.weights.mem
-        # True transpose (matching the jax path / cuBLAS transa semantics),
-        # not the reference numpy path's reshape_transposed reinterpretation
-        # (conv.py:335) which disagrees with its own GPU path.
-        return w.T if self.weights_transposed else w
-
     def numpy_run(self):
         self.input.map_read()
         self.weights.map_read()
@@ -119,18 +126,15 @@ class Conv(ConvolutionalBase, NNLayerBase):
             self.bias.map_read()
         self.output.map_invalidate()
         y = conv_ops.forward_numpy(
-            as_nhwc(self.input.mem), self._weights2d,
+            as_nhwc(self.input.mem), self.weights2d_host,
             self.bias.mem if self.include_bias else None,
             self.ky, self.kx, self.padding, self.sliding,
             activation=self.ACTIVATION, include_bias=self.include_bias)
         self.output.mem[...] = y
 
     def jax_run(self):
-        w = self.weights.dev
-        if self.weights_transposed:
-            w = w.T
         y = conv_ops.forward_jax(
-            as_nhwc(self.input.dev), w,
+            as_nhwc(self.input.dev), self.weights2d_dev,
             self.bias.dev if self.include_bias else None,
             self.ky, self.kx, self.padding, self.sliding,
             activation=self.ACTIVATION, include_bias=self.include_bias)

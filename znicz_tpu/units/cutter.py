@@ -1,0 +1,174 @@
+"""Input-cutting units.
+
+TPU-era equivalent of reference cutter.py (359 LoC — SURVEY.md §2.2).
+``Cutter`` crops a rectangle (padding = left, top, right, bottom kept
+margins); ``GDCutter`` pads the error back with zeros; ``Cutter1D`` is the
+generic strided 1D copy ``y = alpha*x + beta*y`` used as LSTM glue.
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+from znicz_tpu.units.nn_units import Forward, GradientDescentBase
+
+
+class CutterBase(object):
+    """padding property carrier (reference cutter.py:52-87)."""
+
+    def init_padding(self, kwargs):
+        self.padding = kwargs["padding"]
+
+    @property
+    def padding(self):
+        return self._padding
+
+    @padding.setter
+    def padding(self, value):
+        if value is None:
+            raise ValueError("padding may not be None")
+        if not isinstance(value, (tuple, list)):
+            raise TypeError("padding must be a tuple or list")
+        if len(value) != 4:
+            raise ValueError(
+                "padding must be (left, top, right, bottom)")
+        self._padding = tuple(value)
+
+    def compute_cut_shape(self, input_shape):
+        if len(input_shape) != 4:
+            raise ValueError("input must be (n_samples, sy, sx, n_channels)")
+        if self.padding[0] < 0 or self.padding[1] < 0:
+            raise ValueError("padding[0], padding[1] must be >= 0")
+        shape = list(input_shape)
+        shape[2] -= self.padding[0] + self.padding[2]
+        shape[1] -= self.padding[1] + self.padding[3]
+        if shape[2] <= 0 or shape[1] <= 0:
+            raise ValueError("Resulted output shape is empty")
+        return tuple(shape)
+
+
+class Cutter(CutterBase, Forward):
+    """Crops a rectangle from each sample (reference cutter.py:91-174)."""
+
+    MAPPING = {"cutter"}
+
+    def __init__(self, workflow, **kwargs):
+        super(Cutter, self).__init__(workflow, **kwargs)
+        self.init_padding(kwargs)
+        self.weights.reset()
+        self.bias.reset()
+        self.include_bias = False
+        self.exports.append("padding")
+
+    def initialize(self, device=None, **kwargs):
+        super(Cutter, self).initialize(device=device, **kwargs)
+        self.output_shape = self.compute_cut_shape(self.input.shape)
+        if self.output:
+            assert self.output.shape[1:] == self.output_shape[1:]
+        if not self.output or self.output.shape[0] != self.output_shape[0]:
+            self.output.reset(numpy.zeros(self.output_shape,
+                                          self.input.dtype))
+
+    def _crop(self, arr):
+        left, top = self.padding[0], self.padding[1]
+        return arr[:, top:top + self.output_shape[1],
+                   left:left + self.output_shape[2], :]
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self._crop(self.input.mem)
+
+    def jax_run(self):
+        self.output.set_dev(self._crop(self.input.dev))
+
+
+class GDCutter(CutterBase, GradientDescentBase):
+    """Pads the error back with zeros (reference cutter.py:177-260)."""
+
+    MAPPING = {"cutter"}
+
+    def __init__(self, workflow, **kwargs):
+        super(GDCutter, self).__init__(workflow, **kwargs)
+        self.init_padding(kwargs)
+
+    def initialize(self, device=None, **kwargs):
+        self.output_shape = self.compute_cut_shape(self.input.shape)
+        if self.err_output.size != int(numpy.prod(self.output_shape)):
+            raise ValueError(
+                "Computed err_output size differs from the assigned one")
+        super(GDCutter, self).initialize(device=device, **kwargs)
+
+    def numpy_run(self):
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        left, top = self.padding[0], self.padding[1]
+        out = self.err_output.mem.reshape(self.output_shape)
+        padded = numpy.zeros(self.input.shape, dtype=out.dtype)
+        padded[:, top:top + self.output_shape[1],
+               left:left + self.output_shape[2], :] = out
+        bp = padded * self.err_input_alpha
+        if self.err_input_beta:
+            bp = bp + self.err_input_beta * self.err_input.mem
+        self.err_input.mem[...] = bp
+
+    def jax_run(self):
+        import jax.numpy as jnp
+        left, top, right, bottom = self.padding
+        out = self.err_output.dev.reshape(self.output_shape)
+        padded = jnp.pad(
+            out, ((0, 0), (top, bottom), (left, right), (0, 0)))
+        bp = padded * self.err_input_alpha
+        if self.err_input_beta:
+            bp = bp + self.err_input_beta * self.err_input.dev
+        self.err_input.set_dev(bp)
+
+
+class Cutter1D(AcceleratedUnit):
+    """y[:, oo:oo+len] = alpha * x[:, io:io+len] + beta * y[...]
+    (reference cutter.py:263-359)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Cutter1D, self).__init__(workflow, **kwargs)
+        self.alpha = kwargs.get("alpha")
+        self.beta = kwargs.get("beta")
+        self.input_offset = kwargs.get("input_offset", 0)
+        self.output_offset = kwargs.get("output_offset", 0)
+        self.length = kwargs.get("length")
+        self.output = Array(name="output")
+        self.demand("alpha", "beta", "input", "length")
+
+    def initialize(self, device=None, **kwargs):
+        super(Cutter1D, self).initialize(device=device, **kwargs)
+        if not self.output or self.output.shape[0] != self.input.shape[0]:
+            self.output.reset(numpy.zeros(
+                (self.input.shape[0], self.output_offset + self.length),
+                dtype=self.input.dtype))
+        else:
+            assert self.output.sample_size >= \
+                self.output_offset + self.length
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_write()
+        out = self.output.matrix[
+            :, self.output_offset:self.output_offset + self.length]
+        if self.beta:
+            out *= self.beta
+        else:
+            out[:] = 0
+        out += self.input.matrix[
+            :, self.input_offset:self.input_offset + self.length] * \
+            self.alpha
+
+    def jax_run(self):
+        y = self.output.dev
+        y2 = y.reshape(y.shape[0], -1)
+        x2 = self.input.dev.reshape(self.input.shape[0], -1)
+        src = x2[:, self.input_offset:self.input_offset + self.length] * \
+            self.alpha
+        cur = y2[:, self.output_offset:self.output_offset + self.length]
+        patch = src + (cur * self.beta if self.beta else 0)
+        self.output.set_dev(
+            y2.at[:, self.output_offset:self.output_offset +
+                  self.length].set(patch).reshape(y.shape))

@@ -1,0 +1,169 @@
+"""Deconv (transposed convolution) — AE decoder counterpart of Conv.
+
+TPU-era equivalent of reference deconv.py (348 LoC — SURVEY.md §2.2).
+No bias; weights come from the paired Conv (``link_conv_attrs``); output
+shape from ``output_shape_source``.  Forward = col2im scatter of
+``input @ W`` (the conv's err_input computation); with ``unsafe_padding``
+overlap counts (``hits``) normalize the result.
+"""
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.units.conv import ConvolutionalBase
+from znicz_tpu.units.nn_units import Forward, GradientDescentBase, as_nhwc
+from znicz_tpu.ops import conv as conv_ops
+
+
+class Deconv(ConvolutionalBase, Forward):
+    """(reference deconv.py:55-347)"""
+
+    MAPPING = {"deconv"}
+
+    @staticmethod
+    def compute_padding(sx, sy, kx, ky, sliding):
+        """(reference deconv.py:91-99)"""
+        return (kx - sliding[1], ky - sliding[0],
+                kx - sx % sliding[1] if sx % sliding[1] != 0
+                else kx - sliding[1],
+                ky - sy % sliding[0] if sy % sliding[0] != 0
+                else ky - sliding[0])
+
+    @staticmethod
+    def check_padding_is_safe(kx, ky, sliding):
+        """(reference deconv.py:102-107)"""
+        if sliding[0] > (ky >> 1) or sliding[1] > (kx >> 1):
+            raise ValueError(
+                "sliding should not be greater than half of the kernel size")
+        # Deviation: the reference tests kx twice and never ky
+        # (deconv.py:105-107) — an unsafe ky slipped through as safe.
+        if kx % sliding[0] != 0 or ky % sliding[1] != 0:
+            raise ValueError("Kernel size should be multiple of sliding")
+
+    def __init__(self, workflow, **kwargs):
+        super(Deconv, self).__init__(workflow, **kwargs)
+        self.unsafe_padding = kwargs.get("unsafe_padding", False)
+        self.hits = Array(name="hits")
+        self.padding = kwargs.get("padding")
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        self.n_kernels = kwargs.get("n_kernels")
+        self.kx = kwargs.get("kx")
+        self.ky = kwargs.get("ky")
+        self.unpack_size = kwargs.get("unpack_size", 16)
+        self.include_bias = False
+        del self.bias
+        self.demand("n_kernels", "kx", "ky", "sliding", "input", "weights",
+                    "output_shape_source")
+
+    def initialize(self, device=None, **kwargs):
+        super(Deconv, self).initialize(device=device, **kwargs)
+        if hasattr(self, "bias"):
+            raise ValueError("bias should not be set")
+        if (len(self.input.shape) != 4 or
+                self.input.shape[3] != self.n_kernels):
+            raise ValueError("Incorrectly shaped input encountered")
+        weights_shape = (tuple(reversed(self.weights.shape))
+                         if self.weights_transposed else self.weights.shape)
+        if (len(weights_shape) != 2 or
+                weights_shape[0] != self.n_kernels or
+                weights_shape[1] % (self.kx * self.ky) != 0):
+            raise ValueError("Incorrectly shaped weights encountered")
+        output_shape = tuple(self.output_shape_source.shape)
+        if len(output_shape) != 4:
+            raise ValueError("Incorrect output_shape_source shape")
+        if output_shape[0] != self.input.shape[0]:
+            raise ValueError("output_shape_source.shape[0] != input.shape[0]")
+
+        try:
+            self.check_padding_is_safe(self.kx, self.ky, self.sliding)
+        except ValueError:
+            if not self.unsafe_padding:
+                raise
+            self.warning("The padding will be unsafe")
+
+        computed = self.compute_padding(
+            output_shape[2], output_shape[1], self.kx, self.ky, self.sliding)
+        if self.padding is None:
+            self.padding = computed
+        elif tuple(self.padding) != computed and not self.unsafe_padding:
+            raise ValueError(
+                "Expected padding %s but got %s" % (computed, self.padding))
+        self.padding = tuple(self.padding)
+
+        if not self.output or self.output.shape != output_shape:
+            self.output.reset(numpy.zeros(output_shape, self.input.dtype))
+        if self.unsafe_padding:
+            b, ny, nx = (self.input.shape[0], self.input.shape[1],
+                         self.input.shape[2])
+            hits = numpy.asarray(conv_ops.deconv_hits_jax(
+                (b, ny, nx), self.ky, self.kx, self.padding, self.sliding,
+                tuple(output_shape)))[:, :, :, None]
+            self.hits.reset(numpy.maximum(hits, 1).astype(self.input.dtype))
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.weights.map_read()
+        self.output.map_invalidate()
+        out = conv_ops.deconv_forward_numpy(
+            self.input.mem, self.weights2d_host, self.ky, self.kx,
+            self.padding, self.sliding, tuple(self.output.shape))
+        if self.unsafe_padding and self.hits:
+            out = out / self.hits.mem[:out.shape[0]]
+        self.output.mem[...] = out
+
+    def jax_run(self):
+        out = conv_ops.deconv_forward_jax(
+            self.input.dev, self.weights2d_dev, self.ky, self.kx,
+            self.padding, self.sliding, tuple(self.output.shape))
+        if self.unsafe_padding and self.hits:
+            out = out / self.hits.dev[:out.shape[0]]
+        self.output.set_dev(out)
+
+
+class GDDeconv(ConvolutionalBase, GradientDescentBase):
+    """Backward for Deconv (reference gd_deconv.py:53-409) — uses the conv
+    forward math of the paired geometry via the VJP of the deconv."""
+
+    MAPPING = {"deconv"}
+
+    def __init__(self, workflow, **kwargs):
+        super(GDDeconv, self).__init__(workflow, **kwargs)
+        self.include_bias = False
+        self.demand("weights", "n_kernels", "kx", "ky", "padding", "sliding")
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.weights.map_read()
+        self.err_output.map_read()
+        err_in, grad_w = conv_ops.deconv_backward_numpy(
+            as_nhwc(self.input.mem), as_nhwc(self.err_output.mem),
+            self.weights2d_host, self.ky, self.kx,
+            tuple(self.padding), tuple(self.sliding))
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            bp = err_in.reshape(self.input.shape) * self.err_input_alpha
+            if self.err_input_beta:
+                bp = bp + self.err_input_beta * self.err_input.mem
+            self.err_input.mem[...] = bp
+        if self.need_gradient_weights:
+            if self.weights_transposed:
+                grad_w = grad_w.T.reshape(self.weights.shape)
+            self.gradient_weights.map_write()
+            self.gradient_weights.mem[...] = grad_w
+            self._numpy_apply_update("weights")
+
+    def jax_run(self):
+        err_in, grad_w = conv_ops.deconv_backward_jax(
+            as_nhwc(self.input.dev), as_nhwc(self.err_output.dev),
+            self.weights2d_dev,
+            self.ky, self.kx, tuple(self.padding), tuple(self.sliding))
+        if self.need_err_input:
+            bp = err_in.reshape(self.input.shape) * self.err_input_alpha
+            if self.err_input_beta:
+                bp = bp + self.err_input_beta * self.err_input.dev
+            self.err_input.set_dev(bp)
+        if self.need_gradient_weights:
+            if self.weights_transposed:
+                grad_w = grad_w.T.reshape(self.weights.shape)
+            self.gradient_weights.set_dev(grad_w)
+            self._jax_apply_update("weights", grad_w)

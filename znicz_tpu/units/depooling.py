@@ -1,0 +1,49 @@
+"""Depooling — AE decoder counterpart of OffsetPooling.
+
+TPU-era equivalent of reference depooling.py (144 LoC): scatters the input
+into zeros at ``output_offset`` (the flat winner offsets recorded by the
+paired max/stochastic pooling, whose INPUT space is this unit's OUTPUT
+space; shape from ``output_shape_source``).
+"""
+
+import numpy
+
+from znicz_tpu.units.nn_units import Forward
+from znicz_tpu.ops import pooling as pool_ops
+
+
+class Depooling(Forward):
+    """(reference depooling.py:48-144)"""
+
+    MAPPING = {"depooling"}
+
+    def __init__(self, workflow, **kwargs):
+        super(Depooling, self).__init__(workflow, **kwargs)
+        self.weights.reset()
+        self.bias.reset()
+        self.include_bias = False
+        self.demand("input", "output_offset", "output_shape_source")
+
+    def initialize(self, device=None, **kwargs):
+        super(Depooling, self).initialize(device=device, **kwargs)
+        if self.output_offset.shape != self.input.shape:
+            raise ValueError("output_offset shape %s != input shape %s"
+                             % (self.output_offset.shape, self.input.shape))
+        output_shape = tuple(self.output_shape_source.shape)
+        if output_shape[0] != self.input.shape[0]:
+            raise ValueError("output_shape_source.shape[0] != input.shape[0]")
+        if not self.output or self.output.shape != output_shape:
+            self.output.reset(numpy.zeros(output_shape, self.input.dtype))
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output_offset.map_read()
+        self.output.map_invalidate()
+        # scatter = the max-pooling backward primitive with values as "err"
+        self.output.mem[...] = pool_ops.max_pooling_backward_numpy(
+            self.input.mem, self.output_offset.mem, self.output.shape)
+
+    def jax_run(self):
+        self.output.set_dev(pool_ops.max_pooling_backward_jax(
+            self.input.dev, self.output_offset.dev,
+            int(numpy.prod(self.output.shape)), tuple(self.output.shape)))

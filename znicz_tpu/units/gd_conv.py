@@ -41,14 +41,6 @@ class GradientDescentConv(ConvolutionalBase, GradientDescentBase):
             self.ACTIVATION, self.output.dev.reshape(self.err_output.shape))
         self.err_output.set_dev(self.err_output.dev * d)
 
-    @property
-    def _weights2d(self):
-        w = self.weights.mem
-        # True transpose (matching the jax path / cuBLAS transa semantics),
-        # not the reference numpy path's reshape_transposed reinterpretation
-        # (conv.py:335) which disagrees with its own GPU path.
-        return w.T if self.weights_transposed else w
-
     def numpy_run(self):
         self.numpy_err_output_update()
         self.input.map_read()
@@ -56,7 +48,7 @@ class GradientDescentConv(ConvolutionalBase, GradientDescentBase):
         self.err_output.map_read()
         err_in, grad_w, grad_b = conv_ops.backward_numpy(
             as_nhwc(self.input.mem), self.err_output.mem,
-            self._weights2d,
+            self.weights2d_host,
             self.ky, self.kx, self.padding, self.sliding,
             need_err_input=self.need_err_input,
             include_bias=self.include_bias and self.bias is not None)
@@ -79,11 +71,8 @@ class GradientDescentConv(ConvolutionalBase, GradientDescentBase):
 
     def jax_run(self):
         self.jax_err_output_update()
-        w = self.weights.dev
-        if self.weights_transposed:
-            w = w.T
         err_in, grad_w, grad_b = conv_ops.backward_jax(
-            as_nhwc(self.input.dev), self.err_output.dev, w,
+            as_nhwc(self.input.dev), self.err_output.dev, self.weights2d_dev,
             self.ky, self.kx, self.padding, self.sliding,
             need_err_input=self.need_err_input,
             include_bias=self.include_bias and self.bias is not None)

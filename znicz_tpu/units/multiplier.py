@@ -1,0 +1,64 @@
+"""Pointwise multiplier (LSTM glue).
+
+TPU-era equivalent of reference multiplier.py (182 LoC): ``output = x * y``;
+backward ``err_x = err_output * y``, ``err_y = err_output * x``.
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+
+
+class Multiplier(AcceleratedUnit):
+    """(reference multiplier.py:47-109)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(Multiplier, self).__init__(workflow, **kwargs)
+        self.output = Array(name="output")
+        self.demand("x", "y")
+
+    def initialize(self, device=None, **kwargs):
+        super(Multiplier, self).initialize(device=device, **kwargs)
+        if not self.output or self.output.shape[0] != self.x.shape[0]:
+            self.output.reset(numpy.zeros_like(self.x.mem))
+        assert self.output.shape == self.x.shape == self.y.shape
+
+    def numpy_run(self):
+        self.x.map_read()
+        self.y.map_read()
+        self.output.map_invalidate()
+        numpy.multiply(self.x.mem, self.y.mem, self.output.mem)
+
+    def jax_run(self):
+        self.output.set_dev(self.x.dev * self.y.dev)
+
+
+class GDMultiplier(AcceleratedUnit):
+    """(reference multiplier.py:112-182)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(GDMultiplier, self).__init__(workflow, **kwargs)
+        self.err_x = Array(name="err_x")
+        self.err_y = Array(name="err_y")
+        self.demand("x", "y", "err_output")
+
+    def initialize(self, device=None, **kwargs):
+        super(GDMultiplier, self).initialize(device=device, **kwargs)
+        for arr, src in ((self.err_x, self.x), (self.err_y, self.y)):
+            if not arr or arr.shape[0] != src.shape[0]:
+                arr.reset(numpy.zeros_like(src.mem))
+
+    def numpy_run(self):
+        self.x.map_read()
+        self.y.map_read()
+        self.err_output.map_read()
+        self.err_x.map_invalidate()
+        self.err_y.map_invalidate()
+        numpy.multiply(self.err_output.mem, self.y.mem, self.err_x.mem)
+        numpy.multiply(self.err_output.mem, self.x.mem, self.err_y.mem)
+
+    def jax_run(self):
+        err = self.err_output.dev
+        self.err_x.set_dev(err * self.y.dev)
+        self.err_y.set_dev(err * self.x.dev)

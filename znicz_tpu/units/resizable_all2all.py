@@ -1,0 +1,66 @@
+"""Resizable fully-connected layer — live topology change.
+
+TPU-era equivalent of reference resizable_all2all.py (80 LoC): setting
+``output_sample_shape`` after initialize grows (new rows filled from the
+unit's PRNG) or shrinks the weight matrix in place.
+"""
+
+import numpy
+
+from znicz_tpu.units.all2all import All2All
+
+
+class ResizableAll2All(All2All):
+    """(reference resizable_all2all.py:41-80)"""
+
+    MAPPING = {"all2all_resizable"}
+
+    @All2All.output_sample_shape.setter
+    def output_sample_shape(self, value):
+        old = self.neurons_number if self.initialized else 0
+        All2All.output_sample_shape.fset(self, value)
+        if not self.initialized:
+            return
+        if self.neurons_number <= 0:
+            raise ValueError(
+                "Neurons number must be greater than 0 (got %d)"
+                % self.neurons_number)
+        self._adjust_neurons_number(self.neurons_number - old)
+
+    def _adjust_neurons_number(self, delta):
+        if delta == 0:
+            return
+        if not self.weights_transposed:
+            old_nn = self.weights.shape[0]
+            new_w = numpy.zeros((old_nn + delta, self.weights.shape[1]),
+                                self.weights.dtype)
+            if delta > 0:
+                new_w[:old_nn] = self.weights.mem
+                self.fill_array(self.weights_filling, new_w[old_nn:],
+                                self.weights_stddev)
+            else:
+                new_w[:] = self.weights.mem[:new_w.shape[0]]
+        else:
+            old_nn = self.weights.shape[1]
+            new_w = numpy.zeros((self.weights.shape[0], old_nn + delta),
+                                self.weights.dtype)
+            if delta > 0:
+                new_w[:, :old_nn] = self.weights.mem
+                self.fill_array(self.weights_filling, new_w[:, old_nn:],
+                                self.weights_stddev)
+            else:
+                new_w[:] = self.weights.mem[:, :new_w.shape[1]]
+        self.weights.reset(new_w)
+        if self.include_bias and self.bias:
+            old_b = self.bias.mem
+            new_b = numpy.zeros(old_b.shape[0] + delta, self.bias.dtype)
+            if delta > 0:
+                new_b[:old_b.shape[0]] = old_b
+                self.fill_array(self.bias_filling, new_b[old_b.shape[0]:],
+                                self.bias_stddev)
+            else:
+                new_b[:] = old_b[:new_b.shape[0]]
+            self.bias.reset(new_b)
+        self.output.reset(numpy.zeros(
+            (self.input.shape[0],) + self.output_sample_shape,
+            dtype=self.input.dtype))

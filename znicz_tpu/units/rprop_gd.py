@@ -1,0 +1,86 @@
+"""RProp gradient descent — sign-based per-weight learning rates.
+
+TPU-era equivalent of reference rprop_gd.py (129 LoC), registered as
+"rprop_gd".  Per-element LR grows by ``increase`` while the gradient keeps
+its sign and shrinks by ``decrease`` on a sign flip; the update is
+``w -= sign(grad) * lr``.
+
+**Deviations from the reference, deliberately:** the reference initializes
+the per-weight LRs to zero (so the first clip snaps them to
+min_learning_rate=1e-6, freezing training) and drops the result of the
+decrease multiply (``lrs * decrease_ratios`` without assignment,
+rprop_gd.py:87,115).  Both are plain bugs; here LRs start at
+``initial_learning_rate`` and the decrease is applied.
+"""
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.units.gd import GradientDescent
+from znicz_tpu.ops import dense
+
+
+class GDRProp(GradientDescent):
+    """(reference rprop_gd.py:44-129)"""
+
+    MAPPING = {"rprop_gd"}
+
+    def __init__(self, workflow, **kwargs):
+        super(GDRProp, self).__init__(workflow, **kwargs)
+        self.initial_learning_rate = kwargs.get("initial_learning_rate",
+                                                0.01)
+        self.min_learning_rate = kwargs.get("min_learning_rate", 1e-6)
+        self.max_learning_rate = kwargs.get("max_learning_rate", 1.0)
+        self.increase = kwargs.get("increase", 1.05)
+        self.decrease = kwargs.get("decrease", 0.80)
+        self.weight_lrs = Array(name="weight_lrs")
+        self.bias_lrs = Array(name="bias_lrs")
+
+    def initialize(self, device=None, **kwargs):
+        super(GDRProp, self).initialize(device=device, **kwargs)
+        if not self.weight_lrs:
+            self.weight_lrs.reset(numpy.full_like(
+                self.weights.mem, self.initial_learning_rate))
+        if self.include_bias and self.bias and not self.bias_lrs:
+            self.bias_lrs.reset(numpy.full_like(
+                self.bias.mem, self.initial_learning_rate))
+
+    def _rprop_step(self, vec, lrs, grad_prev, grad):
+        """Shared RProp update; returns the new parameter value."""
+        sign = numpy.sign(grad)
+        delta_sign = numpy.sign(grad_prev * grad)
+        lrs *= numpy.where(delta_sign > 0, self.increase, 1.0)
+        lrs *= numpy.where(delta_sign < 0, self.decrease, 1.0)
+        lrs[:] = lrs.clip(self.min_learning_rate, self.max_learning_rate)
+        return vec - sign * lrs
+
+    def numpy_run(self):
+        self.numpy_err_output_update()
+        err_in, grad_w, grad_b = dense.backward_numpy(
+            self.input.mem, self.err_output.mem, self.weights.mem,
+            weights_transposed=self.weights_transposed,
+            need_err_input=self.need_err_input,
+            include_bias=self.include_bias and self.bias is not None)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = err_in
+        self.weights.map_write()
+        self.gradient_weights.map_write()
+        self.weight_lrs.map_write()
+        self.weights.mem[...] = self._rprop_step(
+            self.weights.mem, self.weight_lrs.mem,
+            self.gradient_weights.mem, grad_w)
+        self.gradient_weights.mem[...] = grad_w
+        if self.include_bias and self.bias:
+            self.bias.map_write()
+            self.gradient_bias.map_write()
+            self.bias_lrs.map_write()
+            self.bias.mem[...] = self._rprop_step(
+                self.bias.mem, self.bias_lrs.mem,
+                self.gradient_bias.mem, grad_b)
+            self.gradient_bias.mem[...] = grad_b
+
+    def jax_run(self):
+        # CPU-only in the reference (rprop_gd.py:47); the host path is
+        # cheap relative to the GEMMs, which still run through numpy BLAS.
+        self.numpy_run()

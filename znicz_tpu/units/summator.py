@@ -1,0 +1,61 @@
+"""Pointwise summator (LSTM glue).
+
+TPU-era equivalent of reference summator.py (162 LoC): ``output = x + y``;
+backward copies err_output into both err_x and err_y.
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+
+
+class Summator(AcceleratedUnit):
+    """(reference summator.py:47-109)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(Summator, self).__init__(workflow, **kwargs)
+        self.output = Array(name="output")
+        self.demand("x", "y")
+
+    def initialize(self, device=None, **kwargs):
+        super(Summator, self).initialize(device=device, **kwargs)
+        if not self.output or self.output.shape[0] != self.x.shape[0]:
+            self.output.reset(numpy.zeros_like(self.x.mem))
+        assert self.output.shape == self.x.shape == self.y.shape
+
+    def numpy_run(self):
+        self.x.map_read()
+        self.y.map_read()
+        self.output.map_invalidate()
+        numpy.add(self.x.mem, self.y.mem, self.output.mem)
+
+    def jax_run(self):
+        self.output.set_dev(self.x.dev + self.y.dev)
+
+
+class GDSummator(AcceleratedUnit):
+    """(reference summator.py:112-162)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(GDSummator, self).__init__(workflow, **kwargs)
+        self.err_x = Array(name="err_x")
+        self.err_y = Array(name="err_y")
+        self.demand("err_output")
+
+    def initialize(self, device=None, **kwargs):
+        super(GDSummator, self).initialize(device=device, **kwargs)
+        for arr in (self.err_x, self.err_y):
+            if not arr or arr.shape[0] != self.err_output.shape[0]:
+                arr.reset(numpy.zeros_like(self.err_output.mem))
+
+    def numpy_run(self):
+        self.err_output.map_read()
+        self.err_x.map_invalidate()
+        self.err_y.map_invalidate()
+        self.err_x.mem[...] = self.err_output.mem
+        self.err_y.mem[...] = self.err_output.mem
+
+    def jax_run(self):
+        self.err_x.set_dev(self.err_output.dev)
+        self.err_y.set_dev(self.err_output.dev)

@@ -1,0 +1,71 @@
+"""ZeroFiller — masks grouped weights to zero every step.
+
+TPU-era equivalent of reference weights_zerofilling.py (137 LoC).  Linked
+to the NEXT layer's weights by StandardWorkflowBase (the
+``LINKS_NEXT_WEIGHTS`` hook; reference standard_workflow_base.py:301-303).
+Used for grouped-convolution emulation.
+"""
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.units.nn_units import ForwardBase
+
+
+class ZeroFiller(ForwardBase):
+    """(reference weights_zerofilling.py:46-137)"""
+
+    MAPPING = {"zero_filter"}
+    #: StandardWorkflowBase links the next forward's weights into this unit
+    LINKS_NEXT_WEIGHTS = True
+
+    def __init__(self, workflow, **kwargs):
+        super(ZeroFiller, self).__init__(workflow, **kwargs)
+        self.mask = Array(name="mask")
+        self.grouping = kwargs.get("grouping", 2)
+        self.demand("weights")
+
+    @property
+    def effective_shape(self):
+        return (self.weights.shape[0],
+                self.weights.size // self.weights.shape[0])
+
+    @property
+    def grouping(self):
+        return self._grouping
+
+    @grouping.setter
+    def grouping(self, value):
+        if not isinstance(value, int):
+            raise TypeError("grouping must be an integer")
+        if value < 2:
+            raise ValueError("grouping value %d is invalid" % value)
+        self._grouping = value
+
+    def initialize(self, device=None, **kwargs):
+        super(ZeroFiller, self).initialize(device=device, **kwargs)
+        if not self.weights:
+            return True
+        if not self.mask:
+            if self.effective_shape[1] % self.grouping != 0:
+                raise ValueError(
+                    "Non-multiple of grouping weights shape: %s, grouping=%d"
+                    % (self.weights.shape, self.grouping))
+            kernels, chans = self.effective_shape
+            k = numpy.arange(kernels)[:, None] % self.grouping
+            c = numpy.arange(chans)[None, :] % self.grouping
+            self.mask.reset((k != c).astype(self.weights.dtype))
+        else:
+            assert self.mask.shape == self.effective_shape
+
+    def numpy_run(self):
+        self.mask.map_read()
+        self.weights.map_write()
+        w2 = self.weights.mem.reshape(self.effective_shape)
+        w2 *= self.mask.mem
+
+    def jax_run(self):
+        w = self.weights.dev
+        self.weights.set_dev(
+            (w.reshape(self.effective_shape) * self.mask.dev).reshape(
+                w.shape))
