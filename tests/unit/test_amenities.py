@@ -1,0 +1,178 @@
+"""LR schedules, rollback, accumulators, plotters, image saver."""
+
+import os
+
+import numpy
+import pytest
+
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.units import lr_adjust, nn_rollback, accumulator
+from znicz_tpu.units.image_saver import ImageSaver
+
+
+def test_lr_policies():
+    P = lr_adjust.LRAdjustPolicyRegistry.policies
+    assert set(P) >= {"exp", "fixed", "step_exp", "inv", "arbitrary_step"}
+    exp = P["exp"](0.1, gamma=0.5, a_ratio=1.0)
+    assert exp(0) == pytest.approx(0.1)
+    assert exp(2) == pytest.approx(0.1 * 0.25)
+    fixed = P["fixed"](0.1)
+    assert fixed(100) == 0.1
+    step = P["step_exp"](0.1, gamma=0.5, step=10)
+    assert step(9) == pytest.approx(0.1)
+    assert step(10) == pytest.approx(0.05)
+    inv = P["inv"](0.1, gamma=1.0, pow_ratio=1.0)
+    assert inv(1) == pytest.approx(0.05)
+    arb = P["arbitrary_step"](0.1, lrs_with_lengths=[(1, 2), (0.1, 3)])
+    assert arb(0) == pytest.approx(0.1)
+    assert arb(1) == pytest.approx(0.1)
+    assert arb(2) == pytest.approx(0.01)
+    assert arb(4) == pytest.approx(0.01)
+    assert arb(5) == 0.0
+
+
+class _FakeGD(object):
+    def __init__(self):
+        self.learning_rate = 0.1
+        self.learning_rate_bias = 0.2
+        self.gate_skip = Bool(False)
+        self.name = "fake_gd"
+        self.weights = Array(numpy.ones((3, 3)))
+        self.bias = Array(numpy.ones(3))
+        self.gradient_weights = Array(numpy.zeros((3, 3)))
+        self.gradient_bias = Array(numpy.zeros(3))
+
+
+def test_lr_adjust_unit():
+    wf = DummyWorkflow()
+    gd = _FakeGD()
+    adj = lr_adjust.LearningRateAdjust(
+        wf, lr_policy_name="step_exp",
+        lr_parameters={"gamma": 0.5, "step": 2})
+    adj.add_gd_unit(gd)
+    adj.run()
+    assert gd.learning_rate == pytest.approx(0.1)
+    adj.run()
+    adj.run()  # iteration index 2 -> gamma^1
+    assert gd.learning_rate == pytest.approx(0.05)
+    # bias untouched without a bias policy
+    assert gd.learning_rate_bias == 0.2
+
+
+def test_rollback_improve_then_diverge():
+    wf = DummyWorkflow()
+    gd = _FakeGD()
+    rb = nn_rollback.NNRollback(wf, minus_steps=2)
+    rb.add_gd(gd)
+    rb.improved = True
+    rb.run()  # stores weights, bumps lr
+    assert gd.learning_rate == pytest.approx(0.1 * 1.04)
+    stored = numpy.array(gd.weights.mem)
+
+    # diverge: trash the weights, two non-improved epochs trigger rollback
+    gd.weights.map_write()
+    gd.weights.mem[...] = 7.0
+    rb.improved = False
+    rb.run()
+    assert gd.weights.mem[0, 0] == 7.0  # not yet
+    rb.run()
+    assert numpy.abs(gd.weights.mem - stored).max() == 0
+    assert gd.learning_rate == pytest.approx(0.1 * 1.04 * 0.65)
+
+
+def test_rollback_nan_triggers_immediate_rollback():
+    wf = DummyWorkflow()
+    gd = _FakeGD()
+    rb = nn_rollback.NNRollback(wf, minus_steps=5)
+    rb.add_gd(gd)
+    rb.improved = True
+    rb.run()
+    stored = numpy.array(gd.weights.mem)
+    gd.weights.map_write()
+    gd.weights.mem[0, 0] = numpy.nan
+    rb.improved = False
+    rb.run()
+    assert numpy.abs(gd.weights.mem - stored).max() == 0
+
+
+def test_fix_accumulator():
+    wf = DummyWorkflow()
+    acc = accumulator.FixAccumulator(wf, bars=10, type="tanh")
+    acc.input = Array(numpy.array([-2.0, 0.0, 1.0, 2.0]))
+    acc.initialize()
+    acc.run()
+    hist = acc.output.mem
+    assert hist[0] >= 1          # -2 underflows
+    assert hist[11] == 1         # 2 overflows
+    assert hist.sum() == 4
+
+
+def test_range_accumulator():
+    wf = DummyWorkflow()
+    acc = accumulator.RangeAccumulator(wf, bars=4)
+    acc.input = Array(numpy.array([0.0, 1.0, 2.0, 3.0]))
+    acc.run()
+    assert sum(acc.y) == 4
+    acc.input.mem = numpy.array([4.0, 5.0])
+    acc.run()
+    assert sum(acc.y) == 6
+    assert acc.gl_max == 5.0
+    acc.reset_flag <<= True
+    acc.run()
+    assert acc.x_out  # squashed out
+
+
+def test_image_saver(tmp_path):
+    wf = DummyWorkflow()
+    sv = ImageSaver(wf, out_dirs=[str(tmp_path / c)
+                                  for c in ("t", "v", "tr")])
+    r = numpy.random.RandomState(0)
+    sv.input = Array(r.uniform(0, 1, (4, 8, 8)))
+    sv.indices = Array(numpy.arange(4, dtype=numpy.int32))
+    sv.labels = Array(numpy.array([0, 1, 2, 3], dtype=numpy.int32))
+    sv.max_idx = Array(numpy.array([0, 1, 0, 3], dtype=numpy.int32))
+    sv.minibatch_class = 2
+    sv.minibatch_size = 4
+    sv.run()
+    files = os.listdir(str(tmp_path / "tr"))
+    assert len(files) == 1  # only sample 2 was misclassified
+    assert files[0].startswith("2_as_0")
+
+
+def test_plotters_record(tmp_path):
+    from znicz_tpu.core import plotting_units as pu
+    from znicz_tpu.units.nn_plotting_units import Weights2D, MSEHistogram
+    wf = DummyWorkflow()
+    ap = pu.AccumulatingPlotter(wf, input_field=1)
+    ap.input = [None, 5.0, 1.0]
+    ap.run()
+    ap.input = [None, 3.0, 1.0]
+    ap.run()
+    assert ap.values == [5.0, 3.0]
+
+    mp = pu.MatrixPlotter(wf)
+    mp.input = Array(numpy.eye(3))
+    mp.run()
+    assert mp.current.shape == (3, 3)
+
+    w2 = Weights2D(wf, limit=4)
+    w2.input = Array(numpy.random.RandomState(1).uniform(-1, 1, (6, 16)))
+    w2.run()
+    assert len(w2.grid) == 4
+    assert w2.grid[0].shape == (4, 4)
+
+    mh = MSEHistogram(wf, bars=5)
+    mh.mse = Array(numpy.random.RandomState(2).uniform(0, 1, 50))
+    mh.run()
+    assert mh.hist.sum() == 50
+
+
+def test_similar_kernels():
+    from znicz_tpu.units.diversity import get_similar_kernels
+    r = numpy.random.RandomState(3)
+    w = r.uniform(-1, 1, (4, 27))
+    w[1] = w[0] + r.uniform(-1e-3, 1e-3, 27)  # near-duplicate pair
+    pairs = get_similar_kernels(w, channels=3)
+    assert (0, 1) in pairs

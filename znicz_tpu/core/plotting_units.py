@@ -1,0 +1,253 @@
+"""Plotting units — data-recording plotters with optional PNG rendering.
+
+TPU-era equivalent of the core ``veles.plotting_units`` API surface
+(SURVEY.md §2.9: AccumulatingPlotter, MatrixPlotter, MultiHistogram,
+ImagePlotter, ImmediatePlotter, TableMaxMin).  The reference streams to a
+matplotlib-backed web status server; here every plotter records its data
+(inspectable, testable) and — unless ``root.common.disable.plotting`` —
+renders a PNG into ``root.common.dirs.cache/plots`` on each redraw.
+"""
+
+import os
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.units import Unit
+
+
+class IPlotter(object):
+    """Marker interface (parity: veles.plotter.IPlotter)."""
+
+
+class Plotter(Unit, IPlotter):
+    """Base plotter: gather data in ``run``, render in ``redraw``."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "PLOTTER")
+        super(Plotter, self).__init__(workflow, **kwargs)
+        self.clear_plot = kwargs.get("clear_plot", False)
+        self.redraw_plot = kwargs.get("redraw_plot", True)
+        self._fig_path = None
+
+    @property
+    def plotting_enabled(self):
+        return not root.common.disable.plotting
+
+    def _figure(self):
+        import matplotlib
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        return plt
+
+    def _save_figure(self, plt):
+        out_dir = os.path.join(root.common.dirs.cache, "plots")
+        os.makedirs(out_dir, exist_ok=True)
+        self._fig_path = os.path.join(out_dir, "%s.png" % self.name)
+        plt.savefig(self._fig_path)
+        plt.close("all")
+
+    def run(self):
+        self.fill()
+        if self.plotting_enabled and self.redraw_plot:
+            self.redraw()
+
+    def fill(self):
+        pass
+
+    def redraw(self):
+        pass
+
+
+class AccumulatingPlotter(Plotter):
+    """Accumulates scalar values over time (error curves)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(AccumulatingPlotter, self).__init__(workflow, **kwargs)
+        self.plot_style = kwargs.get("plot_style", "r-")
+        self.label = kwargs.get("name", self.name)
+        self.input = None  # value source (attr or Array)
+        self.input_field = kwargs.get("input_field", None)
+        self.input_offset = kwargs.get("input_offset", 0)
+        self.values = []
+
+    def _current_value(self):
+        v = self.input
+        if self.input_field is not None:
+            if isinstance(v, (dict, list, tuple)):
+                v = v[self.input_field]
+            else:
+                v = getattr(v, self.input_field)
+        if v is None:
+            return None
+        arr = numpy.asarray(v)
+        if arr.ndim:
+            arr = arr.ravel()[self.input_offset]
+        return float(arr)
+
+    def fill(self):
+        v = self._current_value()
+        if v is not None:
+            self.values.append(v)
+
+    def redraw(self):
+        plt = self._figure()
+        plt.figure()
+        plt.plot(self.values, self.plot_style)
+        plt.title(self.label)
+        self._save_figure(plt)
+
+
+class MatrixPlotter(Plotter):
+    """Renders a matrix (confusion matrix)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MatrixPlotter, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.input_field = kwargs.get("input_field", None)
+        self.current = None
+
+    def fill(self):
+        v = self.input
+        if self.input_field is not None:
+            v = getattr(v, self.input_field) if not isinstance(v, dict) \
+                else v[self.input_field]
+        if hasattr(v, "mem"):
+            v.map_read()
+            v = v.mem
+        self.current = numpy.array(v)
+
+    def redraw(self):
+        if self.current is None:
+            return
+        plt = self._figure()
+        plt.figure()
+        plt.imshow(self.current, interpolation="nearest", cmap="viridis")
+        plt.colorbar()
+        plt.title(self.name)
+        self._save_figure(plt)
+
+
+class MultiHistogram(Plotter):
+    """Histograms of several weight rows."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MultiHistogram, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.hist_number = kwargs.get("hist_number", 16)
+        self.n_bars = kwargs.get("n_bars", 25)
+        self.histograms = []
+
+    def fill(self):
+        if self.input is None:
+            return
+        if hasattr(self.input, "map_read"):
+            self.input.map_read()
+            mem = self.input.mem
+        else:
+            mem = numpy.asarray(self.input)
+        rows = mem.reshape(mem.shape[0], -1)
+        self.histograms = [
+            numpy.histogram(rows[i], bins=self.n_bars)
+            for i in range(min(self.hist_number, rows.shape[0]))]
+
+    def redraw(self):
+        if not self.histograms:
+            return
+        plt = self._figure()
+        n = len(self.histograms)
+        cols = int(numpy.ceil(numpy.sqrt(n)))
+        rows_n = int(numpy.ceil(n / cols))
+        fig, axes = plt.subplots(rows_n, cols, squeeze=False)
+        for i, (hist, edges) in enumerate(self.histograms):
+            ax = axes[i // cols][i % cols]
+            ax.bar(edges[:-1], hist, width=numpy.diff(edges))
+        self._save_figure(plt)
+
+
+class ImagePlotter(Plotter):
+    """Renders input samples as images."""
+
+    def __init__(self, workflow, **kwargs):
+        super(ImagePlotter, self).__init__(workflow, **kwargs)
+        self.inputs = []
+        self.input_fields = []
+        self.current = None
+
+    def fill(self):
+        imgs = []
+        for v, field in zip(self.inputs,
+                            self.input_fields or [None] * len(self.inputs)):
+            if field is not None:
+                v = getattr(v, field)
+            if hasattr(v, "map_read"):
+                v.map_read()
+                v = v.mem
+            imgs.append(numpy.array(v))
+        self.current = imgs
+
+    def redraw(self):
+        if not self.current:
+            return
+        plt = self._figure()
+        fig, axes = plt.subplots(1, len(self.current), squeeze=False)
+        for ax, img in zip(axes[0], self.current):
+            img = numpy.squeeze(numpy.asarray(img, dtype=numpy.float64))
+            if img.ndim == 1:
+                ax.plot(img)
+            else:
+                ax.imshow(img if img.ndim == 2 else img[..., :3],
+                          cmap="gray")
+        self._save_figure(plt)
+
+
+class ImmediatePlotter(Plotter):
+    """Plots a list of 1D arrays each redraw."""
+
+    def __init__(self, workflow, **kwargs):
+        super(ImmediatePlotter, self).__init__(workflow, **kwargs)
+        self.inputs = []
+        self.input_fields = []
+        self.input_styles = kwargs.get("input_styles", ["k-", "g-", "b-"])
+        self.current = []
+
+    def fill(self):
+        series = []
+        for v, field in zip(self.inputs,
+                            self.input_fields or [None] * len(self.inputs)):
+            if field is not None:
+                v = getattr(v, field)
+            if hasattr(v, "map_read"):
+                v.map_read()
+                v = v.mem
+            series.append(numpy.array(v).ravel())
+        self.current = series
+
+    def redraw(self):
+        plt = self._figure()
+        plt.figure()
+        for arr, style in zip(self.current, self.input_styles):
+            plt.plot(arr, style)
+        self._save_figure(plt)
+
+
+class TableMaxMin(Plotter):
+    """Logs a table of max/min of given arrays."""
+
+    def __init__(self, workflow, y_max_rows=2, x_cols=1, **kwargs):
+        super(TableMaxMin, self).__init__(workflow, **kwargs)
+        self.y = []
+        self.col_labels = []
+        self.rows = []
+
+    def fill(self):
+        row = []
+        for v in self.y:
+            if hasattr(v, "map_read"):
+                v.map_read()
+                v = v.mem
+            arr = numpy.asarray(v)
+            row.append((float(arr.max()), float(arr.min())))
+        self.rows.append(row)
+        for label, (mx, mn) in zip(self.col_labels, row):
+            self.debug("%s: max %.6f min %.6f", label, mx, mn)

@@ -168,6 +168,104 @@ class StandardWorkflow(StandardWorkflowBase):
         self.repeater.link_from(*parents)
         return self.repeater
 
+    # -- training amenities (reference 533-600, 573-591) --------------------
+    def link_lr_adjuster(self, *parents, **kwargs):
+        """Per-iteration LR schedules on every GD unit
+        (reference standard_workflow.py:573-591)."""
+        from znicz_tpu.units.lr_adjust import LearningRateAdjust
+        cfg = self.config2kwargs(kwargs.pop("lr_adjuster_config", None)) \
+            or kwargs
+        self.lr_adjuster = LearningRateAdjust(
+            self, name="lr_adjuster", **cfg)
+        for gd in self.gds:
+            self.lr_adjuster.add_gd_unit(gd)
+        self.lr_adjuster.link_from(*parents)
+        return self.lr_adjuster
+
+    def link_rollback(self, *parents, **kwargs):
+        """Divergence recovery (reference standard_workflow.py:594-600)."""
+        from znicz_tpu.units.nn_rollback import NNRollback
+        self.rollback = NNRollback(self, name="rollback", **kwargs)
+        self.rollback.link_from(*parents)
+        self.rollback.link_attrs(self.decision, "improved")
+        self.rollback.gate_skip = ~self.loader.epoch_ended
+        for gd in self.gds:
+            self.rollback.add_gd(gd)
+        return self.rollback
+
+    def link_image_saver(self, *parents, **kwargs):
+        """Dump misclassified samples, gated on improvement
+        (reference standard_workflow.py:533-569)."""
+        from znicz_tpu.units.image_saver import ImageSaver
+        self.image_saver = ImageSaver(self, name="image_saver", **kwargs)
+        self.image_saver.link_from(*parents)
+        self.image_saver.link_attrs(self.forwards[-1], "output")
+        if self.loss_function == "softmax":
+            self.image_saver.link_attrs(self.forwards[-1], "max_idx")
+        self.image_saver.link_attrs(
+            self.loader,
+            ("input", "minibatch_data"),
+            ("indices", "minibatch_indices"),
+            ("labels", "minibatch_labels"),
+            "minibatch_class", "minibatch_size")
+        self.image_saver.gate_skip = ~self.decision.improved
+        return self.image_saver
+
+    def link_error_plotter(self, *parents):
+        """Per-epoch error curve (reference standard_workflow.py:672-700)."""
+        from znicz_tpu.core.plotting_units import AccumulatingPlotter
+        self.error_plotter = []
+        prev = parents
+        for i in (1, 2):  # validation, train
+            p = AccumulatingPlotter(self, name="error_%d" % i,
+                                    input_field=i)
+            p.input = self.decision.epoch_n_err_pt
+            p.link_from(*prev)
+            p.gate_skip = ~self.decision.epoch_ended
+            self.error_plotter.append(p)
+            prev = (p,)
+        return self.error_plotter[-1]
+
+    def link_weights_plotter(self, *parents, **kwargs):
+        """Weight-image grids per layer
+        (reference standard_workflow.py:853-891)."""
+        from znicz_tpu.units.nn_plotting_units import Weights2D
+        limit = kwargs.get("limit", 64)
+        self.weights_plotter = []
+        prev = parents
+        for i, fwd in enumerate(self.forwards):
+            # weight Arrays are still empty at link time; Weights2D.fill
+            # skips empty arrays at run time (weightless units stay empty)
+            if getattr(fwd, "weights", None) is None:
+                continue
+            p = Weights2D(self, name="weights_%d" % i, limit=limit)
+            p.input = fwd.weights
+            p.link_from(*prev)
+            p.gate_skip = ~self.decision.epoch_ended
+            self.weights_plotter.append(p)
+            prev = (p,)
+        return self.weights_plotter[-1] if self.weights_plotter \
+            else parents[0]
+
+    def link_conf_matrix_plotter(self, *parents):
+        """(reference standard_workflow.py:723-743)"""
+        from znicz_tpu.core.plotting_units import MatrixPlotter
+        self.conf_matrix_plotter = MatrixPlotter(
+            self, name="conf_matrix")
+        self.conf_matrix_plotter.input = self.evaluator.confusion_matrix
+        self.conf_matrix_plotter.link_from(*parents)
+        self.conf_matrix_plotter.gate_skip = ~self.decision.epoch_ended
+        return self.conf_matrix_plotter
+
+    def link_mse_plotter(self, *parents):
+        """(reference standard_workflow.py:702-721)"""
+        from znicz_tpu.units.nn_plotting_units import MSEHistogram
+        self.mse_plotter = MSEHistogram(self, name="mse_histogram")
+        self.mse_plotter.link_attrs(self.evaluator, "mse")
+        self.mse_plotter.link_from(*parents)
+        self.mse_plotter.gate_skip = ~self.decision.epoch_ended
+        return self.mse_plotter
+
     def link_end_point(self, *parents):
         self.end_point.link_from(*parents)
         self.end_point.gate_block = ~self.decision.complete

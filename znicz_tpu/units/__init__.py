@@ -26,3 +26,6 @@ from znicz_tpu.units import resizable_all2all  # noqa: F401
 from znicz_tpu.units import rprop_gd  # noqa: F401
 from znicz_tpu.units import evaluator  # noqa: F401
 from znicz_tpu.units import decision  # noqa: F401
+from znicz_tpu.units import lr_adjust  # noqa: F401
+from znicz_tpu.units import nn_rollback  # noqa: F401
+from znicz_tpu.units import accumulator  # noqa: F401

@@ -1,0 +1,47 @@
+"""DiffStats — pickles chosen arrays of chosen units over time.
+
+TPU-era equivalent of reference diff_stats.py (129 LoC — SURVEY.md §2.4):
+a gradient-debugging probe that appends snapshots of named attributes to a
+pickle file each run.
+"""
+
+import pickle
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.core.memory import Array
+
+import numpy
+
+
+class DiffStats(Unit):
+    """(reference diff_stats.py:48-129)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(DiffStats, self).__init__(workflow, **kwargs)
+        #: {unit: [attr names]} to record
+        self.arrays = kwargs.get("arrays", {})
+        self.file_name = kwargs.get("file_name", "diff_stats.pickle")
+        self.history = []
+
+    def run(self):
+        record = {}
+        for unit, names in self.arrays.items():
+            ustats = record.setdefault(unit.name, {})
+            for name in names:
+                arr = getattr(unit, name, None)
+                if isinstance(arr, Array) and arr:
+                    arr.map_read()
+                    mem = arr.mem
+                    ustats[name] = {
+                        "min": float(mem.min()), "max": float(mem.max()),
+                        "avg": float(mem.mean()),
+                        "std": float(mem.std()),
+                        "nans": int(numpy.isnan(mem).sum()),
+                    }
+        self.history.append(record)
+
+    def flush(self):
+        with open(self.file_name, "wb") as fout:
+            pickle.dump(self.history, fout)
+        self.info("wrote %d records to %s", len(self.history),
+                  self.file_name)

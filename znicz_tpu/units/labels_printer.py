@@ -1,0 +1,31 @@
+"""LabelsPrinter — inference-result printer for forward workflows.
+
+TPU-era equivalent of reference labels_printer.py (68 LoC — SURVEY.md
+§2.5): tallies predicted labels over the run and prints the distribution.
+"""
+
+from collections import Counter
+
+from znicz_tpu.core.units import Unit
+
+
+class LabelsPrinter(Unit):
+    """(reference labels_printer.py:45-68)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(LabelsPrinter, self).__init__(workflow, **kwargs)
+        self.top_number = kwargs.get("top_number", 5)
+        self.counter = Counter()
+        self.demand("input")  # max_idx of the softmax head
+
+    def run(self):
+        self.input.map_read()
+        for v in self.input.mem.ravel():
+            self.counter[int(v)] += 1
+
+    def print_top(self):
+        for label, count in self.counter.most_common(self.top_number):
+            self.info("label %d: %d samples", label, count)
+
+    def reset(self):
+        self.counter.clear()

@@ -1,0 +1,165 @@
+"""Learning-rate schedules applied per iteration to GD units.
+
+TPU-era equivalent of reference lr_adjust.py (302 LoC — SURVEY.md §2.4).
+Policies registered by name: exp, fixed, step_exp, inv, arbitrary_step.
+``LearningRateAdjust`` runs every minibatch before the GD units and
+rewrites their ``learning_rate``/``learning_rate_bias`` from the policy.
+"""
+
+import math
+
+from znicz_tpu.core.units import Unit
+
+
+class LRAdjustPolicyRegistry(type):
+    """(reference lr_adjust.py:55-57)"""
+
+    policies = {}
+
+    def __init__(cls, name, bases, clsdict):
+        super(LRAdjustPolicyRegistry, cls).__init__(name, bases, clsdict)
+        mapping = clsdict.get("MAPPING", None)
+        if mapping:
+            LRAdjustPolicyRegistry.policies[mapping] = cls
+
+
+class PolicyBase(object, metaclass=LRAdjustPolicyRegistry):
+    """A pickleable callable: iteration number -> learning rate."""
+
+
+class ExpPolicy(PolicyBase):
+    """LR = base * gamma^(a_ratio * iter) (reference lr_adjust.py:183)."""
+
+    MAPPING = "exp"
+
+    def __init__(self, lr_to_adjust, **kwargs):
+        self.base_lr = kwargs.get("base_lr", lr_to_adjust)
+        self.gamma = kwargs["gamma"]
+        self.a_ratio = kwargs["a_ratio"]
+
+    def __call__(self, itr):
+        return self.base_lr * (self.gamma ** (self.a_ratio * itr))
+
+
+class FixedAjustPolicy(PolicyBase):
+    """LR = base (reference lr_adjust.py:201)."""
+
+    MAPPING = "fixed"
+
+    def __init__(self, lr_to_adjust, **kwargs):
+        self.base_lr = kwargs.get("base_lr", lr_to_adjust)
+
+    def __call__(self, itr):
+        return self.base_lr
+
+
+class StepExpPolicy(PolicyBase):
+    """LR = base * gamma^floor(iter/step) (reference lr_adjust.py:217)."""
+
+    MAPPING = "step_exp"
+
+    def __init__(self, lr_to_adjust, **kwargs):
+        self.base_lr = kwargs.get("base_lr", lr_to_adjust)
+        self.gamma = kwargs["gamma"]
+        self.step = kwargs["step"]
+
+    def __call__(self, itr):
+        return self.base_lr * (
+            self.gamma ** math.floor(float(itr) / float(self.step)))
+
+
+class InvAdjustPolicy(PolicyBase):
+    """LR = base * (1 + gamma*iter)^-pow (reference lr_adjust.py:236)."""
+
+    MAPPING = "inv"
+
+    def __init__(self, lr_to_adjust, **kwargs):
+        self.base_lr = kwargs.get("base_lr", lr_to_adjust)
+        self.gamma = kwargs["gamma"]
+        self.pow_ratio = kwargs["pow_ratio"]
+
+    def __call__(self, itr):
+        return self.base_lr * (1.0 + self.gamma * itr) ** (-self.pow_ratio)
+
+
+class ArbitraryStepPolicy(PolicyBase):
+    """Piecewise LR from [(coeff, n_iters), ...] pairs
+    (reference lr_adjust.py:252 — used by the CIFAR caffe config)."""
+
+    MAPPING = "arbitrary_step"
+
+    def __init__(self, lr_to_adjust, **kwargs):
+        base_lr = kwargs.get("base_lr", lr_to_adjust)
+        lrs_with_lengths = kwargs["lrs_with_lengths"]
+        assert lrs_with_lengths is not None
+        self.bounds = []  # (first_iter_after_segment, lr)
+        cur = 0
+        for coeff, length in lrs_with_lengths:
+            assert coeff * base_lr >= 0
+            assert length > 0
+            cur += length
+            self.bounds.append((cur, coeff * base_lr))
+
+    def __call__(self, itr):
+        for bound, lr in self.bounds:
+            if itr < bound:
+                return lr
+        return 0.0  # past the schedule (reference: fill_value=0)
+
+
+class LearningRateAdjust(Unit):
+    """(reference lr_adjust.py:61-157)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(LearningRateAdjust, self).__init__(workflow, **kwargs)
+        self._gd_units = []
+        self._minibatches_count = 0
+        self.lr_policy_name = kwargs.get("lr_policy_name", None)
+        self.bias_lr_policy_name = kwargs.get("bias_lr_policy_name", None)
+        self.lr_parameters = kwargs.get("lr_parameters", {})
+        self.bias_lr_parameters = kwargs.get("bias_lr_parameters", {})
+        self._base_lr = {}
+        self._base_lr_bias = {}
+        self._got_base = False
+
+    @property
+    def has_policy(self):
+        return self.lr_policy_name is not None or \
+            self.bias_lr_policy_name is not None
+
+    def add_gd_unit(self, gd_unit):
+        self.gate_skip = gd_unit.gate_skip
+        self._gd_units.append(gd_unit)
+
+    def _adjusted(self, base, policy_name, params):
+        if policy_name is None:
+            return None
+        policy = LRAdjustPolicyRegistry.policies[policy_name](base, **params)
+        return float(policy(self._minibatches_count))
+
+    def run(self):
+        if self.is_slave:
+            return
+        if not self._got_base:
+            for gd in self._gd_units:
+                self._base_lr[gd] = gd.learning_rate
+                self._base_lr_bias[gd] = gd.learning_rate_bias
+            self._got_base = True
+        for gd in self._gd_units:
+            lr = self._adjusted(self._base_lr[gd], self.lr_policy_name,
+                                self.lr_parameters)
+            if lr is not None:
+                gd.learning_rate = lr
+            lr_bias = self._adjusted(
+                self._base_lr_bias[gd], self.bias_lr_policy_name,
+                self.bias_lr_parameters)
+            if lr_bias is not None:
+                gd.learning_rate_bias = lr_bias
+        self._minibatches_count += 1
+
+    # IDistributable stubs (reference lr_adjust.py:143-157)
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass

@@ -1,0 +1,116 @@
+"""NN-specific plotters.
+
+TPU-era equivalent of reference nn_plotting_units.py (902 LoC — SURVEY.md
+§2.5): ``Weights2D`` renders weight matrices as image grids;
+``MSEHistogram`` histograms per-sample MSE.  The Kohonen map plotters live
+with the Kohonen units.  Same record-then-render model as
+:mod:`znicz_tpu.core.plotting_units`.
+"""
+
+import numpy
+
+from znicz_tpu.core.plotting_units import Plotter
+
+
+class Weights2D(Plotter):
+    """Weight matrices as a grid of images
+    (reference nn_plotting_units.py:52-218)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Weights2D, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.input_field = kwargs.get("input_field", None)
+        self.limit = kwargs.get("limit", 64)
+        self.color_space = kwargs.get("color_space", "RGB")
+        self.transposed = kwargs.get("transposed", False)
+        self.grid = None
+
+    def _mem(self):
+        v = self.input
+        if self.input_field is not None:
+            v = getattr(v, self.input_field)
+        if hasattr(v, "map_read"):
+            v.map_read()
+            v = v.mem
+        return numpy.asarray(v)
+
+    @staticmethod
+    def normalize_image(a):
+        """(reference nn_plotting_units.py:166-184)"""
+        a = a.astype(numpy.float64)
+        lo, hi = a.min(), a.max()
+        if hi == lo:
+            return numpy.zeros_like(a)
+        return (a - lo) / (hi - lo)
+
+    def fill(self):
+        if self.input is None or \
+                (hasattr(self.input, "__bool__") and not self.input):
+            return
+        mem = self._mem()
+        if self.transposed:
+            mem = mem.T
+        mem = mem.reshape(mem.shape[0], -1)[:self.limit]
+        side = int(numpy.round(numpy.sqrt(mem.shape[1])))
+        rgb_side = int(numpy.round(numpy.sqrt(mem.shape[1] // 3))) \
+            if mem.shape[1] % 3 == 0 else 0
+        if side * side == mem.shape[1]:
+            imgs = [self.normalize_image(r.reshape(side, side))
+                    for r in mem]
+        elif rgb_side and rgb_side * rgb_side * 3 == mem.shape[1]:
+            imgs = [self.normalize_image(r.reshape(rgb_side, rgb_side, 3))
+                    for r in mem]
+        else:
+            imgs = [self.normalize_image(r.reshape(1, -1)) for r in mem]
+        self.grid = imgs
+
+    def redraw(self):
+        if not self.grid:
+            return
+        plt = self._figure()
+        n = len(self.grid)
+        cols = int(numpy.ceil(numpy.sqrt(n)))
+        rows = int(numpy.ceil(n / cols))
+        fig, axes = plt.subplots(rows, cols, squeeze=False)
+        for i in range(rows * cols):
+            ax = axes[i // cols][i % cols]
+            ax.axis("off")
+            if i < n:
+                img = self.grid[i]
+                ax.imshow(img, cmap="gray" if img.ndim == 2 else None)
+        self._save_figure(plt)
+
+
+class MSEHistogram(Plotter):
+    """Histogram of the evaluator's per-sample MSE
+    (reference nn_plotting_units.py:220-343)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MSEHistogram, self).__init__(workflow, **kwargs)
+        self.mse = None
+        self.bars = kwargs.get("bars", 35)
+        self.hist = None
+        self.edges = None
+        self.mse_min = None
+        self.mse_max = None
+        self.demand("mse")
+
+    def fill(self):
+        v = self.mse
+        if hasattr(v, "map_read"):
+            v.map_read()
+            v = v.mem
+        arr = numpy.asarray(v).ravel()
+        self.mse_min = float(arr.min())
+        self.mse_max = float(arr.max())
+        self.hist, self.edges = numpy.histogram(arr, bins=self.bars)
+
+    def redraw(self):
+        if self.hist is None:
+            return
+        plt = self._figure()
+        plt.figure()
+        plt.bar(self.edges[:-1], self.hist, width=numpy.diff(self.edges))
+        plt.title("%s [%.4g, %.4g]" % (self.name, self.mse_min,
+                                       self.mse_max))
+        self._save_figure(plt)
