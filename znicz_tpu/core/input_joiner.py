@@ -44,6 +44,13 @@ class InputJoiner(AcceleratedUnit):
         width = sum(a.sample_size for a in ins)
         self.output.reset(numpy.zeros((batch, width),
                                       dtype=ins[0].dtype))
+        # per-input slice geometry (offset_N / length_N) — consumed by the
+        # LSTM backward's Cutter1D glue (reference lstm.py:246-301)
+        off = 0
+        for i, a in enumerate(ins):
+            setattr(self, "offset_%d" % i, off)
+            setattr(self, "length_%d" % i, a.sample_size)
+            off += a.sample_size
 
     def numpy_run(self):
         ins = self._resolved_inputs()

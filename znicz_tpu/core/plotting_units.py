@@ -58,6 +58,22 @@ class Plotter(Unit, IPlotter):
     def redraw(self):
         pass
 
+    @staticmethod
+    def resolve(value, field=None):
+        """Shared input resolution: optional field lookup (attr or index),
+        Array map_read, numpy view."""
+        if field is not None:
+            if isinstance(value, (dict, list, tuple)):
+                value = value[field]
+            else:
+                value = getattr(value, field)
+        if value is None:
+            return None
+        if hasattr(value, "map_read"):
+            value.map_read()
+            value = value.mem
+        return numpy.asarray(value)
+
 
 class AccumulatingPlotter(Plotter):
     """Accumulates scalar values over time (error curves)."""
@@ -72,15 +88,11 @@ class AccumulatingPlotter(Plotter):
         self.values = []
 
     def _current_value(self):
-        v = self.input
-        if self.input_field is not None:
-            if isinstance(v, (dict, list, tuple)):
-                v = v[self.input_field]
-            else:
-                v = getattr(v, self.input_field)
-        if v is None:
+        arr = self.resolve(self.input, self.input_field)
+        if arr is None or (arr.ndim == 0 and arr == None):  # noqa: E711
             return None
-        arr = numpy.asarray(v)
+        if arr.dtype == object:
+            return None
         if arr.ndim:
             arr = arr.ravel()[self.input_offset]
         return float(arr)
@@ -108,14 +120,8 @@ class MatrixPlotter(Plotter):
         self.current = None
 
     def fill(self):
-        v = self.input
-        if self.input_field is not None:
-            v = getattr(v, self.input_field) if not isinstance(v, dict) \
-                else v[self.input_field]
-        if hasattr(v, "mem"):
-            v.map_read()
-            v = v.mem
-        self.current = numpy.array(v)
+        self.current = numpy.array(self.resolve(self.input,
+                                                self.input_field))
 
     def redraw(self):
         if self.current is None:
@@ -141,11 +147,7 @@ class MultiHistogram(Plotter):
     def fill(self):
         if self.input is None:
             return
-        if hasattr(self.input, "map_read"):
-            self.input.map_read()
-            mem = self.input.mem
-        else:
-            mem = numpy.asarray(self.input)
+        mem = self.resolve(self.input)
         rows = mem.reshape(mem.shape[0], -1)
         self.histograms = [
             numpy.histogram(rows[i], bins=self.n_bars)
@@ -175,16 +177,11 @@ class ImagePlotter(Plotter):
         self.current = None
 
     def fill(self):
-        imgs = []
-        for v, field in zip(self.inputs,
-                            self.input_fields or [None] * len(self.inputs)):
-            if field is not None:
-                v = getattr(v, field)
-            if hasattr(v, "map_read"):
-                v.map_read()
-                v = v.mem
-            imgs.append(numpy.array(v))
-        self.current = imgs
+        self.current = [
+            numpy.array(self.resolve(v, field))
+            for v, field in zip(
+                self.inputs,
+                self.input_fields or [None] * len(self.inputs))]
 
     def redraw(self):
         if not self.current:
@@ -212,16 +209,11 @@ class ImmediatePlotter(Plotter):
         self.current = []
 
     def fill(self):
-        series = []
-        for v, field in zip(self.inputs,
-                            self.input_fields or [None] * len(self.inputs)):
-            if field is not None:
-                v = getattr(v, field)
-            if hasattr(v, "map_read"):
-                v.map_read()
-                v = v.mem
-            series.append(numpy.array(v).ravel())
-        self.current = series
+        self.current = [
+            self.resolve(v, field).ravel()
+            for v, field in zip(
+                self.inputs,
+                self.input_fields or [None] * len(self.inputs))]
 
     def redraw(self):
         plt = self._figure()
@@ -243,10 +235,7 @@ class TableMaxMin(Plotter):
     def fill(self):
         row = []
         for v in self.y:
-            if hasattr(v, "map_read"):
-                v.map_read()
-                v = v.mem
-            arr = numpy.asarray(v)
+            arr = self.resolve(v)
             row.append((float(arr.max()), float(arr.min())))
         self.rows.append(row)
         for label, (mx, mn) in zip(self.col_labels, row):

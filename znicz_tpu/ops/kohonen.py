@@ -1,0 +1,98 @@
+"""Kohonen self-organizing map ops.
+
+TPU-era equivalent of the reference's OpenCL-only kohonen kernels
+(ocl/kohonen.cl — distance, argmin reduce, neighborhood gravity, gradient
+apply; SURVEY.md §2.2).  One jitted computation per trainer step: winner
+search, winner histogram, and the gravity-weighted batch gradient.
+
+Math parity (reference kohonen.py:473-496):
+  winner_i = argmin_j ||w_j - x_i||
+  gravity_ij = exp(-||coords_j - coords_winner_i||^2 / (2 sigma^2))
+  W += sum_i gravity_i[:, None] * (x_i - W) * gmult
+"""
+
+from functools import partial
+
+import numpy
+import jax
+import jax.numpy as jnp
+
+
+def make_coords(neurons_number):
+    """Hexagonal-ish grid in [-1, 1]^2 (reference kohonen.py:374-396)."""
+    sz = neurons_number
+    rows = int(numpy.round(numpy.sqrt(sz)))
+    cols = sz // rows
+    if sz % rows != 0:
+        cols += 1
+    coords = numpy.zeros((sz, 2))
+    x_min, x_max, y_min, y_max = -1.0, 1.0, -1.0, 1.0
+    x_step = (x_max - x_min) / (cols - 1) if cols > 1 else 0
+    y_step = (y_max - y_min) / (rows - 1) if rows > 1 else 0
+    y = y_min
+    offs = 0
+    for row in range(rows):
+        x = x_min + (x_step * 0.5 if row & 1 else 0)
+        for _col in range(cols):
+            if offs >= sz:
+                break
+            coords[offs, 0] = x
+            coords[offs, 1] = y
+            offs += 1
+            x += x_step
+        y += y_step
+    return coords
+
+
+@jax.jit
+def winners_jax(x, w):
+    """argmin_j ||w_j - x_i|| for each sample."""
+    x2 = x.reshape(x.shape[0], -1)
+    d2 = ((x2[:, None, :] - w[None, :, :]) ** 2).sum(axis=2)
+    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def train_step_jax(x, w, coords, sigma, gmult):
+    """Returns (new_w, winner_histogram, argmins)."""
+    x2 = x.reshape(x.shape[0], -1)
+    d2 = ((x2[:, None, :] - w[None, :, :]) ** 2).sum(axis=2)
+    argmins = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    hist = jnp.zeros(w.shape[0], jnp.int32).at[argmins].add(1)
+    cd2 = ((coords[:, None, :] - coords[None, :, :]) ** 2).sum(axis=2)
+    gravity = jnp.exp(cd2[argmins] / (-2.0 * sigma * sigma))  # (B, N)
+    # sum_i g_i[:,None] * (x_i - W) = G^T x - (G^T 1)[:,None] * W
+    gw = gravity.sum(axis=0)[:, None]
+    gradients = (gravity.T @ x2 - gw * w) * gmult
+    return w + gradients, hist, argmins
+
+
+def winners_numpy(x, w):
+    x2 = x.reshape(x.shape[0], -1)
+    out = numpy.empty(x2.shape[0], dtype=numpy.int32)
+    for i in range(x2.shape[0]):
+        dist = w - x2[i]
+        out[i] = numpy.argmin(numpy.linalg.norm(dist, axis=1))
+    return out
+
+
+def train_step_numpy(x, w, coords, sigma, gmult):
+    """Direct port of the reference loop (kohonen.py:473-496)."""
+    x2 = x.reshape(x.shape[0], -1)
+    neurons_number = w.shape[0]
+    hist = numpy.zeros(neurons_number, dtype=numpy.int32)
+    gradients = numpy.zeros(w.shape)
+    dists = numpy.empty(neurons_number)
+    argmins = numpy.empty(x2.shape[0], dtype=numpy.int32)
+    for i in range(x2.shape[0]):
+        dist = w - x2[i]
+        winner = int(numpy.argmin(numpy.linalg.norm(dist, axis=1)))
+        argmins[i] = winner
+        hist[winner] += 1
+        wc = coords[winner]
+        for n in range(neurons_number):
+            d = coords[n] - wc
+            dists[n] = numpy.sum(d * d)
+        gravity = numpy.exp(dists / (-2 * sigma * sigma))
+        gradients += gravity[:, None] * (x2[i] - w) * gmult
+    return w + gradients, hist, argmins

@@ -207,7 +207,7 @@ class StandardWorkflow(StandardWorkflowBase):
             ("input", "minibatch_data"),
             ("indices", "minibatch_indices"),
             ("labels", "minibatch_labels"),
-            "minibatch_class", "minibatch_size")
+            "minibatch_class", "minibatch_size", "epoch_number")
         self.image_saver.gate_skip = ~self.decision.improved
         return self.image_saver
 

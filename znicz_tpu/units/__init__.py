@@ -29,3 +29,6 @@ from znicz_tpu.units import decision  # noqa: F401
 from znicz_tpu.units import lr_adjust  # noqa: F401
 from znicz_tpu.units import nn_rollback  # noqa: F401
 from znicz_tpu.units import accumulator  # noqa: F401
+from znicz_tpu.units import kohonen  # noqa: F401
+from znicz_tpu.units import rbm_units  # noqa: F401
+from znicz_tpu.units import lstm  # noqa: F401

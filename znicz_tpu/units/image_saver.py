@@ -30,6 +30,8 @@ class ImageSaver(Unit):
         self.target = None
         self.max_idx = None
         self._n_saved = [0, 0, 0]
+        self._last_epoch = -1
+        self.epoch_number = 0  # linked from the loader
         self.demand("input", "indices", "labels",
                     "minibatch_class", "minibatch_size")
 
@@ -83,6 +85,10 @@ class ImageSaver(Unit):
         self._n_saved = [0, 0, 0]
 
     def run(self):
+        # new epoch (a new improvement, given the gate) -> fresh dump
+        if int(self.epoch_number) != self._last_epoch:
+            self.reset()
+            self._last_epoch = int(self.epoch_number)
         klass = int(self.minibatch_class)
         if self._n_saved[klass] >= self.limit:
             return

@@ -1,0 +1,309 @@
+"""Kohonen self-organizing map units.
+
+TPU-era equivalent of reference kohonen.py (723 LoC — SURVEY.md §2.2):
+``KohonenForward`` (winner lookup, with the optional overall ``total``
+table), ``KohonenTrainer`` (one fused winner+gravity+update step per
+minibatch with decaying radius/gradient schedules), ``KohonenDecision``
+(stops on weight-diff), ``KohonenValidator`` (greedy neuron-to-label
+assignment fitness).  Math in :mod:`znicz_tpu.ops.kohonen`.
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core import prng
+from znicz_tpu.core.units import Unit
+from znicz_tpu.units.decision import TrivialDecision
+from znicz_tpu.ops import kohonen as koh_ops
+
+
+class KohonenForward(AcceleratedUnit):
+    """(reference kohonen.py:72-258)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenForward, self).__init__(workflow, **kwargs)
+        self.demand("input", "weights")
+        self.argmins = None
+        self.output = Array(name="output")
+        self.total = Array() if kwargs.get("total", False) else None
+        if self.total is not None:
+            self.minibatch_offset = None
+            self.minibatch_size = None
+            self.batch_size = None
+
+    @property
+    def neurons_number(self):
+        return self.weights.shape[0]
+
+    @property
+    def sample_length(self):
+        return self.weights.shape[1]
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenForward, self).initialize(device=device, **kwargs)
+        assert self.input.sample_size == self.sample_length
+        batch_size = self.input.shape[0]
+        self.output.reset(numpy.zeros(batch_size, dtype=numpy.int32))
+        if self.total is not None:
+            self.total.reset(numpy.zeros(self.batch_size,
+                                         dtype=numpy.int32))
+
+    def _store(self, winners):
+        self.output.map_invalidate()
+        self.output.mem[:] = winners
+        if self.total is not None:
+            length = int(self.minibatch_size)
+            self.total.map_write()
+            for sindex in range(length):
+                index = sindex + int(self.minibatch_offset) - length
+                self.total.mem[index] = winners[sindex]
+
+    def numpy_run(self):
+        if self.argmins is not None:
+            self.argmins.map_read()
+            self._store(numpy.array(self.argmins.mem))
+            return
+        self.input.map_read()
+        self.weights.map_read()
+        self._store(koh_ops.winners_numpy(self.input.matrix,
+                                          self.weights.mem))
+
+    def jax_run(self):
+        if self.argmins is not None:
+            self._store(numpy.asarray(self.argmins.dev))
+            return
+        winners = koh_ops.winners_jax(self.input.dev, self.weights.dev)
+        self._store(numpy.asarray(winners))
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """(reference kohonen.py:259-535)"""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenTrainer, self).__init__(workflow, **kwargs)
+        self.argmins = Array(name="argmins")
+        self.weights = Array(name="weights")
+        self.winners = Array(name="winners")
+        self._coords = Array(name="coords")
+        self.weights_filling = kwargs.get("weights_filling", "uniform")
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.time = 0
+        self._sigma = 0
+        self.gradient_decay = kwargs.get(
+            "gradient_decay", lambda t: 0.1 / (1.0 + t * 0.05))
+        self.radius_decay = kwargs.get(
+            "radius_decay", lambda t: 1.0 / (1.0 + t * 0.05))
+        self.input_max_supposed = kwargs.get("input_max_supposed", 1.0)
+        self._shape = kwargs.get("shape")
+        self.demand("input", "shape")
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, value):
+        self._shape = value
+
+    @property
+    def gravity_radius(self):
+        return self.radius_decay(self.time) * self._sigma
+
+    @property
+    def gradient_multiplier(self):
+        return self.gradient_decay(self.time)
+
+    def _get_weights_magnitude(self):
+        """(reference kohonen.py:521-535)"""
+        d = self.input_max_supposed * self._sample_length
+        return 9.0 / d
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenTrainer, self).initialize(device=device, **kwargs)
+        self._neurons_number = self.shape[0] * self.shape[1]
+        self._sample_length = self.input.sample_size
+        if self.weights_stddev is None:
+            self.weights_stddev = min(self._get_weights_magnitude(), 0.05)
+        if not self.weights:
+            w = numpy.zeros(
+                (self._neurons_number, self._sample_length),
+                dtype=self.input.dtype)
+            if self.weights_filling == "uniform":
+                prng.get().fill(w, -self.weights_stddev,
+                                self.weights_stddev)
+            elif self.weights_filling == "gaussian":
+                prng.get().fill_normal_real(w, 0, self.weights_stddev)
+            else:
+                raise ValueError("Invalid weights_filling")
+            self.weights.reset(w)
+        else:
+            assert self.weights.shape == (self._neurons_number,
+                                          self._sample_length)
+        self.winners.reset(numpy.zeros(self._neurons_number, numpy.int32))
+        self.argmins.reset(numpy.zeros(self.input.shape[0], numpy.int32))
+        coords = koh_ops.make_coords(self._neurons_number)
+        self._coords.reset(coords.astype(self.weights.dtype))
+        self._sigma = (coords.ravel().max() - coords.ravel().min()) * 1.42
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.weights.map_write()
+        self.winners.map_write()
+        self.argmins.map_invalidate()
+        new_w, hist, argmins = koh_ops.train_step_numpy(
+            self.input.matrix, self.weights.mem, self._coords.mem,
+            self.gravity_radius, self.gradient_multiplier)
+        self.weights.mem[...] = new_w
+        self.winners.mem += hist
+        self.argmins.mem[...] = argmins
+        self.time += 1
+
+    def jax_run(self):
+        new_w, hist, argmins = koh_ops.train_step_jax(
+            self.input.dev, self.weights.dev, self._coords.dev,
+            self.gravity_radius, self.gradient_multiplier)
+        self.weights.set_dev(new_w)
+        self.winners.map_write()
+        self.winners.mem += numpy.asarray(hist)
+        self.argmins.set_dev(argmins)
+        self.time += 1
+
+
+class KohonenDecision(TrivialDecision):
+    """Stops on incremental weight-difference (reference 536-583)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenDecision, self).__init__(workflow, **kwargs)
+        self.weights_mem = numpy.empty((0, 0), dtype=numpy.float32)
+        self._prev_weights = numpy.empty((0, 0), dtype=numpy.float32)
+        self.winners_mem = numpy.empty((0, 0))
+        self.weights_min_diff = kwargs.get("weights_min_diff", 0)
+        self.demand("weights", "winners")
+
+    @property
+    def weights_diff(self):
+        if self.weights_mem.size * self._prev_weights.size == 0:
+            return numpy.inf
+        return float(numpy.linalg.norm(self.weights_mem -
+                                       self._prev_weights))
+
+    def on_training_finished(self):
+        self.weights.map_read()
+        self.winners.map_write()
+        self._prev_weights = self.weights_mem.copy()
+        if self.weights_mem.shape != self.weights.shape:
+            self.weights_mem = numpy.empty(self.weights.shape,
+                                           self.weights.dtype)
+        numpy.copyto(self.weights_mem, self.weights.mem)
+        if self.winners_mem.shape != self.winners.shape:
+            self.winners_mem = numpy.empty(self.winners.shape,
+                                           self.winners.dtype)
+        numpy.copyto(self.winners_mem, self.winners.mem)
+        self.winners.mem[:] = 0
+
+    def train_improve_condition(self):
+        if self.weights_diff < self.weights_min_diff:
+            return True
+        return super(KohonenDecision, self).train_improve_condition()
+
+    def fill_statistics(self, stats):
+        stats.append("weights diff: %f" % self.weights_diff)
+
+
+class KohonenValidator(Unit):
+    """Greedy neuron-to-label assignment fitness (reference 585-723)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenValidator, self).__init__(workflow, **kwargs)
+        self.demand("input", "minibatch_indices", "minibatch_size",
+                    "shape", "samples_by_label")
+        self.accumulated_input = []
+        self._fitness = 0
+        self._result = {}
+        self._fitness_by_label = {}
+        self._fitness_by_neuron = []
+        self._need_validate = True
+
+    @property
+    def neurons_count(self):
+        return self.shape[0] * self.shape[1]
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenValidator, self).initialize(device=device, **kwargs)
+        self.accumulated_input = [set() for _ in range(self.neurons_count)]
+        self._overall = sum(
+            len(m) for m in self.samples_by_label.values())
+        assert self._overall > 0
+
+    def reset(self):
+        for acc in self.accumulated_input:
+            acc.clear()
+        self._need_validate = True
+
+    def run(self):
+        self.input.map_read()
+        self.minibatch_indices.map_read()
+        for i in range(int(self.minibatch_size)):
+            self.accumulated_input[int(self.input[i])].add(
+                int(self.minibatch_indices[i]))
+        self._need_validate = True
+
+    @property
+    def result(self):
+        self._validate()
+        return self._result
+
+    @property
+    def fitness(self):
+        self._validate()
+        return self._fitness
+
+    @property
+    def fitness_by_label(self):
+        self._validate()
+        return self._fitness_by_label
+
+    @property
+    def fitness_by_neuron(self):
+        self._validate()
+        return self._fitness_by_neuron
+
+    def _validate(self):
+        """Greedy max-intersection assignment
+        (reference kohonen.py:675-723)."""
+        if not self._need_validate:
+            return
+        intersections = []
+        labels = sorted(self.samples_by_label)
+        for neuron in range(self.neurons_count):
+            for li, label in enumerate(labels):
+                members = self.samples_by_label[label]
+                intersections.append((
+                    len(self.accumulated_input[neuron] & set(members)),
+                    neuron, li))
+        intersections.sort(reverse=True)
+        self._result = {label: set() for label in labels}
+        fitted = 0
+        fitted_by_label = {label: 0 for label in labels}
+        fitted_by_neuron = [0] * self.neurons_count
+        banned = set()
+        for fit, neuron, li in intersections:
+            if fit <= 0 or len(banned) >= self.neurons_count:
+                break
+            if neuron in banned:
+                continue
+            label = labels[li]
+            fitted += fit
+            fitted_by_label[label] += fit
+            fitted_by_neuron[neuron] = fit
+            self._result[label].add(neuron)
+            banned.add(neuron)
+        self._fitness = fitted / self._overall
+        self._fitness_by_label = {
+            label: fitted_by_label[label] / len(members)
+            for label, members in self.samples_by_label.items()}
+        self._fitness_by_neuron = [
+            fitted_by_neuron[n] / len(wins) if len(wins) else 0
+            for n, wins in enumerate(self.accumulated_input)]
+        self._need_validate = False

@@ -120,6 +120,7 @@ class LearningRateAdjust(Unit):
         self.bias_lr_parameters = kwargs.get("bias_lr_parameters", {})
         self._base_lr = {}
         self._base_lr_bias = {}
+        self._policies = {}       # (id(gd), kind) -> policy instance
         self._got_base = False
 
     @property
@@ -131,10 +132,14 @@ class LearningRateAdjust(Unit):
         self.gate_skip = gd_unit.gate_skip
         self._gd_units.append(gd_unit)
 
-    def _adjusted(self, base, policy_name, params):
+    def _adjusted(self, gd, kind, base, policy_name, params):
         if policy_name is None:
             return None
-        policy = LRAdjustPolicyRegistry.policies[policy_name](base, **params)
+        key = (id(gd), kind)
+        policy = self._policies.get(key)
+        if policy is None:
+            policy = self._policies[key] = \
+                LRAdjustPolicyRegistry.policies[policy_name](base, **params)
         return float(policy(self._minibatches_count))
 
     def run(self):
@@ -146,12 +151,12 @@ class LearningRateAdjust(Unit):
                 self._base_lr_bias[gd] = gd.learning_rate_bias
             self._got_base = True
         for gd in self._gd_units:
-            lr = self._adjusted(self._base_lr[gd], self.lr_policy_name,
-                                self.lr_parameters)
+            lr = self._adjusted(gd, "w", self._base_lr[gd],
+                                self.lr_policy_name, self.lr_parameters)
             if lr is not None:
                 gd.learning_rate = lr
             lr_bias = self._adjusted(
-                self._base_lr_bias[gd], self.bias_lr_policy_name,
+                gd, "b", self._base_lr_bias[gd], self.bias_lr_policy_name,
                 self.bias_lr_parameters)
             if lr_bias is not None:
                 gd.learning_rate_bias = lr_bias

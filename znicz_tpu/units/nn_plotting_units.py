@@ -26,13 +26,7 @@ class Weights2D(Plotter):
         self.grid = None
 
     def _mem(self):
-        v = self.input
-        if self.input_field is not None:
-            v = getattr(v, self.input_field)
-        if hasattr(v, "map_read"):
-            v.map_read()
-            v = v.mem
-        return numpy.asarray(v)
+        return self.resolve(self.input, self.input_field)
 
     @staticmethod
     def normalize_image(a):
@@ -96,11 +90,7 @@ class MSEHistogram(Plotter):
         self.demand("mse")
 
     def fill(self):
-        v = self.mse
-        if hasattr(v, "map_read"):
-            v.map_read()
-            v = v.mem
-        arr = numpy.asarray(v).ravel()
+        arr = self.resolve(self.mse).ravel()
         self.mse_min = float(arr.min())
         self.mse_max = float(arr.max())
         self.hist, self.edges = numpy.histogram(arr, bins=self.bars)

@@ -80,7 +80,9 @@ class NNRollback(Unit):
             self._plus_steps = 0
             self._minus_steps = 0
             for gd, kv in self._gds.items():
-                k = kv.get("lr_plus") or self.lr_plus
+                k = kv.get("lr_plus")
+                if k is None:
+                    k = self.lr_plus
                 gd.learning_rate *= k
                 gd.learning_rate_bias *= k
                 self.debug("Increased lr of %r by %.2f, new lr %.2e",
@@ -105,7 +107,9 @@ class NNRollback(Unit):
             self._minus_steps = 0
             self._plus_steps = 0
             for gd, kv in self._gds.items():
-                k = kv.get("lr_minus") or self.lr_minus
+                k = kv.get("lr_minus")
+                if k is None:
+                    k = self.lr_minus
                 gd.learning_rate *= k
                 gd.learning_rate_bias *= k
                 self.debug("Decreased lr of %r by %.2f, new lr %.2e",

@@ -1,0 +1,324 @@
+"""Bernoulli RBM units — contrastive divergence from composable units.
+
+TPU-era equivalent of reference rbm_units.py (545 LoC — SURVEY.md §2.2):
+``Binarization`` (Bernoulli sampling with the matlab-binornd draw order),
+``IterationCounter``, ``BatchWeights`` (batch-averaged correlation stats),
+``GradientsCalculator`` (CD gradient = data stats - model stats),
+``WeightsUpdater``, ``MemCpy``, the ``GradientRBM`` CD-k Gibbs-sampling
+sub-workflow, and ``EvaluatorRBM`` (reconstruction MSE).
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core import prng
+from znicz_tpu.core.units import Unit
+from znicz_tpu.core.workflow import Workflow, Repeater
+from znicz_tpu.core.normalization import NoneNormalizer
+from znicz_tpu.units.all2all import All2AllSigmoid
+from znicz_tpu.units.evaluator import EvaluatorMSE
+
+
+class EmptyDeviceMethodsMixin(object):
+    """Units that run the same host code on every backend
+    (reference rbm_units.py:54-69)."""
+
+    def numpy_run(self):
+        pass
+
+    def jax_run(self):
+        pass
+
+
+class Binarization(AcceleratedUnit, EmptyDeviceMethodsMixin):
+    """B(i,j) ~ Bernoulli(A(i,j)) (reference rbm_units.py:72-152)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Binarization, self).__init__(workflow, **kwargs)
+        self.output = Array(name="output")
+        self.rand = kwargs.get("rand", prng.get())
+        self.demand("input", "batch_size")
+
+    def initialize(self, device=None, **kwargs):
+        super(Binarization, self).initialize(device=device, **kwargs)
+        if not self.output or self.output.size != self.input.size:
+            self.output.reset(numpy.zeros_like(self.input.mem))
+
+    def matlab_binornd(self, n, p_in):
+        """(reference rbm_units.py:112-152 — preserves the draw order)"""
+        p = numpy.copy(p_in)
+        if p.ndim == 2:
+            nrow, ncol = p.shape
+            p = p.transpose().flatten()
+            f = self.rand.rand(n, p.shape[0])
+            res = (f < p).sum(axis=0)
+            return res.reshape(ncol, nrow).transpose().reshape(nrow, ncol)
+        if p.ndim == 1:
+            f = self.rand.rand(n, p.shape[0])
+            return (f < p).sum(axis=0)
+        raise ValueError("Binarization input must be 1D or 2D")
+
+    def run(self):
+        self.output.map_invalidate()
+        self.input.map_read()
+        self.output.mem[:] = self.input.mem[:]
+        bs = int(self.batch_size)
+        self.output.mem[:bs, :] = self.matlab_binornd(
+            1, self.input.mem[:bs, :])
+
+
+class IterationCounter(Unit):
+    """Loop counter (reference rbm_units.py:155-179)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(IterationCounter, self).__init__(workflow, **kwargs)
+        self.max_iterations = kwargs["max_iterations"]
+        self.iteration = 0
+        self.complete = Bool(False)
+
+    def reset(self):
+        self.iteration = 0
+        self.complete <<= self.iteration > self.max_iterations
+
+    def initialize(self, device=None, **kwargs):
+        super(IterationCounter, self).initialize(device=device, **kwargs)
+        self.complete <<= self.iteration > self.max_iterations
+
+    def run(self):
+        self.iteration += 1
+        self.complete <<= self.iteration > self.max_iterations
+
+
+class BatchWeights(AcceleratedUnit, EmptyDeviceMethodsMixin):
+    """Batch-averaged v-h correlation + biases
+    (reference rbm_units.py:182-249)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(BatchWeights, self).__init__(workflow, **kwargs)
+        self.vbias_batch = Array()
+        self.hbias_batch = Array()
+        self.weights_batch = Array()
+        self.demand("v", "h", "batch_size")
+
+    def initialize(self, device=None, **kwargs):
+        super(BatchWeights, self).initialize(device=device, **kwargs)
+        vsize = self.v.sample_size
+        hsize = self.h.sample_size
+        if not self.hbias_batch:
+            self.hbias_batch.reset(numpy.zeros((1, hsize), self.h.dtype))
+        if not self.vbias_batch:
+            self.vbias_batch.reset(numpy.zeros((1, vsize), self.h.dtype))
+        if not self.weights_batch:
+            self.weights_batch.reset(numpy.zeros((vsize, hsize),
+                                                 self.h.dtype))
+
+    def run(self):
+        self.v.map_read()
+        self.h.map_read()
+        for a in (self.weights_batch, self.hbias_batch, self.vbias_batch):
+            a.map_invalidate()
+        bs = int(self.batch_size)
+        self.weights_batch.mem[:] = numpy.dot(
+            self.v.mem[:bs].T, self.h.mem[:bs]) / bs
+        self.vbias_batch.mem[:] = self.v.mem[:bs].sum(axis=0) / bs
+        self.hbias_batch.mem[:] = self.h.mem[:bs].sum(axis=0) / bs
+
+
+class BatchWeights2(BatchWeights):
+    """Dummy subclass — link_attrs aliasing workaround
+    (reference rbm_units.py:252-258)."""
+
+
+class GradientsCalculator(AcceleratedUnit, EmptyDeviceMethodsMixin):
+    """CD gradient = data stats - model stats
+    (reference rbm_units.py:261-336)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(GradientsCalculator, self).__init__(workflow, **kwargs)
+        self.vbias_grad = Array()
+        self.hbias_grad = Array()
+        self.weights_grad = Array()
+        self.demand("hbias1", "vbias1", "hbias0", "vbias0", "weights0",
+                    "weights1")
+
+    def initialize(self, device=None, **kwargs):
+        super(GradientsCalculator, self).initialize(device=device, **kwargs)
+        if not self.hbias_grad:
+            self.hbias_grad.reset(numpy.zeros(self.hbias0.shape,
+                                              self.hbias0.dtype))
+        if not self.vbias_grad:
+            self.vbias_grad.reset(numpy.zeros(self.vbias0.shape,
+                                              self.vbias0.dtype))
+        if not self.weights_grad:
+            self.weights_grad.reset(numpy.zeros(self.weights0.shape,
+                                                self.weights0.dtype))
+
+    def run(self):
+        for a in (self.hbias0, self.vbias0, self.weights0,
+                  self.hbias1, self.vbias1, self.weights1):
+            a.map_read()
+        for a in (self.weights_grad, self.vbias_grad, self.hbias_grad):
+            a.map_invalidate()
+        self.vbias_grad.mem[:] = self.vbias0.mem - self.vbias1.mem
+        self.hbias_grad.mem[:] = self.hbias0.mem - self.hbias1.mem
+        self.weights_grad.mem[:] = self.weights0.mem - self.weights1.mem
+
+
+class WeightsUpdater(Unit):
+    """w += lr * grad (reference rbm_units.py:338-364)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(WeightsUpdater, self).__init__(workflow, **kwargs)
+        self.learning_rate = kwargs["learning_rate"]
+        self.demand("hbias_grad", "vbias_grad", "weights_grad",
+                    "weights", "hbias", "vbias")
+
+    def run(self):
+        for a in (self.hbias_grad, self.vbias_grad, self.weights_grad):
+            a.map_read()
+        for a in (self.weights, self.hbias, self.vbias):
+            a.map_write()
+        self.weights.mem += self.learning_rate * self.weights_grad.mem.T
+        self.hbias.mem += self.learning_rate * \
+            self.hbias_grad.mem.reshape(self.hbias.shape)
+        self.vbias.mem += self.learning_rate * \
+            self.vbias_grad.mem.reshape(self.vbias.shape)
+
+
+class MemCpy(AcceleratedUnit):
+    """output = copy(input) (reference rbm_units.py:366-405)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MemCpy, self).__init__(workflow, **kwargs)
+        self.output = Array(name="output")
+        self.demand("input")
+
+    def initialize(self, device=None, **kwargs):
+        super(MemCpy, self).initialize(device=device, **kwargs)
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(numpy.zeros_like(self.input.mem))
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[:] = self.input.mem
+
+    def jax_run(self):
+        self.output.set_dev(self.input.dev)
+
+
+class All2AllSigmoidH(All2AllSigmoid):
+    """Dummy subclass — link_attrs aliasing workaround."""
+    MAPPING = set()
+    hide_from_registry = True
+
+
+class All2AllSigmoidV(All2AllSigmoid):
+    MAPPING = set()
+    hide_from_registry = True
+
+
+class BinarizationGradH(Binarization):
+    pass
+
+
+class BinarizationGradV(Binarization):
+    pass
+
+
+class GradientRBM(Workflow):
+    """CD-k Gibbs sampling built from units
+    (reference rbm_units.py:441-501; algorithm:
+    deeplearning.net/tutorial/rbm.html)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(GradientRBM, self).__init__(workflow, **kwargs)
+        self.stddev = kwargs["stddev"]
+        self.batch_size = -1
+        self.mem_cpy = MemCpy(self)
+        self.mem_cpy.link_from(self.start_point)
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.mem_cpy)
+        self.decision = IterationCounter(self,
+                                         max_iterations=kwargs["cd_k"])
+        self.decision.link_from(self.repeater)
+        self.bino_h = BinarizationGradH(
+            self, rand=kwargs.get("rand_h", prng.get()))
+        self.bino_h.link_attrs(self.mem_cpy, ("input", "output"))
+        self.bino_h.link_from(self.decision)
+        self.bino_h.gate_block = self.decision.complete
+        self.make_v = All2AllSigmoidV(
+            self, weights_stddev=self.stddev, weights_transposed=True,
+            output_sample_shape=kwargs["v_size"])
+        self.make_v.link_from(self.bino_h)
+        self.make_v.link_attrs(self.bino_h, ("input", "output"))
+        self.bino_v = BinarizationGradV(
+            self, rand=kwargs.get("rand_v", prng.get()))
+        self.bino_v.link_attrs(self.make_v, ("input", "output"))
+        self.bino_v.link_from(self.make_v)
+        self.make_h = All2AllSigmoidH(
+            self, weights_stddev=self.stddev,
+            output_sample_shape=kwargs["h_size"])
+        self.make_h.link_attrs(self.bino_v, ("input", "output"))
+        self.make_h.output = self.mem_cpy.output
+        self.make_h.link_from(self.bino_v)
+        self.repeater.link_from(self.make_h)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+        self.mem_cpy.link_attrs(self, "input")
+        self.bino_h.link_attrs(self, "batch_size")
+        self.bino_v.link_attrs(self, "batch_size")
+        self.make_v.link_attrs(self, "weights")
+        self.make_v.link_attrs(self, ("bias", "vbias"))
+        self.make_h.link_attrs(self, "weights")
+        self.make_h.link_attrs(self, ("bias", "hbias"))
+        self.link_attrs(self.make_h, "output")
+        self.link_attrs(self.bino_v, ("v1", "output"))
+        self.link_attrs(self.make_h, ("h1", "output"))
+        self.demand("input", "weights", "hbias", "vbias", "batch_size")
+
+    def run(self):
+        self.decision.reset()
+        return super(GradientRBM, self).run()
+
+
+class All2AllSigmoidWithForeignWeights(All2AllSigmoid):
+    MAPPING = set()
+    hide_from_registry = True
+
+
+class BinarizationEval(Binarization):
+    pass
+
+
+class EvaluatorRBM(Workflow):
+    """Reconstruction-MSE evaluator (reference rbm_units.py:518-545)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorRBM, self).__init__(workflow, **kwargs)
+        self.binarization = BinarizationEval(
+            self, rand=kwargs.get("rand", prng.get()))
+        self.binarization.link_from(self.start_point)
+        self.rec = All2AllSigmoidWithForeignWeights(
+            self, output_sample_shape=kwargs["bias_shape"],
+            weights_transposed=True)
+        self.rec.link_from(self.binarization)
+        self.rec.link_attrs(self.binarization, ("input", "output"))
+        self.mse = EvaluatorMSE(self, root=False, mean=False)
+        self.mse.link_from(self.rec)
+        self.mse.link_attrs(self.rec, "output")
+        self.mse.normalizer = NoneNormalizer()
+        self.end_point.link_from(self.mse)
+
+        self.binarization.link_attrs(self, "input", "batch_size")
+        self.rec.link_attrs(self, "weights")
+        self.mse.link_attrs(self, "target", "batch_size")
+        self.link_attrs(self.rec, ("vbias", "bias"))
+        self.demand("input", "weights", "target")
+
+    @property
+    def output(self):
+        return self.vbias

@@ -20,13 +20,24 @@ class Summator(AcceleratedUnit):
 
     def initialize(self, device=None, **kwargs):
         super(Summator, self).initialize(device=device, **kwargs)
-        if not self.output or self.output.shape[0] != self.x.shape[0]:
-            self.output.reset(numpy.zeros_like(self.x.mem))
+        # inputs may not be allocated yet (LSTM wiring) — defer to run
+        # (reference multiplier.py:56-64)
+        src = self.x if self.x else self.y
+        if src and (not self.output or
+                    self.output.shape[0] != src.shape[0]):
+            self.output.reset(numpy.zeros_like(src.mem))
+        if not self.x or not self.y:
+            return
         assert self.output.shape == self.x.shape == self.y.shape
+
+    def _ensure_output(self):
+        if not self.output or self.output.shape != self.x.shape:
+            self.output.reset(numpy.zeros_like(self.x.mem))
 
     def numpy_run(self):
         self.x.map_read()
         self.y.map_read()
+        self._ensure_output()
         self.output.map_invalidate()
         numpy.add(self.x.mem, self.y.mem, self.output.mem)
 
@@ -46,11 +57,18 @@ class GDSummator(AcceleratedUnit):
     def initialize(self, device=None, **kwargs):
         super(GDSummator, self).initialize(device=device, **kwargs)
         for arr in (self.err_x, self.err_y):
-            if not arr or arr.shape[0] != self.err_output.shape[0]:
+            if self.err_output and (
+                    not arr or arr.shape[0] != self.err_output.shape[0]):
+                arr.reset(numpy.zeros_like(self.err_output.mem))
+
+    def _ensure_errs(self):
+        for arr in (self.err_x, self.err_y):
+            if not arr or arr.shape != self.err_output.shape:
                 arr.reset(numpy.zeros_like(self.err_output.mem))
 
     def numpy_run(self):
         self.err_output.map_read()
+        self._ensure_errs()
         self.err_x.map_invalidate()
         self.err_y.map_invalidate()
         self.err_x.mem[...] = self.err_output.mem
