@@ -102,6 +102,23 @@ class LinearNormalizer(NormalizerBase):
         return data
 
 
+@register("internal_mean")
+class InternalMeanNormalizer(NormalizerBase):
+    """Subtract the training set's mean sample (Caffe-style; reference
+    "internal_mean", used by the CIFAR caffe config)."""
+
+    def analyze(self, data):
+        self.state = {"mean": data.mean(axis=0)}
+
+    def normalize(self, data):
+        data -= self.state["mean"].reshape(1, -1)
+        return data
+
+    def denormalize(self, data):
+        data += self.state["mean"].reshape(1, -1)
+        return data
+
+
 @register("mean_disp")
 class MeanDispNormalizer(NormalizerBase):
     """Subtract per-feature mean, divide by per-feature dispersion

@@ -1,0 +1,122 @@
+"""CIFAR-10 sample — the Caffe-style ConvNet (baseline 17.21% val err).
+
+Parity target: reference samples/CIFAR10/cifar_caffe_config.py — conv
+32C5(pad 2) -> MP3/2 -> strict relu -> LRN -> conv 32C5 -> relu -> AP3/2
+-> LRN -> conv 64C5 -> relu -> AP3/2 -> softmax(10), gaussian fillings,
+momentum 0.9, arbitrary_step LR schedule.  Exercises standalone
+activation layers and LRN inside StandardWorkflow.
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.standard_workflow import StandardWorkflow
+import znicz_tpu.loader.loader_cifar  # noqa: F401 (registers cifar_loader)
+
+
+root.cifar.update({
+    "decision": {"fail_iterations": 250, "max_epochs": 1000000000},
+    "lr_adjuster": {"do": True, "lr_policy_name": "arbitrary_step",
+                    "bias_lr_policy_name": "arbitrary_step",
+                    "lr_parameters": {
+                        "lrs_with_lengths":
+                            [(1, 60000), (0.1, 5000), (0.01, 100000000)]},
+                    "bias_lr_parameters": {
+                        "lrs_with_lengths":
+                            [(1, 60000), (0.1, 5000), (0.01, 100000000)]}},
+    "snapshotter": {"prefix": "cifar_caffe", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loss_function": "softmax",
+    "loader_name": "cifar_loader",
+    "loader": {"minibatch_size": 100,
+               "normalization_type": "internal_mean",
+               "shuffle_limit": 2000000000},
+    "layers": [
+        {"name": "conv1", "type": "conv",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5,
+                "padding": (2, 2, 2, 2), "sliding": (1, 1),
+                "weights_filling": "gaussian", "weights_stddev": 0.0001,
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": {"learning_rate": 0.001, "learning_rate_bias": 0.002,
+                "weights_decay": 0.0005, "weights_decay_bias": 0.0005,
+                "factor_ortho": 0.001, "gradient_moment": 0.9,
+                "gradient_moment_bias": 0.9}},
+        {"name": "pool1", "type": "max_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "relu1", "type": "activation_str"},
+        {"name": "norm1", "type": "norm",
+         "alpha": 0.00005, "beta": 0.75, "n": 3, "k": 1},
+        {"name": "conv2", "type": "conv",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5,
+                "padding": (2, 2, 2, 2), "sliding": (1, 1),
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": {"learning_rate": 0.001, "learning_rate_bias": 0.002,
+                "weights_decay": 0.0005, "weights_decay_bias": 0.0005,
+                "factor_ortho": 0.001, "gradient_moment": 0.9,
+                "gradient_moment_bias": 0.9}},
+        {"name": "relu2", "type": "activation_str"},
+        {"name": "pool2", "type": "avg_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "norm2", "type": "norm",
+         "alpha": 0.00005, "beta": 0.75, "n": 3, "k": 1},
+        {"name": "conv3", "type": "conv",
+         "->": {"n_kernels": 64, "kx": 5, "ky": 5,
+                "padding": (2, 2, 2, 2), "sliding": (1, 1),
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": {"learning_rate": 0.001, "learning_rate_bias": 0.001,
+                "weights_decay": 0.0005, "weights_decay_bias": 0.0005,
+                "factor_ortho": 0.001, "gradient_moment": 0.9,
+                "gradient_moment_bias": 0.9}},
+        {"name": "relu3", "type": "activation_str"},
+        {"name": "pool3", "type": "avg_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "fc_softmax4", "type": "softmax",
+         "->": {"output_sample_shape": 10,
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": {"learning_rate": 0.001, "learning_rate_bias": 0.002,
+                "weights_decay": 1.0, "weights_decay_bias": 0,
+                "gradient_moment": 0.9, "gradient_moment_bias": 0.9}}],
+})
+
+
+class CifarWorkflow(StandardWorkflow):
+    """(reference samples/CIFAR10/cifar.py:69-104)"""
+
+    def create_workflow(self):
+        super(CifarWorkflow, self).create_workflow()
+        adj_cfg = root.cifar.lr_adjuster.as_dict()
+        if adj_cfg.pop("do", False):
+            # schedule applies per minibatch before the GD units fire
+            self.link_lr_adjuster(self.snapshotter, **adj_cfg)
+            # re-route: gds were linked from snapshotter; insert adjuster
+            self.gds[-1].unlink_from(self.snapshotter)
+            self.gds[-1].link_from(self.lr_adjuster)
+
+
+def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+    cfg = root.cifar
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    kwargs.setdefault("loss_function", cfg.loss_function)
+    return CifarWorkflow(
+        layers=layers if layers is not None else cfg.layers,
+        loader_name=cfg.loader_name,
+        loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=cfg.snapshotter.as_dict(),
+        **kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+if __name__ == "__main__":
+    wf = run_sample()
+    print("best validation/train err%:", wf.decision.best_n_err_pt)
