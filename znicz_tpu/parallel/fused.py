@@ -3,23 +3,26 @@
 SURVEY.md §7 design stance: the unit graph remains the epoch-level control
 plane, but the hot loop — forward, loss gradient, backward, per-layer
 update — compiles to a single XLA computation.  This module is the fused
-path for fully-connected stacks (the reference's all2all family,
-all2all.py:53-474 + gd.py:73-551); conv models plug in as further spec
-types.
+path for whole feed-forward topologies: the FC family (reference
+all2all.py:53-474 + gd.py:73-551), the conv family (conv.py:71-568 +
+gd_conv.py:60-750), pooling (pooling.py:122-548), LRN (normalization.py),
+standalone activations (activation.py) and dropout (dropout.py).
 
-Parity: weight init matches ``All2All.initialize`` (magnitude heuristic
-all2all.py:106-117, fill semantics all2all.py:119-127, same PRNG draw
-order), and the update algebra is literally :func:`znicz_tpu.ops.gd_math.
-update` with ``xp=jnp`` — the same function the unit-at-a-time path runs.
-Gradients come from ``jax.grad`` of the softmax-CE loss, which reproduces
-the reference's hand-written chain rule (verified by the parity test
-against the unit-graph path in float64).
+Parity: weight init matches the unit path exactly (magnitude heuristics
+all2all.py:106-117 / conv.py:137-146, fill semantics all2all.py:119-127,
+same PRNG draw order), and the update algebra is literally
+:func:`znicz_tpu.ops.gd_math.update` with ``xp=jnp`` — the same function
+the unit-at-a-time path runs.  Gradients come from ``jax.grad`` of the
+softmax-CE loss, which reproduces the reference's hand-written chain rule
+(verified by the float64 parity tests against the unit-graph path in
+tests/unit/test_fused.py).
 
 Sharding: parameters and inputs carry ``NamedSharding`` annotations over a
 ``(data, model)`` mesh; GSPMD inserts the gradient all-reduce (psum over
 ``data``) and the activation all-gathers (over ``model``) — the TPU-native
 replacement for the reference's parameter-server broadcast/aggregate cycle
-(nn_units.py:178-208, 644-694).
+(nn_units.py:178-208, 644-694).  Conv parameters replicate (they are
+small); wide FC layers shard over ``model``.
 """
 
 from dataclasses import dataclass, field
@@ -32,21 +35,60 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from znicz_tpu.core import prng
 from znicz_tpu.ops import activations, gd_math
+from znicz_tpu.ops import conv as conv_ops
+from znicz_tpu.ops import pooling as pool_ops
+from znicz_tpu.ops import normalization as norm_ops
 
-#: the FC family the fused path can compile (reference all2all.py classes);
-#: activation + magnitude constants come from the registered unit classes —
-#: single source of truth with the unit-graph path.
+#: the FC family (reference all2all.py classes); activation + magnitude
+#: constants come from the registered unit classes — single source of truth
+#: with the unit-graph path.
 FC_TYPES = ("all2all", "all2all_tanh", "all2all_relu", "all2all_str",
             "all2all_sigmoid", "softmax")
+CONV_TYPES = ("conv", "conv_tanh", "conv_sigmoid", "conv_relu", "conv_str")
+POOL_TYPES = ("max_pooling", "maxabs_pooling", "avg_pooling")
+ACTIVATION_TYPES = ("activation_tanh", "activation_sigmoid",
+                    "activation_relu", "activation_str", "activation_log",
+                    "activation_tanhlog", "activation_sincos")
 
 
 def _forward_class(tpe):
-    from znicz_tpu.units import nn_units, all2all  # noqa: F401 (registers)
+    from znicz_tpu.units import nn_units
+    import znicz_tpu.units  # noqa: F401 (registers every unit module)
     return nn_units.mapping[tpe].forward
 
 DEFAULT_HYPER = dict(lr=0.01, wd=0.00005, l1_vs_l2=0.0, moment=0.0,
                      acc_alpha=0.0, acc_beta=0.0, gd_alpha=0.0, gd_beta=1.0,
                      factor_ortho=0.0)
+
+
+def _parse_hyper(bwd, defaults):
+    """Extract (hyper, hyper_bias, flags) from a layer's "<-" dict —
+    the reference backward-kwargs contract (standard_workflow_base.py:
+    406-422)."""
+    hyper = dict(defaults)
+    hyper.update(
+        lr=bwd.get("learning_rate", defaults["lr"]),
+        wd=bwd.get("weights_decay", defaults["wd"]),
+        l1_vs_l2=bwd.get("l1_vs_l2", defaults["l1_vs_l2"]),
+        moment=bwd.get("gradient_moment", defaults["moment"]),
+        acc_alpha=bwd.get("acc_alpha", defaults["acc_alpha"]),
+        acc_beta=bwd.get("acc_beta", defaults["acc_beta"]),
+        gd_alpha=bwd.get("gd_alpha", defaults["gd_alpha"]),
+        gd_beta=bwd.get("gd_beta", defaults["gd_beta"]),
+        factor_ortho=bwd.get("factor_ortho", defaults["factor_ortho"]))
+    hyper_bias = dict(hyper)
+    hyper_bias.update(
+        lr=bwd.get("learning_rate_bias", hyper["lr"]),
+        wd=bwd.get("weights_decay_bias", 0.0),
+        l1_vs_l2=bwd.get("l1_vs_l2_bias", hyper["l1_vs_l2"]),
+        moment=bwd.get("gradient_moment_bias", hyper["moment"]),
+        factor_ortho=0.0)
+    flags = dict(accumulate=bool(bwd.get("accumulate_gradient", False)),
+                 apply=True,
+                 solvers=frozenset(bwd.get("solvers", ())),
+                 ortho=bool(hyper["factor_ortho"]),
+                 variant_moment=bwd.get("variant_moment_gradient", True))
+    return hyper, hyper_bias, flags
 
 
 @dataclass
@@ -65,9 +107,15 @@ class FCSpec:
     bias_filling: str = "uniform"
     include_bias: bool = True
 
+    kind = "fc"
+
     @property
     def is_softmax(self):
         return self.type == "softmax"
+
+    @property
+    def out_shape(self):
+        return (self.n_out,)
 
     def init_stddev(self):
         """Reference magnitude heuristic (all2all.py:106-117), using the
@@ -80,80 +128,250 @@ class FCSpec:
         return min(vle, 0.5)
 
 
-def build_fc_specs(layers, input_sample_size, defaults=None):
-    """Build FCSpec list from a declarative ``layers`` config.
+@dataclass
+class ConvSpec:
+    """One convolutional layer (reference conv.py:71-475 geometry:
+    NHWC, weights (n_kernels, ky*kx*C), padding LTRB, sliding (x, y))."""
+    type: str
+    in_shape: tuple      # sample (H, W, C)
+    out_shape: tuple     # sample (ny, nx, K)
+    n_kernels: int
+    kx: int
+    ky: int
+    padding: tuple
+    sliding: tuple
+    activation: str
+    hyper: dict = field(default_factory=dict)
+    hyper_bias: dict = field(default_factory=dict)
+    flags: dict = field(default_factory=dict)
+    weights_stddev: float = None
+    bias_stddev: float = None
+    weights_filling: str = "uniform"
+    bias_filling: str = "uniform"
+    include_bias: bool = True
+    max_supposed: float = 1.0
+
+    kind = "conv"
+    is_softmax = False
+
+    @property
+    def n_channels(self):
+        return self.in_shape[2]
+
+    def init_stddev(self):
+        """Reference conv magnitude heuristic (conv.py:137-146), capped at
+        0.05 like Conv.initialize."""
+        if self.weights_stddev is not None:
+            return self.weights_stddev
+        vle = 1.0 / (self.max_supposed *
+                     numpy.sqrt(self.kx * self.ky * self.n_channels))
+        if self.weights_filling == "gaussian":
+            vle /= 3
+        return min(vle, 0.05)
+
+
+@dataclass
+class PoolSpec:
+    """max / maxabs / avg pooling (reference pooling.py ceil-mode
+    geometry; winner-take-all gradient comes from the VJP of the gather —
+    the same scatter-add the unit path runs, gd_pooling.py:233-247)."""
+    type: str
+    in_shape: tuple
+    out_shape: tuple
+    mode: str            # "max" | "maxabs" | "avg"
+    kx: int
+    ky: int
+    sliding: tuple
+
+    kind = "pool"
+    is_softmax = False
+
+
+@dataclass
+class LRNSpec:
+    """Cross-channel local response normalization (normalization.py)."""
+    type: str
+    in_shape: tuple
+    out_shape: tuple
+    alpha: float = 1e-4
+    beta: float = 0.75
+    k: float = 2.0
+    n: int = 5
+
+    kind = "lrn"
+    is_softmax = False
+
+
+@dataclass
+class ActivationSpec:
+    """Standalone activation layer (activation.py)."""
+    type: str
+    in_shape: tuple
+    out_shape: tuple
+    activation: str = "linear"
+
+    kind = "activation"
+    is_softmax = False
+
+
+@dataclass
+class DropoutSpec:
+    """Inverted dropout: keep-mask / (1 - ratio) in train mode
+    (reference dropout.py:147-153; the fused path draws the mask from a
+    jax PRNG key instead of the host stream — same Bernoulli(1-ratio)
+    distribution, device-resident)."""
+    type: str
+    in_shape: tuple
+    out_shape: tuple
+    ratio: float = 0.5
+
+    kind = "dropout"
+    is_softmax = False
+
+
+def _normalize_sample_shape(shape):
+    if isinstance(shape, (int, numpy.integer)):
+        return (int(shape),)
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 2:    # (H, W) -> single implicit channel, as_nhwc
+        shape = shape + (1,)
+    return shape
+
+
+def build_specs(layers, input_sample_shape, defaults=None):
+    """Build the spec list from a declarative ``layers`` config.
 
     Each entry is a dict with "type" plus forward kwargs (optionally under
     "->") and backward kwargs (under "<-") — the reference config format
-    (standard_workflow_base.py:406-422).
+    (standard_workflow_base.py:406-422).  Sample shapes thread through the
+    conv/pooling geometry exactly as the unit graph's initialize() chain
+    does.
     """
     defaults = dict(DEFAULT_HYPER, **(defaults or {}))
     specs = []
-    n_in = int(input_sample_size)
+    shape = _normalize_sample_shape(input_sample_shape)
     for layer in layers:
         layer = dict(layer)
         tpe = layer.pop("type")
-        if tpe not in FC_TYPES:
-            raise ValueError("fused path does not support layer type %r"
-                             % tpe)
+        layer.pop("name", None)
         fwd = dict(layer.pop("->", {}))
         bwd = dict(layer.pop("<-", {}))
         fwd.update({k: v for k, v in layer.items()})
-        shape = fwd.get("output_sample_shape", fwd.get("output_samples"))
-        if shape is None:
-            raise ValueError("layer %r needs output_sample_shape" % tpe)
-        n_out = int(numpy.prod(shape))
-        hyper = dict(defaults)
-        hyper.update(
-            lr=bwd.get("learning_rate", defaults["lr"]),
-            wd=bwd.get("weights_decay", defaults["wd"]),
-            l1_vs_l2=bwd.get("l1_vs_l2", defaults["l1_vs_l2"]),
-            moment=bwd.get("gradient_moment", defaults["moment"]),
-            acc_alpha=bwd.get("acc_alpha", defaults["acc_alpha"]),
-            acc_beta=bwd.get("acc_beta", defaults["acc_beta"]),
-            gd_alpha=bwd.get("gd_alpha", defaults["gd_alpha"]),
-            gd_beta=bwd.get("gd_beta", defaults["gd_beta"]),
-            factor_ortho=bwd.get("factor_ortho", defaults["factor_ortho"]))
-        hyper_bias = dict(hyper)
-        hyper_bias.update(
-            lr=bwd.get("learning_rate_bias", hyper["lr"]),
-            wd=bwd.get("weights_decay_bias", 0.0),
-            l1_vs_l2=bwd.get("l1_vs_l2_bias", hyper["l1_vs_l2"]),
-            moment=bwd.get("gradient_moment_bias", hyper["moment"]),
-            factor_ortho=0.0)
-        flags = dict(accumulate=bool(bwd.get("accumulate_gradient", False)),
-                     apply=True,
-                     solvers=frozenset(bwd.get("solvers", ())),
-                     ortho=bool(hyper["factor_ortho"]),
-                     variant_moment=bwd.get("variant_moment_gradient", True))
-        specs.append(FCSpec(
-            type=tpe, n_in=n_in, n_out=n_out,
-            activation=("linear" if tpe == "softmax"
-                        else _forward_class(tpe).ACTIVATION),
-            hyper=hyper, hyper_bias=hyper_bias, flags=flags,
-            weights_stddev=fwd.get("weights_stddev"),
-            bias_stddev=fwd.get("bias_stddev"),
-            weights_filling=fwd.get("weights_filling", "uniform"),
-            bias_filling=fwd.get("bias_filling", "uniform"),
-            include_bias=fwd.get("include_bias", True)))
-        n_in = n_out
+        if tpe in FC_TYPES:
+            oshape = fwd.get("output_sample_shape",
+                             fwd.get("output_samples"))
+            if oshape is None:
+                raise ValueError("layer %r needs output_sample_shape" % tpe)
+            n_out = int(numpy.prod(oshape))
+            hyper, hyper_bias, flags = _parse_hyper(bwd, defaults)
+            specs.append(FCSpec(
+                type=tpe, n_in=int(numpy.prod(shape)), n_out=n_out,
+                activation=("linear" if tpe == "softmax"
+                            else _forward_class(tpe).ACTIVATION),
+                hyper=hyper, hyper_bias=hyper_bias, flags=flags,
+                weights_stddev=fwd.get("weights_stddev"),
+                bias_stddev=fwd.get("bias_stddev"),
+                weights_filling=fwd.get("weights_filling", "uniform"),
+                bias_filling=fwd.get("bias_filling", "uniform"),
+                include_bias=fwd.get("include_bias", True)))
+            shape = (n_out,)
+        elif tpe in CONV_TYPES:
+            if len(shape) != 3:
+                raise ValueError(
+                    "conv layer %r needs a (H, W, C) input, have %r"
+                    % (tpe, shape))
+            kx, ky = int(fwd["kx"]), int(fwd["ky"])
+            n_kernels = int(fwd["n_kernels"])
+            padding = tuple(fwd.get("padding", (0, 0, 0, 0)))
+            sliding = tuple(fwd.get("sliding", (1, 1)))
+            ny, nx = conv_ops.output_spatial(
+                shape[0], shape[1], ky, kx, padding, sliding)
+            hyper, hyper_bias, flags = _parse_hyper(bwd, defaults)
+            specs.append(ConvSpec(
+                type=tpe, in_shape=shape, out_shape=(ny, nx, n_kernels),
+                n_kernels=n_kernels, kx=kx, ky=ky,
+                padding=padding, sliding=sliding,
+                activation=_forward_class(tpe).ACTIVATION,
+                hyper=hyper, hyper_bias=hyper_bias, flags=flags,
+                weights_stddev=fwd.get("weights_stddev"),
+                bias_stddev=fwd.get("bias_stddev"),
+                weights_filling=fwd.get("weights_filling", "uniform"),
+                bias_filling=fwd.get("bias_filling", "uniform"),
+                include_bias=fwd.get("include_bias", True),
+                max_supposed=fwd.get("input_max_supposed", 1.0)))
+            shape = (ny, nx, n_kernels)
+        elif tpe in POOL_TYPES:
+            if len(shape) != 3:
+                raise ValueError(
+                    "pooling layer %r needs a (H, W, C) input, have %r"
+                    % (tpe, shape))
+            kx, ky = int(fwd["kx"]), int(fwd["ky"])
+            sliding = tuple(fwd.get("sliding") or (kx, ky))
+            ny, nx = pool_ops.output_spatial(
+                shape[0], shape[1], ky, kx, sliding)
+            mode = {"max_pooling": "max", "maxabs_pooling": "maxabs",
+                    "avg_pooling": "avg"}[tpe]
+            specs.append(PoolSpec(
+                type=tpe, in_shape=shape, out_shape=(ny, nx, shape[2]),
+                mode=mode, kx=kx, ky=ky, sliding=sliding))
+            shape = (ny, nx, shape[2])
+        elif tpe == "norm":
+            if len(shape) != 3:
+                raise ValueError(
+                    "LRN layer needs a (H, W, C) input, have %r" % (shape,))
+            specs.append(LRNSpec(
+                type=tpe, in_shape=shape, out_shape=shape,
+                alpha=fwd.get("alpha", 1e-4), beta=fwd.get("beta", 0.75),
+                k=fwd.get("k", 2), n=fwd.get("n", 5)))
+        elif tpe in ACTIVATION_TYPES:
+            specs.append(ActivationSpec(
+                type=tpe, in_shape=shape, out_shape=shape,
+                activation=_forward_class(tpe).ACTIVATION))
+        elif tpe == "dropout":
+            specs.append(DropoutSpec(
+                type=tpe, in_shape=shape, out_shape=shape,
+                ratio=fwd.get("dropout_ratio", 0.5)))
+        else:
+            raise ValueError("fused path does not support layer type %r"
+                             % tpe)
+    return specs
+
+
+def build_fc_specs(layers, input_sample_size, defaults=None):
+    """FC-only builder (back-compat): rejects non-FC layer types."""
+    specs = build_specs(layers, int(input_sample_size), defaults)
+    for spec in specs:
+        if spec.kind != "fc":
+            raise ValueError("fused FC path does not support layer type %r"
+                             % spec.type)
     return specs
 
 
 def init_params(specs, rand=None, dtype=numpy.float32):
     """Host-side init with the unit path's exact draw order and fill
-    semantics (weights then bias per layer, all2all.py:119-127)."""
+    semantics (weights then bias per layer, all2all.py:119-127 /
+    conv.py:100-111; param-less layers draw nothing)."""
     rand = rand or prng.get()
     params = []
     for spec in specs:
+        if spec.kind == "fc":
+            w_shape = (spec.n_out, spec.n_in)
+            n_bias = spec.n_out
+        elif spec.kind == "conv":
+            w_shape = (spec.n_kernels,
+                       spec.kx * spec.ky * spec.n_channels)
+            n_bias = spec.n_kernels
+        else:
+            params.append({})
+            continue
         stddev = spec.init_stddev()
         bias_stddev = spec.bias_stddev if spec.bias_stddev is not None \
             else stddev
-        w = numpy.zeros((spec.n_out, spec.n_in), dtype=dtype)
+        w = numpy.zeros(w_shape, dtype=dtype)
         _fill(rand, spec.weights_filling, w, stddev)
         p = {"w": w}
         if spec.include_bias:
-            b = numpy.zeros(spec.n_out, dtype=dtype)
+            b = numpy.zeros(n_bias, dtype=dtype)
             _fill(rand, spec.bias_filling, b, bias_stddev)
             p["b"] = b
         params.append(p)
@@ -170,8 +388,10 @@ def init_opt_state(specs, params):
     path (vel = gradient_*_with_moment, acc, solver slots)."""
     states = []
     for spec, p in zip(specs, params):
-        st = {"w": gd_math.init_state(
-            p["w"], dict(spec.flags, need_vel=True))}
+        st = {}
+        if "w" in p:
+            st["w"] = gd_math.init_state(
+                p["w"], dict(spec.flags, need_vel=True))
         if "b" in p:
             st["b"] = gd_math.init_state(
                 p["b"], dict(spec.flags, need_vel=True))
@@ -179,25 +399,60 @@ def init_opt_state(specs, params):
     return states
 
 
-def forward(params, x, specs, return_logits=False):
-    """Pure forward pass.  With ``return_logits`` the softmax head is left
-    un-normalized (for the CE loss); otherwise softmax is applied."""
-    y = x.reshape(x.shape[0], -1)
+def forward(params, x, specs, return_logits=False, key=None, train=False):
+    """Pure forward pass through the whole spec stack.
+
+    With ``return_logits`` the softmax head is left un-normalized (for the
+    CE loss); otherwise softmax is applied.  ``key``/``train`` drive
+    dropout masks; inference leaves dropout as identity (reference
+    dropout.py:84-190 TRAIN gating).
+    """
+    y = x
     for p, spec in zip(params, specs):
-        y = y @ p["w"].T
-        if "b" in p:
-            y = y + p["b"]
-        if not spec.is_softmax:
+        if spec.kind == "fc":
+            y = y.reshape(y.shape[0], -1)
+            y = y @ p["w"].T
+            if "b" in p:
+                y = y + p["b"]
+            if not spec.is_softmax:
+                y = activations.apply_jax(spec.activation, y)
+            elif not return_logits:
+                y = jax.nn.softmax(y, axis=1)
+        elif spec.kind == "conv":
+            y = y.reshape((y.shape[0],) + spec.in_shape)
+            y = conv_ops.forward_jax(
+                y, p["w"], p.get("b"), spec.ky, spec.kx,
+                spec.padding, spec.sliding, activation=spec.activation,
+                include_bias="b" in p)
+        elif spec.kind == "pool":
+            y = y.reshape((y.shape[0],) + spec.in_shape)
+            if spec.mode == "avg":
+                y = pool_ops.avg_pooling_jax(
+                    y, spec.ky, spec.kx, spec.sliding)
+            else:
+                y, _ = pool_ops.max_pooling_jax(
+                    y, spec.ky, spec.kx, spec.sliding,
+                    use_abs=(spec.mode == "maxabs"))
+        elif spec.kind == "lrn":
+            y = y.reshape((y.shape[0],) + spec.in_shape)
+            y = norm_ops.lrn_forward_jax(
+                y, alpha=spec.alpha, beta=spec.beta, k=spec.k, n=spec.n)
+        elif spec.kind == "activation":
             y = activations.apply_jax(spec.activation, y)
-        elif not return_logits:
-            y = jax.nn.softmax(y, axis=1)
+        elif spec.kind == "dropout":
+            if train and key is not None:
+                key, sub = jax.random.split(key)
+                keep = jax.random.uniform(sub, y.shape) >= spec.ratio
+                y = y * keep.astype(y.dtype) / (1.0 - spec.ratio)
+        else:  # pragma: no cover - build_specs rejects unknown kinds
+            raise AssertionError(spec.kind)
     return y
 
 
-def _loss_and_stats(params, x, labels, specs):
+def _loss_and_stats(params, x, labels, specs, key=None):
     """Mean softmax-CE loss (matches evaluator err_output scaling,
     ops/evaluator.py) + error count."""
-    y = forward(params, x, specs, return_logits=True)
+    y = forward(params, x, specs, return_logits=True, key=key, train=True)
     logp = jax.nn.log_softmax(y, axis=1)
     valid = labels >= 0
     lbl = jnp.maximum(labels, 0)
@@ -208,20 +463,35 @@ def _loss_and_stats(params, x, labels, specs):
     return loss, n_err
 
 
-class FusedMLP:
-    """Compiled trainer for an FC stack over an optional device mesh."""
+def flops_per_image(specs):
+    """Analytic forward FLOPs per sample (matmul/conv MACs × 2) — the
+    basis for the bench's MFU estimate (train step ≈ 3 × forward)."""
+    total = 0
+    for spec in specs:
+        if spec.kind == "fc":
+            total += 2 * spec.n_in * spec.n_out
+        elif spec.kind == "conv":
+            ny, nx, k = spec.out_shape
+            total += 2 * ny * nx * k * spec.kx * spec.ky * spec.n_channels
+    return total
 
-    def __init__(self, layers, input_sample_size, mesh=None, rand=None,
-                 dtype=numpy.float32, defaults=None):
-        self.specs = build_fc_specs(layers, input_sample_size, defaults)
+
+class FusedNet:
+    """Compiled trainer for a feed-forward spec stack over an optional
+    device mesh."""
+
+    def __init__(self, layers, input_sample_shape, mesh=None, rand=None,
+                 dtype=numpy.float32, defaults=None, dropout_seed=0):
+        self.specs = build_specs(layers, input_sample_shape, defaults)
+        self.input_sample_shape = _normalize_sample_shape(input_sample_shape)
         if not self.specs[-1].is_softmax:
             raise ValueError(
-                "FusedMLP trains a softmax-CE objective; the last layer "
-                "must be type 'softmax' (got %r). Use the unit-graph path "
-                "for other heads." % self.specs[-1].type)
+                "the fused path trains a softmax-CE objective; the last "
+                "layer must be type 'softmax' (got %r). Use the unit-graph "
+                "path for other heads." % self.specs[-1].type)
         if any(s.is_softmax for s in self.specs[:-1]):
             raise ValueError(
-                "softmax is only supported as the head of a FusedMLP")
+                "softmax is only supported as the head of a fused net")
         self.mesh = mesh
         params_host = init_params(self.specs, rand, dtype)
         states_host = init_opt_state(self.specs, params_host)
@@ -230,11 +500,14 @@ class FusedMLP:
         # mismatched initial placement would force a second full compile
         # when the donated step returns GSPMD-sharded state.
         self.state = self._place_state(states_host)
+        self._key = jax.random.PRNGKey(dropout_seed)
+        self._has_dropout = any(s.kind == "dropout" for s in self.specs)
         # specs close over the traced functions (they carry dicts, so they
         # can't be hashable static args); hyperparameters bake in as XLA
         # constants.
         specs = tuple(self.specs)
-        step_fn = lambda p, s, x, l: _train_step(p, s, x, l, specs)  # noqa
+        step_fn = lambda p, s, x, l, k: _train_step(  # noqa: E731
+            p, s, x, l, specs, k)
         if mesh is not None:
             # Pin output shardings to the input placements: GSPMD would
             # otherwise return spec variants (P('model',) vs
@@ -256,11 +529,12 @@ class FusedMLP:
 
     # -- sharding -----------------------------------------------------------
     def _param_spec(self, spec, name):
-        """model-axis sharding for wide layers, replicated otherwise."""
+        """model-axis sharding for wide FC layers, replicated otherwise
+        (conv kernels are small — replication beats the all-gather)."""
         if self.mesh is None:
             return None
         msize = self.mesh.shape["model"]
-        if msize > 1 and spec.n_out % msize == 0:
+        if (spec.kind == "fc" and msize > 1 and spec.n_out % msize == 0):
             return P("model", None) if name == "w" else P("model")
         return P()
 
@@ -304,8 +578,12 @@ class FusedMLP:
     def step(self, x, labels):
         """One fused train step.  Returns {"loss": float, "n_err": int}."""
         x, labels = self._place_batch(x, labels)
+        if self._has_dropout:
+            self._key, key = jax.random.split(self._key)
+        else:
+            key = self._key
         self.params, self.state, metrics = self._step(
-            self.params, self.state, x, labels)
+            self.params, self.state, x, labels, key)
         return metrics
 
     def predict(self, x):
@@ -316,14 +594,28 @@ class FusedMLP:
         return jax.tree.map(lambda a: numpy.asarray(a), self.params)
 
 
-def _train_step(params, state, x, labels, specs):
+class FusedMLP(FusedNet):
+    """FC-only fused trainer (back-compat name; flat input)."""
+
+    def __init__(self, layers, input_sample_size, **kwargs):
+        # validate BEFORE the base init so a rejected config consumes no
+        # PRNG draws from a shared rand (fail-fast like build_fc_specs)
+        build_fc_specs(layers, int(input_sample_size),
+                       kwargs.get("defaults"))
+        super(FusedMLP, self).__init__(
+            layers, int(input_sample_size), **kwargs)
+
+
+def _train_step(params, state, x, labels, specs, key=None):
     (loss, n_err), grads = jax.value_and_grad(
-        lambda p: _loss_and_stats(p, x, labels, specs), has_aux=True)(params)
+        lambda p: _loss_and_stats(p, x, labels, specs, key),
+        has_aux=True)(params)
     new_params, new_state = [], []
     for spec, p, st, g in zip(specs, params, state, grads):
         np_, nst = {}, {}
-        np_["w"], nst["w"], _ = gd_math.update(
-            jnp, p["w"], g["w"], st["w"], spec.hyper, spec.flags)
+        if "w" in p:
+            np_["w"], nst["w"], _ = gd_math.update(
+                jnp, p["w"], g["w"], st["w"], spec.hyper, spec.flags)
         if "b" in p:
             hyper_b = spec.hyper_bias
             flags_b = dict(spec.flags, ortho=False)
