@@ -211,6 +211,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         if n < self.max_minibatch_size:
             self.minibatch_labels.map_write()
             self.minibatch_labels.mem[n:] = -1
+            targets = getattr(self, "minibatch_targets", None)
+            if targets:
+                targets.map_write()
+                targets.mem[n:] = 0
 
         seg_done = off + n >= length
         epoch_done = seg_done and self._segment == len(order) - 1
@@ -264,22 +268,28 @@ class FullBatchLoader(Loader):
         super(FullBatchLoader, self).initialize(device=device, **kwargs)
         self._apply_normalization()
 
-    def _apply_normalization(self):
+    def _fit_and_normalize(self, array, norm_type, norm_params):
+        """Fit a normalizer on the TRAIN slice of ``array`` and normalize
+        the whole array in place (reference semantics: normalizer analyzed
+        on the training set, applied everywhere).  Returns the
+        normalizer."""
         from znicz_tpu.core import normalization
-        if self.normalization_type in (None, "none"):
-            self.normalizer = normalization.NoneNormalizer()
-            return
-        self.normalizer = normalization.create(
-            self.normalization_type, **self.normalization_parameters)
-        data = self.original_data.mem
+        if norm_type in (None, "none"):
+            return normalization.NoneNormalizer()
+        normalizer = normalization.create(norm_type, **norm_params)
+        data = array.mem
         flat = data.reshape(data.shape[0], -1)
-        # Fit on TRAIN only (reference semantics: normalizer analyzed on
-        # the training set, applied everywhere).
         start, end = self.class_index_range(TRAIN)
         fit_on = flat[start:end] if end > start else flat
-        self.normalizer.analyze(fit_on)
-        self.original_data.map_write()
-        self.normalizer.normalize(flat)
+        normalizer.analyze(fit_on)
+        array.map_write()
+        normalizer.normalize(flat)
+        return normalizer
+
+    def _apply_normalization(self):
+        self.normalizer = self._fit_and_normalize(
+            self.original_data, self.normalization_type,
+            self.normalization_parameters)
 
     def fill_minibatch(self):
         idx = self.minibatch_indices.mem
@@ -292,3 +302,70 @@ class FullBatchLoader(Loader):
         if self._original_labels:
             for i in range(n):
                 self.minibatch_labels.mem[i] = self._original_labels[idx[i]]
+
+
+class LoaderMSEMixin(object):
+    """Per-sample regression targets — the contract EvaluatorMSE trains
+    against (reference veles.loader.LoaderMSEMixin, SURVEY.md §2.9;
+    used by Kanji/Approximator, evaluator.py:334-556).
+
+    Adds ``minibatch_targets`` (wired to the evaluator's ``target`` by
+    StandardWorkflow.link_evaluator), optional ``class_targets`` (enables
+    the nearest-class-target error metric), and a targets normalizer
+    separate from the data normalizer.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(LoaderMSEMixin, self).__init__(workflow, **kwargs)
+        self.minibatch_targets = Array(name="minibatch_targets")
+        self.targets_normalization_type = kwargs.get(
+            "targets_normalization_type", "none")
+        self.targets_normalization_parameters = kwargs.get(
+            "targets_normalization_parameters", {})
+        self.target_normalizer = None
+        self.class_targets = None
+
+    @property
+    def targets_shape(self):
+        return tuple(self.minibatch_targets.shape[1:])
+
+
+class FullBatchLoaderMSEMixin(LoaderMSEMixin):
+    """FullBatch variant: whole ``original_targets`` in memory, sliced per
+    minibatch alongside the data (reference FullBatchLoaderMSEMixin)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(FullBatchLoaderMSEMixin, self).__init__(workflow, **kwargs)
+        self.original_targets = Array(name="original_targets")
+
+    def create_minibatch_data(self):
+        super(FullBatchLoaderMSEMixin, self).create_minibatch_data()
+        if not self.original_targets:
+            raise ValueError(
+                "%s.load_data must fill original_targets" % self.name)
+        self.minibatch_targets.reset(numpy.zeros(
+            (self.max_minibatch_size,) +
+            tuple(self.original_targets.shape[1:]),
+            dtype=self.minibatch_data.dtype))
+
+    def initialize(self, device=None, **kwargs):
+        super(FullBatchLoaderMSEMixin, self).initialize(
+            device=device, **kwargs)
+        self._apply_target_normalization()
+
+    def _apply_target_normalization(self):
+        self.target_normalizer = self._fit_and_normalize(
+            self.original_targets, self.targets_normalization_type,
+            self.targets_normalization_parameters)
+
+    def fill_minibatch(self):
+        super(FullBatchLoaderMSEMixin, self).fill_minibatch()
+        idx = self.minibatch_indices.mem
+        self.minibatch_targets.map_invalidate()
+        tgt = self.original_targets.mem
+        for i in range(self.minibatch_size):
+            self.minibatch_targets.mem[i] = tgt[idx[i]]
+
+
+class FullBatchLoaderMSE(FullBatchLoaderMSEMixin, FullBatchLoader):
+    """Convenience concrete base for full-batch MSE loaders."""

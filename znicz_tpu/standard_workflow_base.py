@@ -216,6 +216,25 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
                 last_fwd.output_sample_shape = ulc
 
             loader.on_initialized = on_initialized
+        elif (self.real_loader is not None and
+              hasattr(self.real_loader, "minibatch_targets") and
+              hasattr(last_fwd, "output_sample_shape")):
+            # MSE topologies: the last FC layer's width comes from the
+            # loader's target sample shape (reference
+            # standard_workflow_base.py:324-334, LoaderMSEMixin path).
+            loader = self.real_loader
+
+            def on_initialized_mse():
+                tshape = tuple(loader.minibatch_targets.shape[1:])
+                oss = last_fwd.output_sample_shape
+                if oss != tuple() and tuple(numpy.ravel(oss)) != tshape \
+                        and numpy.prod(oss) != numpy.prod(tshape):
+                    self.warning(
+                        "Overriding %s.output_sample_shape %s with %s "
+                        "(loader targets)", last_fwd.name, oss, tshape)
+                last_fwd.output_sample_shape = tshape
+
+            loader.on_initialized = on_initialized_mse
         return last_fwd
 
     def _add_forward_unit(self, new_unit, init_attrs=None, *parents):
