@@ -360,11 +360,10 @@ class FullBatchLoaderMSEMixin(LoaderMSEMixin):
 
     def fill_minibatch(self):
         super(FullBatchLoaderMSEMixin, self).fill_minibatch()
-        idx = self.minibatch_indices.mem
+        n = self.minibatch_size
+        idx = self.minibatch_indices.mem[:n]
         self.minibatch_targets.map_invalidate()
-        tgt = self.original_targets.mem
-        for i in range(self.minibatch_size):
-            self.minibatch_targets.mem[i] = tgt[idx[i]]
+        self.minibatch_targets.mem[:n] = self.original_targets.mem[idx]
 
 
 class FullBatchLoaderMSE(FullBatchLoaderMSEMixin, FullBatchLoader):

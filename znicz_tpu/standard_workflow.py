@@ -123,7 +123,10 @@ class StandardWorkflow(StandardWorkflowBase):
         elif self.loss_function == "mse":
             self.evaluator.link_attrs(
                 self.loader, ("target", "minibatch_targets"))
-            if getattr(self.loader, "class_targets", None) is not None:
+            # linked attrs resolve lazily, so this works for loaders that
+            # only fill class_targets inside load_data (the evaluator
+            # checks for None again at run time)
+            if hasattr(self.loader, "class_targets"):
                 self.evaluator.link_attrs(self.loader, "class_targets",
                                           ("labels", "minibatch_labels"))
         return self.evaluator

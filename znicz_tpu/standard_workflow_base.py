@@ -225,7 +225,7 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
             loader = self.real_loader
 
             def on_initialized_mse():
-                tshape = tuple(loader.minibatch_targets.shape[1:])
+                tshape = loader.targets_shape
                 oss = last_fwd.output_sample_shape
                 if oss != tuple() and tuple(numpy.ravel(oss)) != tshape \
                         and numpy.prod(oss) != numpy.prod(tshape):
