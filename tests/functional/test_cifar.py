@@ -1,0 +1,57 @@
+"""CIFAR functional test — the caffe-style conv topology actually trains.
+
+Closes VERDICT.md round-1 weak point #6: samples/cifar.py (conv + maxpool +
+strict-relu + LRN + avgpool + arbitrary_step LR schedule, the 17.21%-val
+reference config) had no test.  Trains the real workflow for several epochs
+on the deterministic synthetic set and asserts the error decreases and the
+lr_adjuster graph surgery holds together.
+"""
+
+import numpy
+
+from znicz_tpu.core.backends import JaxDevice
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import TRAIN, VALID
+
+LOADER_CFG = {"synthetic_train": 200, "synthetic_valid": 80,
+              "minibatch_size": 40}
+
+
+def _run(max_epochs):
+    from znicz_tpu.samples import cifar
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    wf = cifar.build(
+        loader_config=dict(LOADER_CFG),
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 100})
+    wf.initialize(device=JaxDevice())
+    wf.run()
+    return wf
+
+
+def test_cifar_caffe_topology_trains():
+    wf1 = _run(max_epochs=1)
+    first_train = wf1.decision.epoch_n_err[TRAIN]
+    first_valid = wf1.decision.epoch_n_err[VALID]
+
+    wf = _run(max_epochs=4)
+    assert wf.loader.epoch_number == 4
+    # same seeds => epoch 1 identical; epochs 2-4 must improve on it
+    assert wf.decision.epoch_n_err[TRAIN] < first_train, \
+        "training error should decrease (epoch1 %d -> epoch4 %d)" % (
+            first_train, wf.decision.epoch_n_err[TRAIN])
+    assert wf.decision.best_n_err_pt[VALID] <= \
+        100.0 * first_valid / LOADER_CFG["synthetic_valid"]
+
+    # the lr_adjuster re-link surgery: adjuster feeds the gd chain
+    assert wf.lr_adjuster in wf.gds[-1].links_from
+    assert wf.snapshotter not in wf.gds[-1].links_from
+    # arbitrary_step schedule engaged on every gd unit
+    for gd in wf.gds:
+        assert gd.learning_rate > 0
+
+    # graph shape sanity: conv stack geometry (32x32 pad2 5x5 convs)
+    shapes = [tuple(f.output.shape) for f in wf.forwards]
+    mb = LOADER_CFG["minibatch_size"]
+    assert shapes[0] == (mb, 32, 32, 32)     # conv1
+    assert shapes[-1] == (mb, 10)            # softmax head

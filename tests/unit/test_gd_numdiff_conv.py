@@ -22,29 +22,11 @@ from znicz_tpu.ops import conv as conv_ops
 from znicz_tpu.ops import pooling as pool_ops
 from znicz_tpu.ops import dense, activations
 
-H = 1e-5
-POINTS = (2 * H, H, -H, -2 * H)
-COEFFS = numpy.array([-1.0, 8.0, -8.0, 1.0]) / (12.0 * H)
+from tests.unit.test_gd_numdiff import numdiff  # shared 5-point stencil
 
 #: conv geometry under test: asymmetric padding + non-unit sliding
 PAD = (1, 2, 1, 0)   # L T R B
 SLIDE = (2, 2)
-
-
-def numdiff(f, arr):
-    """Five-point numeric gradient of scalar f w.r.t. every arr element."""
-    g = numpy.zeros_like(arr)
-    flat = arr.reshape(-1)
-    gf = g.reshape(-1)
-    for i in range(flat.size):
-        orig = flat[i]
-        vals = []
-        for d in POINTS:
-            flat[i] = orig + d
-            vals.append(f())
-        flat[i] = orig
-        gf[i] = (numpy.array(vals) * COEFFS).sum()
-    return g
 
 
 def test_conv_backward_numdiff_padding_sliding():

@@ -88,6 +88,20 @@ class RandomGenerator(object):
     def choice(self, a, size=None, replace=True, p=None):
         return self._state.choice(a, size, replace, p)
 
+    # -- state capture (checkpoint/resume exactness) ------------------------
+    def get_state(self):
+        """Opaque resumable state (numpy RandomState + key counter)."""
+        return {"np": self._state.get_state(),
+                "seed_arr": None if self._seed_arr is None
+                else numpy.array(self._seed_arr),
+                "key_counter": self._key_counter}
+
+    def set_state(self, state):
+        self._state.set_state(state["np"])
+        self._seed_arr = state["seed_arr"]
+        self._key_counter = state["key_counter"]
+        return self
+
     # -- TPU-first: deterministic jax.random keys ---------------------------
     def jax_key(self):
         """Mint the next ``jax.random`` key in this stream.
@@ -116,3 +130,14 @@ def get(key=1):
     if rg is None:
         rg = _streams[key] = RandomGenerator(key)
     return rg
+
+
+def states():
+    """Capture every registered stream's state (snapshot payload)."""
+    return {key: rg.get_state() for key, rg in _streams.items()}
+
+
+def restore(state_map):
+    """Restore stream states captured by :func:`states` (resume)."""
+    for key, st in state_map.items():
+        get(key).set_state(st)

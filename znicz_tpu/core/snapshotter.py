@@ -109,11 +109,15 @@ class SnapshotterToFile(SnapshotterBase):
     MAPPING = "file"
 
     def export(self):
+        from znicz_tpu.core import prng
         payload = {
             "format": 1,
             "workflow": type(self.workflow).__name__,
             "config": root.to_json(),
             "units": self.collect_state(),
+            # PRNG stream states make resume-retrain EXACT (the reference
+            # gets this by pickling the whole workflow, prng included)
+            "prng": prng.states(),
             "suffix": self.suffix,
             "time": time.time(),
         }

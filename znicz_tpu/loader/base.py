@@ -102,6 +102,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self._segment = 0        # position in the serving order
         self._offset_in_class = 0
         self._global_offset = 0
+        #: snapshotted iteration state — with the PRNG states this makes
+        #: resume-retrain exact (epoch position + the shuffled order)
+        self.exports = ["epoch_number", "_segment", "_offset_in_class",
+                        "_global_offset", "_indices"]
         self.normalizer = None
         self._labels_mapping = {}
 
@@ -258,9 +262,11 @@ class FullBatchLoader(Loader):
 
     def create_minibatch_data(self):
         sample_shape = self.original_data.shape[1:]
-        dtype = root.common.engine.precision_dtype \
-            if "precision_dtype" in root.common.engine.__dict__ else \
-            self.original_data.dtype
+        # side-effect-free lookup (plain getattr would auto-vivify an empty
+        # Config node into the global config)
+        dtype = root.common.engine.get("precision_dtype")
+        if dtype is None:
+            dtype = self.original_data.dtype
         self.minibatch_data.reset(numpy.zeros(
             (self.max_minibatch_size,) + tuple(sample_shape), dtype=dtype))
 

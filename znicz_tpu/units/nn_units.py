@@ -312,6 +312,12 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
         self.gradient_changed = False
         self.apply_gradient = kwargs.get("apply_gradient",
                                          not workflow.is_slave)
+        #: optimizer state in snapshots (velocity/accumulator restore makes
+        #: resumed momentum training exact)
+        self.exports = ["gradient_weights_with_moment",
+                        "gradient_bias_with_moment",
+                        "accumulated_gradient_weights",
+                        "accumulated_gradient_bias"]
         # jax-side optimizer state pytrees (device-resident twins)
         self._jstate_w = None
         self._jstate_b = None
@@ -540,7 +546,15 @@ class NNSnapshotterToFile(NNSnapshotterBase):
 
 
 def load_snapshot_into_workflow(state, workflow):
-    """Resume helper: apply a snapshot state dict onto a built workflow."""
+    """Resume helper: apply a snapshot state dict onto a built workflow.
+
+    Restores per-unit exports (weights, optimizer state, decision stats,
+    loader position) and the PRNG stream states, making
+    train-snapshot-resume-retrain bit-exact on the numpy path.
+    """
+    if "prng" in state:
+        from znicz_tpu.core import prng
+        prng.restore(state["prng"])
     units = {u.name: u for u in workflow.units}
     for uname, ustate in state["units"].items():
         u = units.get(uname)
