@@ -7,4 +7,13 @@ TRAIN=2 (reference: veles.loader import sites, loader_wine.py:41).
 
 from znicz_tpu.loader.base import (  # noqa: F401
     TEST, VALID, TRAIN, CLASS_NAME, Loader, FullBatchLoader,
+    FullBatchLoaderMSE, FullBatchLoaderMSEMixin, LoaderMSEMixin,
     UserLoaderRegistry, ILoader, IFullBatchLoader)
+from znicz_tpu.loader.image import (  # noqa: F401
+    IImageLoader, ImageLoaderBase, FullBatchImageLoader,
+    FileListImageLoader, FullBatchFileListImageLoader,
+    AutoLabelFileImageLoader, FullBatchAutoLabelFileImageLoader)
+# registration side effects (type-string loaders)
+import znicz_tpu.loader.loader_lmdb  # noqa: F401
+import znicz_tpu.loader.loader_stl  # noqa: F401
+import znicz_tpu.loader.imagenet_loader  # noqa: F401

@@ -1,0 +1,299 @@
+"""Image-loader base classes.
+
+TPU-era equivalent of the core ``veles.loader.image`` /
+``veles.loader.fullbatch_image`` family (SURVEY.md §2.9: ImageLoader,
+FullBatchImageLoader, FileListImageLoader,
+FullBatchAutoLabelFileImageLoader).  The observed contract the reference
+loaders fill (loader_lmdb.py, loader_stl.py): subclasses provide
+
+* ``get_keys(index)``       -> list of opaque keys for class ``index``
+* ``get_image_data(key)``   -> numpy array (H, W[, C]) uint8/float
+* ``get_image_label(key)``  -> int or string label
+* ``get_image_info(key)``   -> ((H, W), color_space)
+
+The base turns keys into the Loader minibatch contract: string labels get
+an int mapping (``labels_mapping``), images are optionally rescaled to
+``scale`` (PIL bilinear) and served NHWC.
+"""
+
+import os
+
+import numpy
+
+from znicz_tpu.loader.base import (
+    Loader, FullBatchLoader, ILoader, IFullBatchLoader, TEST, VALID, TRAIN)
+
+
+class IImageLoader(ILoader):
+    pass
+
+
+class ImageLoaderBase(Loader):
+    """Streaming image loader: decodes per minibatch, full set never in
+    memory (the reference ImageLoader contract)."""
+
+    MAPPING = None
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(ImageLoaderBase, self).__init__(workflow, **kwargs)
+        #: target (H, W) or None to keep source size
+        self.scale = kwargs.get("scale")
+        self.source_dtype = numpy.float32
+        #: cap on TRAIN images decoded for the normalizer's analyze pass
+        #: (streaming sets don't fit in RAM; the fit is statistical)
+        self.normalizer_analysis_limit = kwargs.get(
+            "normalizer_analysis_limit", 2048)
+        self._keys = {TEST: [], VALID: [], TRAIN: []}
+        self._label_to_int = {}
+        self._distinct_labels = set()
+
+    # -- subclass contract --------------------------------------------------
+    def get_keys(self, index):
+        raise NotImplementedError
+
+    def get_image_data(self, key):
+        raise NotImplementedError
+
+    def get_image_label(self, key):
+        raise NotImplementedError
+
+    def get_image_info(self, key):
+        raise NotImplementedError
+
+    # -- helpers ------------------------------------------------------------
+    @property
+    def labels_mapping(self):
+        return self._label_to_int
+
+    @property
+    def unique_labels_count(self):
+        if self._distinct_labels:
+            return len(self._distinct_labels)
+        return super(ImageLoaderBase, self).unique_labels_count
+
+    def _map_label(self, label):
+        if isinstance(label, (int, numpy.integer)):
+            self._distinct_labels.add(int(label))
+            return int(label)
+        if label not in self._label_to_int:
+            self._label_to_int[label] = len(self._label_to_int)
+        mapped = self._label_to_int[label]
+        self._distinct_labels.add(mapped)
+        return mapped
+
+    def _prepare_image(self, img):
+        """To NHWC float sample, rescaled to ``scale`` if set."""
+        img = numpy.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.scale is not None and tuple(img.shape[:2]) != \
+                tuple(self.scale):
+            from PIL import Image
+            chans = []
+            for c in range(img.shape[2]):
+                pil = Image.fromarray(img[:, :, c])
+                # PIL size is (W, H)
+                pil = pil.resize((self.scale[1], self.scale[0]),
+                                 Image.BILINEAR)
+                chans.append(numpy.asarray(pil))
+            img = numpy.stack(chans, axis=2)
+        return img.astype(self.source_dtype)
+
+    def _sample_shape(self):
+        for clazz in (TRAIN, VALID, TEST):
+            if self._keys[clazz]:
+                # _prepare_image already applies ``scale``
+                return self._prepare_image(
+                    self.get_image_data(self._keys[clazz][0])).shape
+        raise ValueError("%s: no keys in any class" % self.name)
+
+    # -- Loader contract ----------------------------------------------------
+    def load_data(self):
+        # pre-scan labels in dataset order so the int mapping (and thus
+        # the softmax head) is deterministic
+        for clazz in (TEST, VALID, TRAIN):
+            self._keys[clazz] = list(self.get_keys(clazz))
+            self.class_lengths[clazz] = len(self._keys[clazz])
+            for key in self._keys[clazz]:
+                self._map_label(self.get_image_label(key))
+
+    def create_minibatch_data(self):
+        shape = self._sample_shape()
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + tuple(shape),
+            dtype=self.source_dtype))
+
+    def initialize(self, device=None, **kwargs):
+        super(ImageLoaderBase, self).initialize(device=device, **kwargs)
+        if self.normalizer is None:
+            self._fit_normalizer()
+
+    def _fit_normalizer(self):
+        """Fit the normalizer on (up to ``normalizer_analysis_limit``)
+        TRAIN images; fill_minibatch then normalizes every minibatch —
+        the streaming counterpart of FullBatchLoader's whole-set pass."""
+        from znicz_tpu.core import normalization
+        if self.normalization_type in (None, "none"):
+            self.normalizer = normalization.NoneNormalizer()
+            return
+        self.normalizer = normalization.create(
+            self.normalization_type, **self.normalization_parameters)
+        keys = self._keys[TRAIN] or self._keys[VALID] or self._keys[TEST]
+        keys = keys[:self.normalizer_analysis_limit]
+        sample = numpy.stack([
+            self._prepare_image(self.get_image_data(k)) for k in keys])
+        self.normalizer.analyze(sample.reshape(len(keys), -1))
+
+    def _key_of_global_index(self, idx):
+        for clazz in (TEST, VALID, TRAIN):
+            start, end = self.class_index_range(clazz)
+            if start <= idx < end:
+                return self._keys[clazz][idx - start]
+        raise IndexError(idx)
+
+    def fill_minibatch(self):
+        idx = self.minibatch_indices.mem
+        self.minibatch_data.map_invalidate()
+        self.minibatch_labels.map_write()
+        n = self.minibatch_size
+        for i in range(n):
+            key = self._key_of_global_index(int(idx[i]))
+            self.minibatch_data.mem[i] = self._prepare_image(
+                self.get_image_data(key))
+            self.minibatch_labels.mem[i] = self._map_label(
+                self.get_image_label(key))
+        if self.normalizer is not None:
+            self.normalizer.normalize(
+                self.minibatch_data.mem[:n].reshape(n, -1))
+
+
+class FullBatchImageLoader(ImageLoaderBase, FullBatchLoader,
+                           IFullBatchLoader):
+    """Decodes the whole dataset into original_data at load time (the
+    reference FullBatchImageLoader contract) — gets normalization and
+    vectorized minibatch fill from FullBatchLoader."""
+
+    MAPPING = None
+    hide_from_registry = True
+
+    def load_data(self):
+        ImageLoaderBase.load_data(self)
+        shape = self._sample_shape()
+        total = self.total_samples
+        data = numpy.zeros((total,) + tuple(shape), dtype=self.source_dtype)
+        pos = 0
+        for clazz in (TEST, VALID, TRAIN):  # dataset layout order
+            for key in self._keys[clazz]:
+                data[pos] = self._prepare_image(self.get_image_data(key))
+                self._original_labels.append(
+                    self._map_label(self.get_image_label(key)))
+                pos += 1
+        self.original_data.mem = data
+
+    def create_minibatch_data(self):
+        FullBatchLoader.create_minibatch_data(self)
+
+    def fill_minibatch(self):
+        FullBatchLoader.fill_minibatch(self)
+
+
+class FileListImageLoader(ImageLoaderBase, IImageLoader):
+    """Images listed in an index file of ``path [label]`` lines
+    (reference FileListImageLoader contract); one list file per class.
+    """
+
+    MAPPING = "file_list_image"
+
+    def __init__(self, workflow, **kwargs):
+        super(FileListImageLoader, self).__init__(workflow, **kwargs)
+        self.path_to_test_text_file = kwargs.get("test_paths")
+        self.path_to_val_text_file = kwargs.get("validation_paths")
+        self.path_to_train_text_file = kwargs.get("train_paths")
+        self.base_directory = kwargs.get("base_directory", "")
+        self._lists = {TEST: self.path_to_test_text_file,
+                       VALID: self.path_to_val_text_file,
+                       TRAIN: self.path_to_train_text_file}
+
+    def get_keys(self, index):
+        paths = self._lists.get(index)
+        if not paths:
+            return []
+        if isinstance(paths, str):
+            paths = [paths]
+        keys = []
+        for list_file in paths:
+            with open(list_file) as fin:
+                for line in fin:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    parts = line.split()
+                    path = os.path.join(self.base_directory, parts[0])
+                    label = parts[1] if len(parts) > 1 else \
+                        os.path.basename(os.path.dirname(path))
+                    keys.append((path, label))
+        return keys
+
+    def get_image_data(self, key):
+        from PIL import Image
+        return numpy.asarray(Image.open(key[0]))
+
+    def get_image_label(self, key):
+        label = key[1]
+        try:
+            return int(label)
+        except (TypeError, ValueError):
+            return label
+
+    def get_image_info(self, key):
+        from PIL import Image
+        with Image.open(key[0]) as img:
+            return (img.height, img.width), img.mode
+
+
+class FullBatchFileListImageLoader(FullBatchImageLoader,
+                                   FileListImageLoader):
+    """MRO note: FullBatchImageLoader first so load_data /
+    create_minibatch_data / fill_minibatch resolve to the full-batch
+    versions; the key/data providers still come from the list loader."""
+
+    MAPPING = "full_batch_file_list_image"
+
+
+class AutoLabelFileImageLoader(ImageLoaderBase, IImageLoader):
+    """Scans directories of images; the label is the parent directory name
+    (reference FullBatchAutoLabelFileImageLoader contract)."""
+
+    MAPPING = "auto_label_file_image"
+    EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".pgm", ".ppm")
+
+    def __init__(self, workflow, **kwargs):
+        super(AutoLabelFileImageLoader, self).__init__(workflow, **kwargs)
+        self._dirs = {TEST: kwargs.get("test_paths"),
+                      VALID: kwargs.get("validation_paths"),
+                      TRAIN: kwargs.get("train_paths")}
+
+    def get_keys(self, index):
+        dirs = self._dirs.get(index)
+        if not dirs:
+            return []
+        if isinstance(dirs, str):
+            dirs = [dirs]
+        keys = []
+        for base in dirs:
+            for dirpath, _, files in sorted(os.walk(base)):
+                for name in sorted(files):
+                    if os.path.splitext(name)[1].lower() in self.EXTENSIONS:
+                        path = os.path.join(dirpath, name)
+                        keys.append((path, os.path.basename(dirpath)))
+        return keys
+
+    get_image_data = FileListImageLoader.get_image_data
+    get_image_label = FileListImageLoader.get_image_label
+    get_image_info = FileListImageLoader.get_image_info
+
+
+class FullBatchAutoLabelFileImageLoader(FullBatchImageLoader,
+                                        AutoLabelFileImageLoader):
+    MAPPING = "full_batch_auto_label_file_image"
