@@ -1,0 +1,63 @@
+// C API for ctypes/cffi bindings (the Python <-> C++ bridge; pybind11 is
+// not in the image, so the boundary is a plain C ABI).
+#include <cstring>
+#include <string>
+
+#include "workflow.h"
+
+extern "C" {
+
+// Returns an opaque workflow handle, or nullptr (error text via
+// znicz_last_error).
+void* znicz_load(const char* package_path);
+
+// Runs forward on (batch, sample_size) float32 input; writes
+// (batch, output_size) float32 to out.  Returns output_size, or -1.
+int znicz_infer(void* workflow, const float* in, int batch,
+                int sample_size, float* out, int out_capacity);
+
+void znicz_free(void* workflow);
+const char* znicz_last_error();
+
+}  // extern "C"
+
+namespace {
+thread_local std::string g_last_error;
+}
+
+void* znicz_load(const char* package_path) {
+  try {
+    return new znicz::Workflow(znicz::Workflow::Load(package_path));
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+int znicz_infer(void* workflow, const float* in, int batch,
+                int sample_size, float* out, int out_capacity) {
+  try {
+    auto* wf = static_cast<znicz::Workflow*>(workflow);
+    znicz::Tensor x;
+    x.shape = {static_cast<size_t>(batch),
+               static_cast<size_t>(sample_size)};
+    x.data.assign(in, in + static_cast<size_t>(batch) * sample_size);
+    znicz::Tensor y;
+    wf->Execute(x, &y);
+    if (y.data.size() > static_cast<size_t>(out_capacity)) {
+      g_last_error = "output buffer too small";
+      return -1;
+    }
+    memcpy(out, y.data.data(), y.data.size() * sizeof(float));
+    return static_cast<int>(y.cols());
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+void znicz_free(void* workflow) {
+  delete static_cast<znicz::Workflow*>(workflow);
+}
+
+const char* znicz_last_error() { return g_last_error.c_str(); }

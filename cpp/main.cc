@@ -1,0 +1,26 @@
+// CLI: znicz_infer <package.zip> <input.npy> <output.npy>
+// (functional-test driver, reference libZnicz/tests/functional_mnist.cc).
+#include <cstdio>
+
+#include "workflow.h"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr,
+            "usage: %s <package.zip> <input.npy> <output.npy>\n", argv[0]);
+    return 2;
+  }
+  try {
+    znicz::Workflow wf = znicz::Workflow::Load(argv[1]);
+    znicz::Tensor in = znicz::LoadNpyFile(argv[2]);
+    znicz::Tensor out;
+    wf.Execute(in, &out);
+    znicz::SaveNpyFile(argv[3], out);
+    printf("ok: %zu layers, batch %zu -> %zu outputs\n", wf.size(),
+           out.rows(), out.cols());
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,131 @@
+#include "npy.h"
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+namespace znicz {
+
+namespace {
+
+const char kMagic[] = "\x93NUMPY";
+
+std::string HeaderValue(const std::string& header, const std::string& key) {
+  size_t pos = header.find("'" + key + "'");
+  if (pos == std::string::npos)
+    throw std::runtime_error("npy header missing key " + key);
+  pos = header.find(':', pos);
+  size_t end = pos + 1;
+  int depth = 0;
+  while (end < header.size()) {
+    char c = header[end];
+    if (c == '(' || c == '[') ++depth;
+    if (c == ')' || c == ']') --depth;
+    if ((c == ',' || c == '}') && depth <= 0) break;
+    ++end;
+  }
+  std::string value = header.substr(pos + 1, end - pos - 1);
+  // trim spaces and quotes
+  size_t a = value.find_first_not_of(" '\"");
+  size_t b = value.find_last_not_of(" '\"");
+  if (a == std::string::npos) return "";
+  return value.substr(a, b - a + 1);
+}
+
+}  // namespace
+
+Tensor LoadNpy(const std::string& buffer) {
+  if (buffer.size() < 10 || memcmp(buffer.data(), kMagic, 6) != 0)
+    throw std::runtime_error("not an npy file");
+  uint8_t major = buffer[6];
+  size_t header_len, header_off;
+  if (major == 1) {
+    uint16_t len;
+    memcpy(&len, buffer.data() + 8, 2);
+    header_len = len;
+    header_off = 10;
+  } else {
+    uint32_t len;
+    memcpy(&len, buffer.data() + 8, 4);
+    header_len = len;
+    header_off = 12;
+  }
+  std::string header = buffer.substr(header_off, header_len);
+  std::string descr = HeaderValue(header, "descr");
+  std::string order = HeaderValue(header, "fortran_order");
+  if (order.find("True") != std::string::npos)
+    throw std::runtime_error("fortran_order arrays are unsupported");
+
+  Tensor t;
+  std::string shape = HeaderValue(header, "shape");
+  size_t pos = shape.find('(');
+  size_t end = shape.find(')');
+  std::stringstream ss(shape.substr(pos + 1, end - pos - 1));
+  std::string item;
+  while (std::getline(ss, item, ',')) {
+    size_t a = item.find_first_not_of(' ');
+    if (a == std::string::npos) continue;
+    t.shape.push_back(std::stoull(item.substr(a)));
+  }
+  if (t.shape.empty()) t.shape.push_back(1);
+
+  const char* payload = buffer.data() + header_off + header_len;
+  size_t n = t.size();
+  t.data.resize(n);
+  if (descr == "<f4" || descr == "|f4") {
+    if (buffer.size() < header_off + header_len + n * 4)
+      throw std::runtime_error("npy payload truncated");
+    memcpy(t.data.data(), payload, n * 4);
+  } else if (descr == "<f8") {
+    if (buffer.size() < header_off + header_len + n * 8)
+      throw std::runtime_error("npy payload truncated");
+    std::vector<double> tmp(n);
+    memcpy(tmp.data(), payload, n * 8);
+    for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(tmp[i]);
+  } else {
+    throw std::runtime_error("unsupported npy dtype: " + descr);
+  }
+  return t;
+}
+
+std::string SaveNpy(const Tensor& tensor) {
+  std::stringstream shape;
+  shape << "(";
+  for (size_t i = 0; i < tensor.shape.size(); ++i)
+    shape << tensor.shape[i] << (tensor.shape.size() == 1 ? "," : (
+        i + 1 < tensor.shape.size() ? ", " : ""));
+  shape << ")";
+  std::string header = "{'descr': '<f4', 'fortran_order': False, "
+                       "'shape': " + shape.str() + ", }";
+  size_t total = 10 + header.size() + 1;
+  header.append(63 - (total + 63) % 64, ' ');
+  header += '\n';
+
+  std::string out(kMagic, 6);
+  out += '\x01';
+  out += '\x00';
+  uint16_t len = static_cast<uint16_t>(header.size());
+  out.append(reinterpret_cast<const char*>(&len), 2);
+  out += header;
+  out.append(reinterpret_cast<const char*>(tensor.data.data()),
+             tensor.data.size() * 4);
+  return out;
+}
+
+Tensor LoadNpyFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return LoadNpy(ss.str());
+}
+
+void SaveNpyFile(const std::string& path, const Tensor& tensor) {
+  std::ofstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::string payload = SaveNpy(tensor);
+  f.write(payload.data(), payload.size());
+}
+
+}  // namespace znicz

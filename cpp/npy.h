@@ -1,0 +1,40 @@
+// Minimal NumPy .npy (format v1.0/2.0) reader/writer for float32/float64
+// C-order arrays.  TPU-era counterpart of libZnicz's NumpyArray loading
+// (reference libZnicz/src/all2all.h:73-78).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace znicz {
+
+struct Tensor {
+  std::vector<size_t> shape;
+  std::vector<float> data;  // runtime computes in float32
+
+  size_t size() const {
+    size_t n = 1;
+    for (size_t d : shape) n *= d;
+    return n;
+  }
+  size_t rows() const { return shape.empty() ? 0 : shape[0]; }
+  size_t cols() const {
+    size_t n = 1;
+    for (size_t i = 1; i < shape.size(); ++i) n *= shape[i];
+    return n;
+  }
+};
+
+// Parse a .npy from an in-memory buffer.  Throws std::runtime_error on
+// unsupported dtype/layout.
+Tensor LoadNpy(const std::string& buffer);
+
+// Serialize as float32 .npy v1.0.
+std::string SaveNpy(const Tensor& tensor);
+
+// Whole-file helpers.
+Tensor LoadNpyFile(const std::string& path);
+void SaveNpyFile(const std::string& path, const Tensor& tensor);
+
+}  // namespace znicz

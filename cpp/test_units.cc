@@ -1,0 +1,98 @@
+// Dependency-free numeric unit tests (gtest-parity scope:
+// reference libZnicz/tests/all2all*.cc).  Exits non-zero on failure.
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+
+#include "units.h"
+
+namespace {
+
+int g_failures = 0;
+
+#define CHECK_NEAR(a, b, tol)                                             \
+  do {                                                                    \
+    if (std::fabs((a) - (b)) > (tol)) {                                   \
+      fprintf(stderr, "FAIL %s:%d: |%g - %g| > %g\n", __FILE__, __LINE__, \
+              static_cast<double>(a), static_cast<double>(b),             \
+              static_cast<double>(tol));                                  \
+      ++g_failures;                                                       \
+    }                                                                     \
+  } while (0)
+
+znicz::Tensor T(std::vector<size_t> shape, std::vector<float> data) {
+  znicz::Tensor t;
+  t.shape = std::move(shape);
+  t.data = std::move(data);
+  return t;
+}
+
+void TestLinear() {
+  auto unit = znicz::CreateUnit("all2all");
+  unit->SetParameter("weights", T({2, 3}, {1, 2, 3, 4, 5, 6}));
+  unit->SetParameter("bias", T({2}, {0.5f, -0.5f}));
+  znicz::Tensor out;
+  unit->Execute(T({1, 3}, {1, 1, 1}), &out);
+  CHECK_NEAR(out.data[0], 6.5f, 1e-6);    // 1+2+3+0.5
+  CHECK_NEAR(out.data[1], 14.5f, 1e-6);   // 4+5+6-0.5
+}
+
+void TestTransposedWeights() {
+  auto unit = znicz::CreateUnit("all2all");
+  // stored (n_in=3, n_out=2) with transposed flag; same math as above
+  unit->SetParameter("weights", T({3, 2}, {1, 4, 2, 5, 3, 6}));
+  unit->SetParameter("weights_transposed", T({1}, {1}));
+  unit->SetParameter("bias", T({2}, {0.5f, -0.5f}));
+  znicz::Tensor out;
+  unit->Execute(T({1, 3}, {1, 1, 1}), &out);
+  CHECK_NEAR(out.data[0], 6.5f, 1e-6);
+  CHECK_NEAR(out.data[1], 14.5f, 1e-6);
+}
+
+void TestTanh() {
+  auto unit = znicz::CreateUnit("all2all_tanh");
+  unit->SetParameter("weights", T({1, 1}, {1}));
+  unit->SetParameter("bias", T({1}, {0}));
+  znicz::Tensor out;
+  unit->Execute(T({1, 1}, {2}), &out);
+  CHECK_NEAR(out.data[0], 1.7159 * std::tanh(0.6666 * 2.0), 1e-5);
+}
+
+void TestSoftmax() {
+  auto unit = znicz::CreateUnit("softmax");
+  unit->SetParameter("weights", T({3, 1}, {1, 1, 1}));
+  unit->SetParameter("bias", T({3}, {0, std::log(2.f), std::log(5.f)}));
+  znicz::Tensor out;
+  unit->Execute(T({1, 1}, {0}), &out);
+  CHECK_NEAR(out.data[0], 0.125f, 1e-5);
+  CHECK_NEAR(out.data[1], 0.25f, 1e-5);
+  CHECK_NEAR(out.data[2], 0.625f, 1e-5);
+  CHECK_NEAR(out.data[0] + out.data[1] + out.data[2], 1.0f, 1e-6);
+}
+
+void TestNpyRoundtrip() {
+  znicz::Tensor t = T({2, 2}, {1.5f, -2.f, 0.f, 3.25f});
+  znicz::Tensor u = znicz::LoadNpy(znicz::SaveNpy(t));
+  CHECK_NEAR(u.data[0], 1.5f, 0);
+  CHECK_NEAR(u.data[3], 3.25f, 0);
+  if (u.shape != t.shape) {
+    fprintf(stderr, "FAIL npy shape roundtrip\n");
+    ++g_failures;
+  }
+}
+
+}  // namespace
+
+int main() {
+  TestLinear();
+  TestTransposedWeights();
+  TestTanh();
+  TestSoftmax();
+  TestNpyRoundtrip();
+  if (g_failures) {
+    fprintf(stderr, "%d failures\n", g_failures);
+    return 1;
+  }
+  printf("all C++ unit tests passed\n");
+  return 0;
+}

@@ -1,0 +1,115 @@
+#include "units.h"
+
+#include <cmath>
+#include <stdexcept>
+
+namespace znicz {
+
+void Unit::SetParameter(const std::string& name, Tensor value) {
+  params_[name] = std::move(value);
+}
+
+void All2All::SetParameter(const std::string& name, Tensor value) {
+  if (name == "weights") {
+    weights_ = std::move(value);
+  } else if (name == "bias") {
+    bias_ = std::move(value);
+  } else if (name == "weights_transposed") {
+    weights_transposed_ = !value.data.empty() && value.data[0] != 0.f;
+  } else if (name == "include_bias") {
+    include_bias_ = value.data.empty() || value.data[0] != 0.f;
+  } else {
+    Unit::SetParameter(name, std::move(value));
+  }
+  if (!weights_.data.empty()) {
+    if (weights_transposed_) {
+      // stored (n_in, n_out): transpose once at load time
+      size_t n_in = weights_.shape[0], n_out = weights_.cols();
+      Tensor t;
+      t.shape = {n_out, n_in};
+      t.data.resize(weights_.data.size());
+      for (size_t i = 0; i < n_in; ++i)
+        for (size_t j = 0; j < n_out; ++j)
+          t.data[j * n_in + i] = weights_.data[i * n_out + j];
+      weights_ = std::move(t);
+      weights_transposed_ = false;
+    }
+    n_out_ = weights_.shape[0];
+    n_in_ = weights_.cols();
+  }
+}
+
+void All2All::Execute(const Tensor& in, Tensor* out) const {
+  size_t batch = in.rows();
+  size_t sample = in.cols();
+  if (sample != n_in_)
+    throw std::runtime_error("All2All: input sample size " +
+                             std::to_string(sample) + " != weights n_in " +
+                             std::to_string(n_in_));
+  out->shape = {batch, n_out_};
+  out->data.assign(batch * n_out_, 0.f);
+  const float* w = weights_.data.data();
+  for (size_t b = 0; b < batch; ++b) {
+    const float* x = in.data.data() + b * sample;
+    float* y = out->data.data() + b * n_out_;
+    for (size_t j = 0; j < n_out_; ++j) {
+      const float* wj = w + j * n_in_;
+      float acc = 0.f;
+      for (size_t i = 0; i < n_in_; ++i) acc += wj[i] * x[i];
+      y[j] = acc + (include_bias_ && !bias_.data.empty() ? bias_.data[j]
+                                                         : 0.f);
+    }
+  }
+  ApplyActivation(out->data.data(), out->data.size());
+}
+
+void All2AllTanh::ApplyActivation(float* data, size_t n) const {
+  // y = 1.7159 tanh(0.6666 x) (reference all2all.py:271)
+  for (size_t i = 0; i < n; ++i)
+    data[i] = 1.7159f * std::tanh(0.6666f * data[i]);
+}
+
+void All2AllSigmoid::ApplyActivation(float* data, size_t n) const {
+  for (size_t i = 0; i < n; ++i)
+    data[i] = 1.f / (1.f + std::exp(-data[i]));
+}
+
+void All2AllRELU::ApplyActivation(float* data, size_t n) const {
+  // softplus log(1 + e^x), clamped at x > 15 like the Python spec
+  // (ops/activations.py) so large pre-activations don't overflow exp
+  for (size_t i = 0; i < n; ++i)
+    data[i] = data[i] > 15.f ? data[i] : std::log1p(std::exp(data[i]));
+}
+
+void All2AllStrictRELU::ApplyActivation(float* data, size_t n) const {
+  for (size_t i = 0; i < n; ++i)
+    data[i] = data[i] > 0.f ? data[i] : 0.f;
+}
+
+void All2AllSoftmax::Execute(const Tensor& in, Tensor* out) const {
+  All2All::Execute(in, out);
+  size_t batch = out->rows(), n = out->cols();
+  for (size_t b = 0; b < batch; ++b) {
+    float* y = out->data.data() + b * n;
+    float mx = y[0];
+    for (size_t i = 1; i < n; ++i) mx = std::max(mx, y[i]);
+    float sum = 0.f;
+    for (size_t i = 0; i < n; ++i) {
+      y[i] = std::exp(y[i] - mx);
+      sum += y[i];
+    }
+    for (size_t i = 0; i < n; ++i) y[i] /= sum;
+  }
+}
+
+std::unique_ptr<Unit> CreateUnit(const std::string& type) {
+  if (type == "all2all") return std::make_unique<All2AllLinear>();
+  if (type == "all2all_tanh") return std::make_unique<All2AllTanh>();
+  if (type == "all2all_sigmoid") return std::make_unique<All2AllSigmoid>();
+  if (type == "all2all_relu") return std::make_unique<All2AllRELU>();
+  if (type == "all2all_str") return std::make_unique<All2AllStrictRELU>();
+  if (type == "softmax") return std::make_unique<All2AllSoftmax>();
+  throw std::runtime_error("unknown unit type: " + type);
+}
+
+}  // namespace znicz

@@ -1,0 +1,94 @@
+// C++ inference units — libZnicz parity scope.
+//
+// Reference: libZnicz/src/all2all.{cc,h} (All2All base: weights_, bias_,
+// Execute = GEMM + activation), all2all_linear.cc, all2all_tanh.cc
+// (y = 1.7159 tanh(0.6666 x)), all2all_softmax.cc, with units created by
+// a name factory (inc/znicz/units.h:48-50 DECLARE_UNIT).  Extended with
+// the remaining FC activations so every exported all2all* type runs.
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "npy.h"
+
+namespace znicz {
+
+class Unit {
+ public:
+  virtual ~Unit() = default;
+  virtual std::string Name() const = 0;
+  virtual void SetParameter(const std::string& name, Tensor value);
+  // in: (batch, sample_size) row-major; out resized by the unit.
+  virtual void Execute(const Tensor& in, Tensor* out) const = 0;
+  virtual size_t OutputSize() const = 0;
+
+ protected:
+  std::map<std::string, Tensor> params_;
+  bool include_bias_ = true;
+  bool weights_transposed_ = false;
+};
+
+class All2All : public Unit {
+ public:
+  void SetParameter(const std::string& name, Tensor value) override;
+  void Execute(const Tensor& in, Tensor* out) const override;
+  size_t OutputSize() const override { return n_out_; }
+
+ protected:
+  virtual void ApplyActivation(float* data, size_t n) const {}
+  Tensor weights_;  // (n_out, n_in) after transpose resolution
+  Tensor bias_;     // (n_out,)
+  size_t n_in_ = 0, n_out_ = 0;
+};
+
+class All2AllLinear : public All2All {
+ public:
+  std::string Name() const override { return "all2all"; }
+};
+
+class All2AllTanh : public All2All {
+ public:
+  std::string Name() const override { return "all2all_tanh"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+class All2AllSigmoid : public All2All {
+ public:
+  std::string Name() const override { return "all2all_sigmoid"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+class All2AllRELU : public All2All {  // softplus (reference all2all.py:298)
+ public:
+  std::string Name() const override { return "all2all_relu"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+class All2AllStrictRELU : public All2All {
+ public:
+  std::string Name() const override { return "all2all_str"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+// Softmax head: linear GEMM then row-wise exp-normalize.
+class All2AllSoftmax : public All2All {
+ public:
+  std::string Name() const override { return "softmax"; }
+  void Execute(const Tensor& in, Tensor* out) const override;
+};
+
+// Factory by type string (reference DECLARE_UNIT registration).
+std::unique_ptr<Unit> CreateUnit(const std::string& type);
+
+}  // namespace znicz

@@ -1,0 +1,70 @@
+#include "workflow.h"
+
+#include <sstream>
+#include <stdexcept>
+
+#include "zipstore.h"
+
+namespace znicz {
+
+Workflow Workflow::Load(const std::string& path) {
+  auto files = ReadZipStored(path);
+  auto it = files.find("manifest.txt");
+  if (it == files.end())
+    throw std::runtime_error("package has no manifest.txt");
+
+  Workflow wf;
+  std::stringstream manifest(it->second);
+  std::string line;
+  while (std::getline(manifest, line)) {
+    if (line.empty()) continue;
+    std::stringstream ls(line);
+    std::string kv, type;
+    std::vector<std::pair<std::string, std::string>> attrs;
+    while (ls >> kv) {
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = kv.substr(0, eq), value = kv.substr(eq + 1);
+      if (key == "type")
+        type = value;
+      else
+        attrs.emplace_back(key, value);
+    }
+    if (type.empty())
+      throw std::runtime_error("manifest line without type: " + line);
+    auto unit = CreateUnit(type);
+    for (const auto& attr : attrs) {
+      if (attr.second.size() > 4 &&
+          attr.second.substr(attr.second.size() - 4) == ".npy") {
+        auto fit = files.find(attr.second);
+        if (fit == files.end())
+          throw std::runtime_error("package missing " + attr.second);
+        unit->SetParameter(attr.first, LoadNpy(fit->second));
+      } else {
+        Tensor scalar;
+        scalar.shape = {1};
+        scalar.data = {std::stof(attr.second)};
+        unit->SetParameter(attr.first, scalar);
+      }
+    }
+    wf.units_.push_back(std::move(unit));
+  }
+  if (wf.units_.empty())
+    throw std::runtime_error("package has no layers");
+  return wf;
+}
+
+void Workflow::Execute(const Tensor& in, Tensor* out) const {
+  Tensor cur = in;
+  // flatten whatever sample rank to (batch, features)
+  cur.shape = {in.rows(), in.cols()};
+  Tensor next;
+  for (const auto& unit : units_) {
+    unit->Execute(cur, &next);
+    cur = std::move(next);
+    next = Tensor();
+  }
+  *out = std::move(cur);
+}
+
+}  // namespace znicz

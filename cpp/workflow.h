@@ -1,0 +1,26 @@
+// Package loading + forward execution (libVeles-engine parity scope:
+// load a package_export()ed model and run inference,
+// reference libZnicz/tests/functional_mnist.cc).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "units.h"
+
+namespace znicz {
+
+class Workflow {
+ public:
+  // Load a package zip written by znicz_tpu/export.py.
+  static Workflow Load(const std::string& path);
+
+  void Execute(const Tensor& in, Tensor* out) const;
+  size_t size() const { return units_.size(); }
+
+ private:
+  std::vector<std::unique_ptr<Unit>> units_;
+};
+
+}  // namespace znicz

@@ -1,0 +1,78 @@
+#include "zipstore.h"
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+namespace znicz {
+
+namespace {
+
+uint16_t U16(const std::string& b, size_t off) {
+  uint16_t v;
+  memcpy(&v, b.data() + off, 2);
+  return v;
+}
+
+uint32_t U32(const std::string& b, size_t off) {
+  uint32_t v;
+  memcpy(&v, b.data() + off, 4);
+  return v;
+}
+
+}  // namespace
+
+std::map<std::string, std::string> ReadZipStored(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  const std::string buf = ss.str();
+
+  // End-of-central-directory: signature 0x06054b50, scan backward over
+  // the (<=64KB) comment.
+  if (buf.size() < 22) throw std::runtime_error("zip too small");
+  size_t eocd = std::string::npos;
+  size_t stop = buf.size() >= 22 + 65535 ? buf.size() - 22 - 65535 : 0;
+  for (size_t i = buf.size() - 22; ; --i) {
+    if (U32(buf, i) == 0x06054b50) {
+      eocd = i;
+      break;
+    }
+    if (i == stop) break;
+  }
+  if (eocd == std::string::npos)
+    throw std::runtime_error("zip: no end-of-central-directory");
+  uint16_t n_entries = U16(buf, eocd + 10);
+  size_t cd_off = U32(buf, eocd + 16);
+
+  std::map<std::string, std::string> out;
+  size_t pos = cd_off;
+  for (uint16_t i = 0; i < n_entries; ++i) {
+    if (U32(buf, pos) != 0x02014b50)
+      throw std::runtime_error("zip: bad central-directory entry");
+    uint16_t method = U16(buf, pos + 10);
+    uint32_t comp_size = U32(buf, pos + 20);
+    uint16_t name_len = U16(buf, pos + 28);
+    uint16_t extra_len = U16(buf, pos + 30);
+    uint16_t comment_len = U16(buf, pos + 32);
+    uint32_t local_off = U32(buf, pos + 42);
+    std::string name = buf.substr(pos + 46, name_len);
+    if (method != 0)
+      throw std::runtime_error("zip: entry " + name +
+                               " is compressed; packages are stored");
+    // local header: skip its own (possibly different) name/extra lengths
+    if (U32(buf, local_off) != 0x04034b50)
+      throw std::runtime_error("zip: bad local header for " + name);
+    uint16_t lname = U16(buf, local_off + 26);
+    uint16_t lextra = U16(buf, local_off + 28);
+    size_t data_off = local_off + 30 + lname + lextra;
+    out[name] = buf.substr(data_off, comp_size);
+    pos += 46 + name_len + extra_len + comment_len;
+  }
+  return out;
+}
+
+}  // namespace znicz

@@ -1,0 +1,15 @@
+// Minimal reader for STORED-entry zip archives (the package format
+// written by znicz_tpu/export.py).  No inflate: packages are written
+// uncompressed on purpose.
+#pragma once
+
+#include <map>
+#include <string>
+
+namespace znicz {
+
+// Returns {filename: content} for every stored entry.
+// Throws std::runtime_error on malformed archives or compressed entries.
+std::map<std::string, std::string> ReadZipStored(const std::string& path);
+
+}  // namespace znicz

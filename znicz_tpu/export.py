@@ -1,0 +1,124 @@
+"""Deployment package export/import.
+
+TPU-era equivalent of the reference's ``Forward.package_export`` → zip →
+libZnicz deployment path (reference nn_units.py:152-161, mnist.py:124-127,
+libZnicz/src/all2all.cc).  The package is an **uncompressed** zip:
+
+* ``manifest.json`` — human/python metadata: format version, workflow
+  name, per-layer type string + attribute map;
+* ``manifest.txt``  — the same layer list in a line-based form the C++
+  runtime parses without a JSON dependency:
+  ``type=all2all_tanh weights=layer0_weights.npy bias=layer0_bias.npy
+  weights_transposed=0 include_bias=1``;
+* ``layerN_<attr>.npy`` — one NumPy file per exported array.
+
+Stored (not deflated) entries keep the C++ zip reader trivial; model
+weights compress poorly anyway.  ``cpp/`` implements the consumer:
+a C++ inference runtime covering the libZnicz unit scope.
+"""
+
+import io
+import json
+import os
+import zipfile
+
+import numpy
+
+
+def _layer_type(fwd):
+    mapping = getattr(type(fwd), "MAPPING", None)
+    if not mapping:
+        raise ValueError("%s has no MAPPING type string" % type(fwd))
+    return sorted(mapping)[0]
+
+
+def export_package(workflow, path):
+    """Write ``workflow``'s forward stack as a deployment package.
+
+    ``workflow`` needs a ``forwards`` list (StandardWorkflow / NNWorkflow
+    contract); returns the path written.
+    """
+    forwards = list(workflow.forwards)
+    layers = []
+    files = {}
+    for i, fwd in enumerate(forwards):
+        entry = {"type": _layer_type(fwd), "name": fwd.name, "arrays": {}}
+        data = fwd.package_export()
+        for attr, value in data.items():
+            if isinstance(value, numpy.ndarray):
+                fname = "layer%d_%s.npy" % (i, attr)
+                files[fname] = value
+                entry["arrays"][attr] = fname
+            else:
+                if isinstance(value, (tuple, set, frozenset)):
+                    value = list(value)
+                entry[attr] = value
+        layers.append(entry)
+    manifest = {
+        "format": 1,
+        "workflow": type(workflow).__name__,
+        "layers": layers,
+    }
+
+    lines = []
+    for i, entry in enumerate(layers):
+        parts = ["type=%s" % entry["type"]]
+        for attr, fname in sorted(entry["arrays"].items()):
+            parts.append("%s=%s" % (attr, fname))
+        for attr in ("weights_transposed", "include_bias"):
+            if attr in entry:
+                parts.append("%s=%d" % (attr, int(bool(entry[attr]))))
+        lines.append(" ".join(parts))
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr("manifest.json", json.dumps(manifest, indent=2,
+                                                default=repr))
+        zf.writestr("manifest.txt", "\n".join(lines) + "\n")
+        for fname, value in files.items():
+            buf = io.BytesIO()
+            numpy.save(buf, numpy.ascontiguousarray(value))
+            zf.writestr(fname, buf.getvalue())
+    return path
+
+
+def load_package(path):
+    """Read a package back: (manifest dict, {filename: ndarray})."""
+    with zipfile.ZipFile(path) as zf:
+        manifest = json.loads(zf.read("manifest.json"))
+        arrays = {}
+        for info in zf.infolist():
+            if info.filename.endswith(".npy"):
+                arrays[info.filename] = numpy.load(
+                    io.BytesIO(zf.read(info.filename)))
+    return manifest, arrays
+
+
+def run_package_numpy(path, x):
+    """Execute a package forward in pure numpy — the executable spec the
+    C++ runtime (cpp/) must match to 1e-5."""
+    from znicz_tpu.ops import dense
+    manifest, arrays = load_package(path)
+    y = numpy.asarray(x, dtype=numpy.float64).reshape(len(x), -1)
+    for entry in manifest["layers"]:
+        tpe = entry["type"]
+        w = arrays[entry["arrays"]["weights"]]
+        if entry.get("weights_transposed"):
+            w = w.T
+        b = arrays.get(entry["arrays"].get("bias", ""), None)
+        include_bias = bool(entry.get("include_bias", True)) and \
+            b is not None
+        if tpe == "softmax":
+            y = dense.forward_numpy(y, w, b, activation="linear",
+                                    include_bias=include_bias)
+            y, _ = dense.softmax_numpy(y)
+        elif tpe.startswith("all2all"):
+            act = {"all2all": "linear", "all2all_tanh": "tanh",
+                   "all2all_relu": "relu", "all2all_str": "strict_relu",
+                   "all2all_sigmoid": "sigmoid"}[tpe]
+            y = dense.forward_numpy(y, w, b, activation=act,
+                                    include_bias=include_bias)
+        else:
+            raise ValueError(
+                "package runner supports the FC family only, got %r"
+                % tpe)
+    return y

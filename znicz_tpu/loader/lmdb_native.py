@@ -67,6 +67,13 @@ class LMDBReader(object):
             # streaming loaders exist precisely to avoid holding them
             self._buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         self.path = path
+        # liblmdb sizes pages from the creating host's OS page size —
+        # probe meta page 1 at the candidate strides
+        self.pagesize = PAGESIZE
+        for candidate in (4096, 8192, 16384, 32768, 65536):
+            self.pagesize = candidate
+            if self._parse_meta(1) is not None:
+                break
         meta = None
         for pgno in (0, 1):
             m = self._parse_meta(pgno)
@@ -78,7 +85,7 @@ class LMDBReader(object):
         self.entries = self._main["entries"]
 
     def _parse_meta(self, pgno):
-        off = pgno * PAGESIZE
+        off = pgno * self.pagesize
         if len(self._buf) < off + PAGEHDRSZ + _META.size + 2 * _DB.size + 16:
             return None
         _, _, flags, _, _ = _PAGEHDR.unpack_from(self._buf, off)
@@ -100,8 +107,8 @@ class LMDBReader(object):
 
     # -- page access --------------------------------------------------------
     def _page(self, pgno):
-        off = pgno * PAGESIZE
-        if off + PAGESIZE > len(self._buf):
+        off = pgno * self.pagesize
+        if off + self.pagesize > len(self._buf):
             raise LMDBError("page %d out of range" % pgno)
         return off
 
@@ -203,6 +210,10 @@ def write_lmdb(path, items):
         os.makedirs(path, exist_ok=True)
         path = os.path.join(path, "data.mdb")
     items = sorted((bytes(k), bytes(v)) for k, v in items)
+    for k, _ in items:
+        if len(k) > 511:  # liblmdb mdb_env_get_maxkeysize default
+            raise LMDBError(
+                "key of %d bytes exceeds LMDB's 511-byte limit" % len(k))
     space = PAGESIZE - PAGEHDRSZ
     next_pgno = 2
     pages = {}   # pgno -> bytes
