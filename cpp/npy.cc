@@ -46,11 +46,14 @@ Tensor LoadNpy(const std::string& buffer) {
     header_len = len;
     header_off = 10;
   } else {
+    if (buffer.size() < 12) throw std::runtime_error("npy v2 truncated");
     uint32_t len;
     memcpy(&len, buffer.data() + 8, 4);
     header_len = len;
     header_off = 12;
   }
+  if (buffer.size() < header_off + header_len)
+    throw std::runtime_error("npy header truncated");
   std::string header = buffer.substr(header_off, header_len);
   std::string descr = HeaderValue(header, "descr");
   std::string order = HeaderValue(header, "fortran_order");
@@ -116,9 +119,11 @@ std::string SaveNpy(const Tensor& tensor) {
 Tensor LoadNpyFile(const std::string& path) {
   std::ifstream f(path, std::ios::binary);
   if (!f) throw std::runtime_error("cannot open " + path);
-  std::stringstream ss;
-  ss << f.rdbuf();
-  return LoadNpy(ss.str());
+  f.seekg(0, std::ios::end);
+  std::string buf(static_cast<size_t>(f.tellg()), '\0');
+  f.seekg(0);
+  f.read(&buf[0], buf.size());
+  return LoadNpy(buf);
 }
 
 void SaveNpyFile(const std::string& path, const Tensor& tensor) {

@@ -27,9 +27,10 @@ uint32_t U32(const std::string& b, size_t off) {
 std::map<std::string, std::string> ReadZipStored(const std::string& path) {
   std::ifstream f(path, std::ios::binary);
   if (!f) throw std::runtime_error("cannot open " + path);
-  std::stringstream ss;
-  ss << f.rdbuf();
-  const std::string buf = ss.str();
+  f.seekg(0, std::ios::end);
+  std::string buf(static_cast<size_t>(f.tellg()), '\0');
+  f.seekg(0);
+  f.read(&buf[0], buf.size());
 
   // End-of-central-directory: signature 0x06054b50, scan backward over
   // the (<=64KB) comment.
@@ -51,26 +52,31 @@ std::map<std::string, std::string> ReadZipStored(const std::string& path) {
   std::map<std::string, std::string> out;
   size_t pos = cd_off;
   for (uint16_t i = 0; i < n_entries; ++i) {
-    if (U32(buf, pos) != 0x02014b50)
+    if (pos + 46 > buf.size() || U32(buf, pos) != 0x02014b50)
       throw std::runtime_error("zip: bad central-directory entry");
     uint16_t method = U16(buf, pos + 10);
     uint32_t comp_size = U32(buf, pos + 20);
     uint16_t name_len = U16(buf, pos + 28);
     uint16_t extra_len = U16(buf, pos + 30);
     uint16_t comment_len = U16(buf, pos + 32);
-    uint32_t local_off = U32(buf, pos + 42);
+    size_t local_off = U32(buf, pos + 42);
+    if (pos + 46 + name_len > buf.size())
+      throw std::runtime_error("zip: truncated entry name");
     std::string name = buf.substr(pos + 46, name_len);
     if (method != 0)
       throw std::runtime_error("zip: entry " + name +
                                " is compressed; packages are stored");
     // local header: skip its own (possibly different) name/extra lengths
-    if (U32(buf, local_off) != 0x04034b50)
+    if (local_off + 30 > buf.size() ||
+        U32(buf, local_off) != 0x04034b50)
       throw std::runtime_error("zip: bad local header for " + name);
     uint16_t lname = U16(buf, local_off + 26);
     uint16_t lextra = U16(buf, local_off + 28);
     size_t data_off = local_off + 30 + lname + lextra;
+    if (data_off + comp_size > buf.size())
+      throw std::runtime_error("zip: truncated data for " + name);
     out[name] = buf.substr(data_off, comp_size);
-    pos += 46 + name_len + extra_len + comment_len;
+    pos += 46 + static_cast<size_t>(name_len) + extra_len + comment_len;
   }
   return out;
 }

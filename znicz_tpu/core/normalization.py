@@ -102,6 +102,12 @@ class LinearNormalizer(NormalizerBase):
         return data
 
 
+@register("range_linear")
+class RangeLinearNormalizer(LinearNormalizer):
+    """Whole-tensor linear map to a configurable interval (parity:
+    the reference's "range_linear" target normalizer, Kanji config)."""
+
+
 @register("internal_mean")
 class InternalMeanNormalizer(NormalizerBase):
     """Subtract the training set's mean sample (Caffe-style; reference

@@ -44,6 +44,9 @@ class ImageLoaderBase(Loader):
         #: (streaming sets don't fit in RAM; the fit is statistical)
         self.normalizer_analysis_limit = kwargs.get(
             "normalizer_analysis_limit", 2048)
+        #: carve VALID out of TRAIN when the source has no validation
+        #: split (reference loaders' validation_ratio kwarg)
+        self.validation_ratio = kwargs.get("validation_ratio", 0.0)
         self._keys = {TEST: [], VALID: [], TRAIN: []}
         self._label_to_int = {}
         self._distinct_labels = set()
@@ -114,9 +117,18 @@ class ImageLoaderBase(Loader):
         # the softmax head) is deterministic
         for clazz in (TEST, VALID, TRAIN):
             self._keys[clazz] = list(self.get_keys(clazz))
-            self.class_lengths[clazz] = len(self._keys[clazz])
             for key in self._keys[clazz]:
                 self._map_label(self.get_image_label(key))
+        if self.validation_ratio > 0 and not self._keys[VALID] and \
+                self._keys[TRAIN]:
+            n = len(self._keys[TRAIN])
+            n_valid = max(1, int(n * self.validation_ratio))
+            perm = self.prng.permutation(n)
+            keys = self._keys[TRAIN]
+            self._keys[VALID] = [keys[i] for i in sorted(perm[:n_valid])]
+            self._keys[TRAIN] = [keys[i] for i in sorted(perm[n_valid:])]
+        for clazz in (TEST, VALID, TRAIN):
+            self.class_lengths[clazz] = len(self._keys[clazz])
 
     def create_minibatch_data(self):
         shape = self._sample_shape()

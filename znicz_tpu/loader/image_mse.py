@@ -1,0 +1,103 @@
+"""Image loaders with per-label target images (the Kanji pattern).
+
+Parity target: the reference's ``full_batch_auto_label_file_image_mse``
+loader (samples/Kanji/kanji_config.py:55 — data images labeled by
+directory, one target image per label, MSE objective against the label's
+target; ``class_targets`` enables the nearest-target classification
+metric, evaluator.py:334-556).
+"""
+
+import os
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.loader.base import FullBatchLoaderMSEMixin, TEST, VALID, TRAIN
+from znicz_tpu.loader.image import (
+    FullBatchImageLoader, AutoLabelFileImageLoader, IImageLoader)
+
+
+class FullBatchImageLoaderMSE(FullBatchLoaderMSEMixin, FullBatchImageLoader):
+    """Full-batch image loader whose targets are per-label images.
+
+    ``target_paths`` directories hold one image per label, either named
+    ``<label>.<ext>`` or inside a ``<label>/`` subdirectory;
+    ``targets_shape`` optionally rescales them.
+    """
+
+    MAPPING = None
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(FullBatchImageLoaderMSE, self).__init__(workflow, **kwargs)
+        self.target_paths = kwargs.get("target_paths") or []
+        if isinstance(self.target_paths, str):
+            self.target_paths = [self.target_paths]
+        self.targets_scale = kwargs.get("targets_shape")
+        self.class_targets = Array(name="class_targets")
+        self._target_by_label = {}
+
+    def _load_targets(self):
+        exts = AutoLabelFileImageLoader.EXTENSIONS
+        for base in self.target_paths:
+            for dirpath, _, files in sorted(os.walk(base)):
+                for name in sorted(files):
+                    stem, ext = os.path.splitext(name)
+                    if ext.lower() not in exts:
+                        continue
+                    label = stem if os.path.abspath(dirpath) == \
+                        os.path.abspath(base) else os.path.basename(dirpath)
+                    img = self._prepare_target(
+                        os.path.join(dirpath, name))
+                    self._target_by_label[label] = img
+        if not self._target_by_label:
+            raise ValueError("%s: no target images under %s"
+                             % (self.name, self.target_paths))
+
+    def _prepare_target(self, path):
+        from PIL import Image
+        img = numpy.asarray(Image.open(path))
+        if img.ndim == 3 and img.shape[2] == 1:
+            img = img[:, :, 0]
+        if self.targets_scale is not None and \
+                img.shape[:2] != tuple(self.targets_scale):
+            pil = Image.fromarray(img)
+            pil = pil.resize((self.targets_scale[1],
+                              self.targets_scale[0]), Image.BILINEAR)
+            img = numpy.asarray(pil)
+        return img.astype(self.source_dtype)
+
+    def load_data(self):
+        self._load_targets()
+        super(FullBatchImageLoaderMSE, self).load_data()
+        # dataset layout [TEST | VALID | TRAIN]
+        targets = []
+        labels_int = []
+        for clazz in (TEST, VALID, TRAIN):
+            for key in self._keys[clazz]:
+                label = self.get_image_label(key)
+                if label not in self._target_by_label:
+                    raise KeyError(
+                        "no target image for label %r" % (label,))
+                targets.append(self._target_by_label[label])
+                labels_int.append(self._map_label(label))
+        self.original_targets.mem = numpy.stack(targets)
+        # one target per distinct label, ordered by the int mapping —
+        # enables EvaluatorMSE's nearest-target n_err metric
+        by_int = {}
+        for label, img in self._target_by_label.items():
+            by_int[self._map_label(label)] = img
+        self.class_targets.reset(numpy.stack(
+            [by_int[i] for i in sorted(by_int)]))
+
+    def _apply_target_normalization(self):
+        super(FullBatchImageLoaderMSE, self)._apply_target_normalization()
+        # keep class_targets in the same normalized space as the targets
+        ct = self.class_targets.mem
+        self.target_normalizer.normalize(ct.reshape(ct.shape[0], -1))
+
+
+class FullBatchAutoLabelFileImageLoaderMSE(FullBatchImageLoaderMSE,
+                                           AutoLabelFileImageLoader,
+                                           IImageLoader):
+    MAPPING = "full_batch_auto_label_file_image_mse"

@@ -67,13 +67,22 @@ class LMDBReader(object):
             # streaming loaders exist precisely to avoid holding them
             self._buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         self.path = path
-        # liblmdb sizes pages from the creating host's OS page size —
-        # probe meta page 1 at the candidate strides
+        # liblmdb sizes pages from the creating host's OS page size and
+        # records it in the meta's FREE-db pad field (mm_psize); meta page
+        # 0 sits at offset 0 regardless of stride, so read it from there,
+        # falling back to probing meta page 1 when implausible
         self.pagesize = PAGESIZE
-        for candidate in (4096, 8192, 16384, 32768, 65536):
-            self.pagesize = candidate
-            if self._parse_meta(1) is not None:
-                break
+        meta0 = self._parse_meta(0)
+        psize = meta0["free"]["pad"] if meta0 else 0
+        if 512 <= psize <= 65536 and psize & (psize - 1) == 0:
+            self.pagesize = psize
+        else:
+            for candidate in (4096, 8192, 16384, 32768, 65536):
+                self.pagesize = candidate
+                if self._parse_meta(1) is not None:
+                    break
+            else:
+                self.pagesize = PAGESIZE
         meta = None
         for pgno in (0, 1):
             m = self._parse_meta(pgno)
@@ -331,7 +340,8 @@ def write_lmdb(path, items):
         _META.pack_into(buf, PAGEHDRSZ, MDB_MAGIC, MDB_VERSION, 0,
                         max(next_pgno * PAGESIZE, 1 << 20))
         dbs = PAGEHDRSZ + _META.size
-        _DB.pack_into(buf, dbs, 0, 0, 0, 0, 0, 0, 0, P_INVALID)   # FREE
+        # FREE db; its pad field doubles as mm_psize in the meta layout
+        _DB.pack_into(buf, dbs, PAGESIZE, 0, 0, 0, 0, 0, 0, P_INVALID)
         _DB.pack_into(buf, dbs + _DB.size, 0, 0, depth, n_branch, n_leaf,
                       n_ovf, len(items), root)                    # MAIN
         struct.pack_into("<QQ", buf, dbs + 2 * _DB.size, last_pg, txnid)

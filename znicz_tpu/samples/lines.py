@@ -1,0 +1,119 @@
+"""Lines sample — line-orientation classification via mcdnnic topology.
+
+Parity target: reference samples/Lines (lines_config.py): auto-labeled
+image directories, mcdnnic topology "12x256x256-32C4-MP2-64C4-MP3-32N-4N",
+mean_disp normalization, baseline 8.33% val err (BASELINE.md).  The
+reference downloads lines_min.tar; this box materializes a deterministic
+synthetic set of line drawings (4 orientation classes) in the same layout
+when absent.
+"""
+
+import os
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+DATA_DIR = os.path.join(root.common.dirs.datasets, "lines")
+
+root.lines.update({
+    "loss_function": "softmax",
+    "loader_name": "full_batch_auto_label_file_image",
+    "mcdnnic_topology": "12x256x256-32C4-MP2-64C4-MP3-32N-4N",
+    "mcdnnic_parameters": {"<-": {"learning_rate": 0.01}},
+    "decision": {"fail_iterations": 100,
+                 "max_epochs": int(numpy.iinfo(numpy.uint32).max)},
+    "snapshotter": {"prefix": "lines", "interval": 1, "time_interval": 0,
+                    "compression": ""},
+    "loader": {"minibatch_size": 12,
+               "normalization_type": "mean_disp",
+               "train_paths": [os.path.join(DATA_DIR, "learn")],
+               "validation_paths": [os.path.join(DATA_DIR, "test")]},
+})
+
+CLASSES = ("horizontal", "vertical", "diag_down", "diag_up")
+
+
+def _draw_line(size, clazz, offset, thickness, rng):
+    img = numpy.zeros((size, size), dtype=numpy.uint8)
+    idx = numpy.arange(size)
+    if clazz == 0:      # horizontal
+        img[max(0, offset):offset + thickness, :] = 255
+    elif clazz == 1:    # vertical
+        img[:, max(0, offset):offset + thickness] = 255
+    elif clazz == 2:    # diagonal down
+        for t in range(thickness):
+            d = numpy.clip(idx + offset - size // 2 + t, 0, size - 1)
+            img[idx, d] = 255
+    else:               # diagonal up
+        for t in range(thickness):
+            d = numpy.clip(size - 1 - idx + offset - size // 2 + t,
+                           0, size - 1)
+            img[idx, d] = 255
+    noise = rng.normal(0, 20, img.shape)
+    return numpy.clip(img.astype(numpy.float64) + noise,
+                      0, 255).astype(numpy.uint8)
+
+
+def materialize_synthetic(data_dir=None, size=256, per_class=12,
+                          seed=0x11E5):
+    from PIL import Image
+    data_dir = data_dir or DATA_DIR
+    if os.path.isdir(os.path.join(data_dir, "learn")):
+        return data_dir
+    rng = numpy.random.RandomState(seed)
+    for split, n in (("learn", per_class), ("test", max(2, per_class // 3))):
+        for c, label in enumerate(CLASSES):
+            cls_dir = os.path.join(data_dir, split, label)
+            os.makedirs(cls_dir, exist_ok=True)
+            for i in range(n):
+                img = _draw_line(size, c, rng.randint(2, size - 6),
+                                 rng.randint(2, 6), rng)
+                Image.fromarray(img).save(
+                    os.path.join(cls_dir, "%03d.png" % i))
+    return data_dir
+
+
+class LinesWorkflow(StandardWorkflow):
+    """Model created for line-orientation recognition
+    (reference samples/Lines/lines.py)."""
+
+
+def build(loader_config=None, decision_config=None, mcdnnic_topology=None,
+          mcdnnic_parameters=None, **kwargs):
+    cfg = root.lines
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    train_paths = loader_cfg.get("train_paths") or []
+    if not any(os.path.isdir(p) for p in train_paths):
+        base = os.path.dirname(train_paths[0]) if train_paths else None
+        size = 256
+        topo = mcdnnic_topology or cfg.mcdnnic_topology
+        size = int(topo.split("-")[0].split("x")[1])
+        materialize_synthetic(base, size=size)
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    kwargs.setdefault("loss_function", cfg.loss_function)
+    return LinesWorkflow(
+        mcdnnic_topology=mcdnnic_topology or cfg.mcdnnic_topology,
+        mcdnnic_parameters=(mcdnnic_parameters if mcdnnic_parameters
+                            is not None
+                            else cfg.mcdnnic_parameters.as_dict()),
+        loader_name=cfg.loader_name,
+        loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=cfg.snapshotter.as_dict(),
+        **kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+if __name__ == "__main__":
+    wf = run_sample()
+    print("best validation/train err%:", wf.decision.best_n_err_pt)

@@ -182,9 +182,12 @@ class EvaluatorMSE(EvaluatorBase):
 
     def initialize(self, device=None, **kwargs):
         super(EvaluatorMSE, self).initialize(device=device, **kwargs)
-        if self.output.shape != self.target.shape:
+        if self.output.size != self.target.size or \
+                self.output.shape[0] != self.target.shape[0]:
+            # same batch + same per-sample size; sample RANK may differ
+            # (e.g. a flat RBM reconstruction vs an image target)
             raise ValueError(
-                "output shape %s != target shape %s"
+                "output shape %s and target shape %s are incompatible"
                 % (self.output.shape, self.target.shape))
         self.metrics.reset(numpy.zeros(3, dtype=self.output.dtype))
         self.metrics.mem[2] = numpy.inf

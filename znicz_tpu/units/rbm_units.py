@@ -41,11 +41,6 @@ class Binarization(AcceleratedUnit, EmptyDeviceMethodsMixin):
         self.rand = kwargs.get("rand", prng.get())
         self.demand("input", "batch_size")
 
-    def initialize(self, device=None, **kwargs):
-        super(Binarization, self).initialize(device=device, **kwargs)
-        if not self.output or self.output.size != self.input.size:
-            self.output.reset(numpy.zeros_like(self.input.mem))
-
     def matlab_binornd(self, n, p_in):
         """(reference rbm_units.py:112-152 — preserves the draw order)"""
         p = numpy.copy(p_in)
@@ -60,13 +55,20 @@ class Binarization(AcceleratedUnit, EmptyDeviceMethodsMixin):
             return (f < p).sum(axis=0)
         raise ValueError("Binarization input must be 1D or 2D")
 
+    def initialize(self, device=None, **kwargs):
+        super(Binarization, self).initialize(device=device, **kwargs)
+        if not self.output or self.output.size != self.input.size:
+            # output is the 2D (n_samples, sample_size) view — RBM layers
+            # operate on flat samples whatever the loader's sample shape
+            self.output.reset(numpy.zeros_like(self.input.matrix))
+
     def run(self):
         self.output.map_invalidate()
         self.input.map_read()
-        self.output.mem[:] = self.input.mem[:]
+        inp = self.input.matrix
+        self.output.mem[:] = inp[:]
         bs = int(self.batch_size)
-        self.output.mem[:bs, :] = self.matlab_binornd(
-            1, self.input.mem[:bs, :])
+        self.output.mem[:bs, :] = self.matlab_binornd(1, inp[:bs, :])
 
 
 class IterationCounter(Unit):
