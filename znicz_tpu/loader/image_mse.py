@@ -82,11 +82,17 @@ class FullBatchImageLoaderMSE(FullBatchLoaderMSEMixin, FullBatchImageLoader):
                 targets.append(self._target_by_label[label])
                 labels_int.append(self._map_label(label))
         self.original_targets.mem = numpy.stack(targets)
-        # one target per distinct label, ordered by the int mapping —
-        # enables EvaluatorMSE's nearest-target n_err metric
+        # one target per distinct DATA label, ordered by the int mapping —
+        # enables EvaluatorMSE's nearest-target n_err metric.  Targets for
+        # labels with no data samples are skipped (mapping them would add
+        # phantom classes).
         by_int = {}
         for label, img in self._target_by_label.items():
-            by_int[self._map_label(label)] = img
+            if label in self._label_to_int:
+                by_int[self._label_to_int[label]] = img
+            else:
+                self.warning("target image for unused label %r skipped",
+                             label)
         self.class_targets.reset(numpy.stack(
             [by_int[i] for i in sorted(by_int)]))
 

@@ -17,7 +17,11 @@ from znicz_tpu.core.config import root
 from znicz_tpu.standard_workflow import StandardWorkflow
 import znicz_tpu.loader.image_mse  # noqa: F401 (registers the loader)
 
-DATA_DIR = os.path.join(root.common.dirs.datasets, "kanji")
+def data_dir():
+    """Resolved per call — root.common.dirs.datasets may change at
+    runtime (tests point it at tmp dirs)."""
+    return os.path.join(root.common.dirs.datasets, "kanji")
+
 
 root.kanji.update({
     "decision": {"fail_iterations": 1000, "max_epochs": 10000},
@@ -26,8 +30,6 @@ root.kanji.update({
     "snapshotter": {"prefix": "kanji", "interval": 1, "time_interval": 0,
                     "compression": ""},
     "loader": {"minibatch_size": 50,
-               "train_paths": [os.path.join(DATA_DIR, "train")],
-               "target_paths": [os.path.join(DATA_DIR, "target")],
                "normalization_type": "linear",
                "targets_normalization_type": "range_linear",
                "targets_shape": (24, 24),
@@ -55,17 +57,17 @@ root.kanji.update({
 })
 
 
-def materialize_synthetic(data_dir=None, n_classes=6, per_class=30,
+def materialize_synthetic(base_dir=None, n_classes=6, per_class=30,
                           seed=0x4A17):
     """Deterministic synthetic glyph set in the reference's layout:
     ``train/<label>/*.png`` noisy 32x32 renderings, ``target/<label>.png``
     clean 24x24 prototypes."""
     from PIL import Image
-    data_dir = data_dir or DATA_DIR
-    train_dir = os.path.join(data_dir, "train")
-    target_dir = os.path.join(data_dir, "target")
+    base_dir = base_dir or data_dir()
+    train_dir = os.path.join(base_dir, "train")
+    target_dir = os.path.join(base_dir, "target")
     if os.path.isdir(train_dir) and os.path.isdir(target_dir):
-        return data_dir
+        return base_dir
     r = numpy.random.RandomState(seed)
     os.makedirs(target_dir, exist_ok=True)
     for c in range(n_classes):
@@ -92,7 +94,7 @@ def materialize_synthetic(data_dir=None, n_classes=6, per_class=30,
             Image.fromarray(
                 numpy.clip(noisy, 0, 255).astype(numpy.uint8)).save(
                     os.path.join(cls_dir, "%03d.png" % i))
-    return data_dir
+    return base_dir
 
 
 class KanjiWorkflow(StandardWorkflow):
@@ -103,6 +105,10 @@ class KanjiWorkflow(StandardWorkflow):
 def build(layers=None, loader_config=None, decision_config=None, **kwargs):
     cfg = root.kanji
     loader_cfg = cfg.loader.as_dict()
+    # default paths resolve against the CURRENT datasets dir
+    loader_cfg.setdefault("train_paths", [os.path.join(data_dir(), "train")])
+    loader_cfg.setdefault("target_paths",
+                          [os.path.join(data_dir(), "target")])
     loader_cfg.update(loader_config or {})
     train_paths = loader_cfg.get("train_paths") or []
     if not any(os.path.isdir(p) for p in train_paths):

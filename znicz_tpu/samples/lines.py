@@ -88,7 +88,6 @@ def build(loader_config=None, decision_config=None, mcdnnic_topology=None,
     train_paths = loader_cfg.get("train_paths") or []
     if not any(os.path.isdir(p) for p in train_paths):
         base = os.path.dirname(train_paths[0]) if train_paths else None
-        size = 256
         topo = mcdnnic_topology or cfg.mcdnnic_topology
         size = int(topo.split("-")[0].split("x")[1])
         materialize_synthetic(base, size=size)
