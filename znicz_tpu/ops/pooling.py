@@ -23,6 +23,7 @@ from functools import partial
 import numpy
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def output_spatial(sy, sx, ky, kx, sliding):
@@ -36,18 +37,22 @@ def output_spatial(sy, sx, ky, kx, sliding):
     return outs[1], outs[0]  # ny, nx
 
 
+def _ceil_mode_pads(sy, sx, ky, kx, sliding):
+    """Right/bottom padding that makes every ceil-mode window in range."""
+    ny, nx = output_spatial(sy, sx, ky, kx, sliding)
+    pad_y = (ny - 1) * sliding[1] + ky - sy
+    pad_x = (nx - 1) * sliding[0] + kx - sx
+    return ny, nx, ((0, 0), (0, pad_y), (0, pad_x), (0, 0))
+
+
 def _window_view_jax(x, ky, kx, sliding, fill):
     """(B, ny, nx, ky*kx, C) window view + validity mask (ky*kx,) grids.
 
     Overhanging cells are filled with ``fill`` and masked invalid.
     """
     b, sy, sx, c = x.shape
-    ny, nx = output_spatial(sy, sx, ky, kx, sliding)
-    # pad right/bottom so every window index is in range
-    pad_y = (ny - 1) * sliding[1] + ky - sy
-    pad_x = (nx - 1) * sliding[0] + kx - sx
-    xp = jnp.pad(x, ((0, 0), (0, pad_y), (0, pad_x), (0, 0)),
-                 constant_values=fill)
+    ny, nx, pads = _ceil_mode_pads(sy, sx, ky, kx, sliding)
+    xp = jnp.pad(x, pads, constant_values=fill)
     rows = (jnp.arange(ny) * sliding[1])[:, None] + jnp.arange(ky)[None, :]
     cols = (jnp.arange(nx) * sliding[0])[:, None] + jnp.arange(kx)[None, :]
     # (B, ny, ky, nx, kx, C) -> (B, ny, nx, ky, kx, C)
@@ -81,12 +86,54 @@ def max_pooling_jax(x, ky, kx, sliding, use_abs=False):
     return val, offs.astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("ky", "kx", "sliding", "mode"))
+def pooling_fwd_jax(x, ky, kx, sliding, mode="max"):
+    """Offset-free pooling via ``lax.reduce_window`` — the TPU-native
+    formulation (no gathers; the max VJP lowers to select-and-scatter).
+
+    Used by the fused path, where the backward comes from ``jax.grad``
+    and the reference's flat ``input_offset`` bookkeeping is not needed.
+    NOTE maxabs breaks exact-|tie| windows toward the positive value; the
+    reference (and ``max_pooling_jax``) take the first occurrence — use
+    the offset path where that parity matters.
+    Ceil-mode overhang is realized as right/bottom window padding: padded
+    cells contribute the reduction identity, which reproduces the
+    reference's truncated-window semantics for max and (with the
+    geometry-constant divisor below) for avg.
+    """
+    b, sy, sx, c = x.shape
+    dims = (1, ky, kx, 1)
+    strides = (1, sliding[1], sliding[0], 1)
+    ny, nx, pads = _ceil_mode_pads(sy, sx, ky, kx, sliding)
+    # init values must be CONCRETE numpy scalars so jax recognizes the
+    # monoid (max/min/add) and uses the differentiable specialized
+    # reduce-window primitives; traced inits fall back to the generic,
+    # non-differentiable form
+    ninf = numpy.asarray(-numpy.inf, x.dtype)
+    pinf = numpy.asarray(numpy.inf, x.dtype)
+    if mode == "max":
+        return lax.reduce_window(x, ninf, lax.max, dims, strides, pads)
+    if mode == "maxabs":
+        # the max-|x| element is either the window max or the window min;
+        # max/min reductions keep the op differentiable (custom reducers
+        # have no VJP)
+        mx = lax.reduce_window(x, ninf, lax.max, dims, strides, pads)
+        mn = lax.reduce_window(x, pinf, lax.min, dims, strides, pads)
+        return jnp.where(jnp.abs(mx) >= jnp.abs(mn), mx, mn)
+    if mode == "avg":
+        s = lax.reduce_window(x, numpy.asarray(0, x.dtype), lax.add,
+                              dims, strides, pads)
+        # truncated-window divisor is pure geometry -> trace-time constant
+        t_y = numpy.minimum(ky, sy - numpy.arange(ny) * sliding[1])
+        t_x = numpy.minimum(kx, sx - numpy.arange(nx) * sliding[0])
+        cnt = (t_y[:, None] * t_x[None, :]).astype(numpy.float32)
+        return s / jnp.asarray(cnt, x.dtype)[None, :, :, None]
+    raise ValueError(mode)
+
+
 @partial(jax.jit, static_argnames=("ky", "kx", "sliding"))
 def avg_pooling_jax(x, ky, kx, sliding):
-    win, valid, ny, nx = _window_view_jax(x, ky, kx, sliding, 0.0)
-    s = jnp.sum(win * valid[None, :, :, :, None], axis=3)
-    cnt = valid.sum(axis=2).astype(x.dtype)
-    return s / cnt[None, :, :, None]
+    return pooling_fwd_jax(x, ky, kx, sliding, mode="avg")
 
 
 @partial(jax.jit, static_argnames=("ky", "kx", "sliding", "use_abs"))

@@ -56,6 +56,12 @@ def _forward_class(tpe):
     import znicz_tpu.units  # noqa: F401 (registers every unit module)
     return nn_units.mapping[tpe].forward
 
+#: strictly monotonically increasing activations — safe to commute past a
+#: following max pooling (see forward()).  NOTE "relu" is excluded: the
+#: reference's "relu" is log(1 + exp(x)) with a piecewise seam at x=15
+#: (activations.py) and is not monotonic across the seam.
+_MONOTONIC_ACTS = frozenset(("linear", "tanh", "sigmoid"))
+
 DEFAULT_HYPER = dict(lr=0.01, wd=0.00005, l1_vs_l2=0.0, moment=0.0,
                      acc_alpha=0.0, acc_beta=0.0, gd_alpha=0.0, gd_beta=1.0,
                      factor_ortho=0.0)
@@ -399,40 +405,71 @@ def init_opt_state(specs, params):
     return states
 
 
-def forward(params, x, specs, return_logits=False, key=None, train=False):
+def forward(params, x, specs, return_logits=False, key=None, train=False,
+            compute_dtype=None):
     """Pure forward pass through the whole spec stack.
 
     With ``return_logits`` the softmax head is left un-normalized (for the
     CE loss); otherwise softmax is applied.  ``key``/``train`` drive
     dropout masks; inference leaves dropout as identity (reference
     dropout.py:84-190 TRAIN gating).
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) casts activations and
+    parameters at each matmul/conv so the GEMMs run at the MXU's native
+    rate; master parameters stay float32 and the softmax/loss math is
+    always done in float32.
     """
-    y = x
-    for p, spec in zip(params, specs):
+    cd = compute_dtype
+
+    def _p(arr):
+        return arr if (cd is None or arr is None) else arr.astype(cd)
+
+    y = x if cd is None else x.astype(cd)
+    deferred_act = None  # activation commuted past a following max-pool
+    for i, (p, spec) in enumerate(zip(params, specs)):
+        if deferred_act is not None and spec.kind != "pool":
+            raise AssertionError("deferred activation not consumed")
         if spec.kind == "fc":
             y = y.reshape(y.shape[0], -1)
-            y = y @ p["w"].T
+            y = y @ _p(p["w"]).T
             if "b" in p:
-                y = y + p["b"]
+                y = y + _p(p["b"])
             if not spec.is_softmax:
                 y = activations.apply_jax(spec.activation, y)
             elif not return_logits:
+                if cd is not None:
+                    y = y.astype(jnp.float32)
                 y = jax.nn.softmax(y, axis=1)
         elif spec.kind == "conv":
             y = y.reshape((y.shape[0],) + spec.in_shape)
+            act = spec.activation
+            # strictly monotonic activations commute with max pooling
+            # (max(f(x)) == f(max(x)), bit-exact for the same winner);
+            # applying f AFTER the pool does 1/(kx*ky) the transcendental
+            # + HBM work — the dominant non-GEMM cost on TPU
+            if (act in _MONOTONIC_ACTS
+                    and i + 1 < len(specs)
+                    and specs[i + 1].kind == "pool"
+                    and specs[i + 1].mode == "max"):
+                deferred_act, act = act, "linear"
             y = conv_ops.forward_jax(
-                y, p["w"], p.get("b"), spec.ky, spec.kx,
-                spec.padding, spec.sliding, activation=spec.activation,
+                y, _p(p["w"]), _p(p.get("b")), spec.ky, spec.kx,
+                spec.padding, spec.sliding, activation=act,
                 include_bias="b" in p)
         elif spec.kind == "pool":
             y = y.reshape((y.shape[0],) + spec.in_shape)
-            if spec.mode == "avg":
-                y = pool_ops.avg_pooling_jax(
-                    y, spec.ky, spec.kx, spec.sliding)
-            else:
+            if spec.mode == "maxabs":
+                # offset path: reduce_window maxabs breaks |tie|s toward
+                # the positive value, the reference toward the first
+                # occurrence — keep exact parity for this rare mode
                 y, _ = pool_ops.max_pooling_jax(
-                    y, spec.ky, spec.kx, spec.sliding,
-                    use_abs=(spec.mode == "maxabs"))
+                    y, spec.ky, spec.kx, spec.sliding, use_abs=True)
+            else:
+                y = pool_ops.pooling_fwd_jax(
+                    y, spec.ky, spec.kx, spec.sliding, mode=spec.mode)
+            if deferred_act is not None:
+                y = activations.apply_jax(deferred_act, y)
+                deferred_act = None
         elif spec.kind == "lrn":
             y = y.reshape((y.shape[0],) + spec.in_shape)
             y = norm_ops.lrn_forward_jax(
@@ -449,10 +486,14 @@ def forward(params, x, specs, return_logits=False, key=None, train=False):
     return y
 
 
-def _loss_and_stats(params, x, labels, specs, key=None):
+def _loss_and_stats(params, x, labels, specs, key=None, compute_dtype=None):
     """Mean softmax-CE loss (matches evaluator err_output scaling,
-    ops/evaluator.py) + error count."""
-    y = forward(params, x, specs, return_logits=True, key=key, train=True)
+    ops/evaluator.py) + error count.  Loss math is float32 even when the
+    forward GEMMs run in a lower ``compute_dtype``."""
+    y = forward(params, x, specs, return_logits=True, key=key, train=True,
+                compute_dtype=compute_dtype)
+    if compute_dtype is not None:
+        y = y.astype(jnp.float32)
     logp = jax.nn.log_softmax(y, axis=1)
     valid = labels >= 0
     lbl = jnp.maximum(labels, 0)
@@ -481,8 +522,10 @@ class FusedNet:
     device mesh."""
 
     def __init__(self, layers, input_sample_shape, mesh=None, rand=None,
-                 dtype=numpy.float32, defaults=None, dropout_seed=0):
+                 dtype=numpy.float32, defaults=None, dropout_seed=0,
+                 compute_dtype=None):
         self.specs = build_specs(layers, input_sample_shape, defaults)
+        self.compute_dtype = compute_dtype
         self.input_sample_shape = _normalize_sample_shape(input_sample_shape)
         if not self.specs[-1].is_softmax:
             raise ValueError(
@@ -501,13 +544,19 @@ class FusedNet:
         # when the donated step returns GSPMD-sharded state.
         self.state = self._place_state(states_host)
         self._key = jax.random.PRNGKey(dropout_seed)
+        if mesh is not None:
+            # replicate the key over the mesh up front: a default single-
+            # device placement would differ from the sharding the compiled
+            # step/scan returns, costing a recompile on the second call
+            self._key = jax.device_put(
+                self._key, NamedSharding(mesh, P()))
         self._has_dropout = any(s.kind == "dropout" for s in self.specs)
         # specs close over the traced functions (they carry dicts, so they
         # can't be hashable static args); hyperparameters bake in as XLA
         # constants.
         specs = tuple(self.specs)
         step_fn = lambda p, s, x, l, k: _train_step(  # noqa: E731
-            p, s, x, l, specs, k)
+            p, s, x, l, specs, k, compute_dtype)
         if mesh is not None:
             # Pin output shardings to the input placements: GSPMD would
             # otherwise return spec variants (P('model',) vs
@@ -521,11 +570,14 @@ class FusedNet:
                       for s, st in zip(self.specs, self.state)]
             mshard = {"loss": NamedSharding(mesh, P()),
                       "n_err": NamedSharding(mesh, P())}
+            self._pshard, self._sshard = pshard, sshard
             self._step = jax.jit(step_fn, donate_argnums=(0, 1),
                                  out_shardings=(pshard, sshard, mshard))
         else:
+            self._pshard = self._sshard = None
             self._step = jax.jit(step_fn, donate_argnums=(0, 1))
-        self._fwd = jax.jit(lambda p, x: forward(p, x, specs))
+        self._fwd = jax.jit(
+            lambda p, x: forward(p, x, specs, compute_dtype=compute_dtype))
 
     # -- sharding -----------------------------------------------------------
     def _param_spec(self, spec, name):
@@ -586,6 +638,62 @@ class FusedNet:
             self.params, self.state, x, labels, key)
         return metrics
 
+    def run_steps(self, xs, labels_s):
+        """Many fused train steps in ONE compiled call via ``lax.scan``.
+
+        ``xs``: (n_steps, batch, *sample), ``labels_s``: (n_steps, batch).
+        The whole loop is a single XLA computation — no per-step dispatch,
+        which matters when launch latency is non-trivial (remote/tunneled
+        devices) and is the idiomatic TPU epoch loop.  Returns stacked
+        per-step metrics.
+        """
+        if not hasattr(self, "_scan_step"):
+            specs = tuple(self.specs)
+            cd = self.compute_dtype
+
+            def body(carry, batch):
+                p, s, k = carry
+                x, l = batch
+                if self._has_dropout:
+                    k, sub = jax.random.split(k)
+                else:
+                    sub = k
+                p, s, m = _train_step(p, s, x, l, specs, sub, cd)
+                return (p, s, k), m
+
+            def scan_fn(p, s, k, xs, ls):
+                (p, s, k), ms = jax.lax.scan(body, (p, s, k), (xs, ls))
+                return p, s, k, ms
+
+            if self.mesh is not None:
+                # pin output shardings to the input placements, same as
+                # _step in __init__: un-pinned GSPMD output spec variants
+                # would force a full recompile of the donated scan on the
+                # next call
+                rep = NamedSharding(self.mesh, P())
+                mshard = {"loss": rep, "n_err": rep}
+                self._scan_step = jax.jit(
+                    scan_fn, donate_argnums=(0, 1),
+                    out_shardings=(self._pshard, self._sshard, rep, mshard))
+            else:
+                self._scan_step = jax.jit(scan_fn, donate_argnums=(0, 1))
+        if self.mesh is not None:
+            dsize = self.mesh.shape["data"]
+            if xs.shape[1] % dsize:
+                raise ValueError(
+                    "batch %d not divisible by data-parallel %d"
+                    % (xs.shape[1], dsize))
+            xs = jax.device_put(xs, NamedSharding(
+                self.mesh, P(None, "data", *([None] * (xs.ndim - 2)))))
+            labels_s = jax.device_put(
+                labels_s, NamedSharding(self.mesh, P(None, "data")))
+        else:
+            xs = jax.device_put(xs)
+            labels_s = jax.device_put(labels_s)
+        self.params, self.state, self._key, metrics = self._scan_step(
+            self.params, self.state, self._key, xs, labels_s)
+        return metrics
+
     def predict(self, x):
         x, _ = self._place_batch(x, numpy.zeros(x.shape[0], numpy.int32))
         return self._fwd(self.params, x)
@@ -606,21 +714,24 @@ class FusedMLP(FusedNet):
             layers, int(input_sample_size), **kwargs)
 
 
-def _train_step(params, state, x, labels, specs, key=None):
+def _train_step(params, state, x, labels, specs, key=None,
+                compute_dtype=None):
     (loss, n_err), grads = jax.value_and_grad(
-        lambda p: _loss_and_stats(p, x, labels, specs, key),
+        lambda p: _loss_and_stats(p, x, labels, specs, key, compute_dtype),
         has_aux=True)(params)
     new_params, new_state = [], []
     for spec, p, st, g in zip(specs, params, state, grads):
         np_, nst = {}, {}
         if "w" in p:
             np_["w"], nst["w"], _ = gd_math.update(
-                jnp, p["w"], g["w"], st["w"], spec.hyper, spec.flags)
+                jnp, p["w"], g["w"].astype(p["w"].dtype), st["w"],
+                spec.hyper, spec.flags)
         if "b" in p:
             hyper_b = spec.hyper_bias
             flags_b = dict(spec.flags, ortho=False)
             np_["b"], nst["b"], _ = gd_math.update(
-                jnp, p["b"], g["b"], st["b"], hyper_b, flags_b)
+                jnp, p["b"], g["b"].astype(p["b"].dtype), st["b"],
+                hyper_b, flags_b)
         new_params.append(np_)
         new_state.append(nst)
     return new_params, new_state, {"loss": loss, "n_err": n_err}
