@@ -18,12 +18,19 @@ compute lives in jitted pure functions (znicz_tpu.ops).  Python-level gating
 is cheap at that cadence and semantically identical to the reference.
 """
 
+import time
+
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.mutable import Bool
 
 
 class Unit(Logger):
     """A node in the control-plane dataflow graph."""
+
+    #: class-wide switch: sync the device after each run() so run_time_
+    #: measures compute, not async dispatch.  Off by default (it serializes
+    #: the pipeline); turn on when profiling with Workflow.log_unit_timings.
+    sync_timings = False
 
     def __init__(self, workflow, **kwargs):
         self.name = kwargs.get("name", type(self).__name__)
@@ -37,6 +44,10 @@ class Unit(Logger):
         self.view_group = kwargs.get("view_group", None)
         self._initialized = False
         self.run_was_called = False
+        #: per-unit wall-time debug stats (reference nn_units.py:217-239
+        #: print_debug_data — here gathered by the engine for every unit)
+        self.run_time_ = 0.0
+        self.run_count_ = 0
         self.workflow = None
         if workflow is not None:
             workflow.add_unit(self)
@@ -150,7 +161,16 @@ class Unit(Logger):
         if bool(self.gate_block):
             return  # consume the signal
         if not bool(self.gate_skip):
+            t0 = time.perf_counter()
             self.run()
+            if Unit.sync_timings:
+                # device work is dispatched async: without a sync, compute
+                # time lands on whichever later unit blocks (map_read)
+                device = getattr(self, "device", None)
+                if device is not None and hasattr(device, "sync"):
+                    device.sync()
+            self.run_time_ += time.perf_counter() - t0
+            self.run_count_ += 1
             self.run_was_called = True
         for dst in list(self._links_to):
             dst._signal(self)

@@ -206,6 +206,29 @@ class Workflow(Unit):
     def run_is_blocked(self):
         return False
 
+    # -- per-unit timing stats (reference nn_units.py:217-239) ---------------
+    def unit_timings(self):
+        """[(unit, total_seconds, run_count)] sorted by total time desc —
+        the engine times every unit's run() (core/units.py _fire).
+
+        NOTE: device work is dispatched asynchronously, so by default a
+        unit's time covers dispatch only and compute lands on whichever
+        unit blocks first (map_read).  Set ``Unit.sync_timings = True``
+        before the run to charge compute to the unit that issued it."""
+        rows = [(u, u.run_time_, u.run_count_) for u in self._units
+                if u.run_count_]
+        rows.sort(key=lambda r: -r[1])
+        return rows
+
+    def log_unit_timings(self):
+        """Log the per-unit wall-time table at INFO."""
+        rows = self.unit_timings()
+        total = sum(r[1] for r in rows) or 1.0
+        self.info("unit timings (%d runs total):", sum(r[2] for r in rows))
+        for unit, t, n in rows:
+            self.info("  %-28s %8.3fs %6d runs  %5.1f%%",
+                      unit.name, t, n, 100.0 * t / total)
+
 
 class DummyLauncher(object):
     """In-process launcher stand-in (reference: veles.dummy.DummyLauncher,

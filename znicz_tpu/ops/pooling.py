@@ -171,6 +171,43 @@ def stochastic_pooling_jax(x, rand_u16, ky, kx, sliding, use_abs=False):
     return val, offs.astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("ky", "kx", "use_abs"))
+def stochastic_pool_depool_jax(x, rand_u16, ky, kx, use_abs=False):
+    """Stochastic pooling + depooling in place (reference ocl/pooling.cl
+    ``stochastic_pooling_depooling``): one winner per non-overlapping
+    window, sampled with probability proportional to max(x, 0) (or |x|);
+    the output has the INPUT shape — the winner keeps its original signed
+    value, every other cell becomes 0.  Zero-sum windows sample uniformly
+    over the truncated window via the kernel's pos_add=1 cumsum walk.
+
+    Returns (y, offs): y is input-shaped, offs the winners' flat input
+    indices (window-grid shaped, for IDistributable/export parity).
+    """
+    sliding = (kx, ky)
+    b, sy, sx, c = x.shape
+    win, valid, ny, nx = _window_view_jax(x, ky, kx, sliding, 0.0)
+    vmask = valid[None, :, :, :, None]
+    key = jnp.abs(win) if use_abs else jnp.maximum(win, 0.0)
+    key = key * vmask
+    vsum = key.sum(axis=3)                      # (B, ny, nx, C)
+    cnt = valid.sum(axis=2).astype(x.dtype)     # (ny, nx)
+    rnd = rand_u16[:b * ny * nx * c].reshape(b, ny, nx, c).astype(x.dtype)
+    nonzero = vsum > 0
+    total = jnp.where(nonzero, vsum, cnt[None, :, :, None])
+    pos = rnd * total / 65536.0
+    # zero-sum windows walk a cumsum of ones over the valid cells
+    keyz = jnp.where(nonzero[:, :, :, None, :], key,
+                     vmask.astype(x.dtype) * jnp.ones_like(win))
+    csum = jnp.cumsum(keyz, axis=3)
+    hit = pos[:, :, :, None, :] <= csum
+    q = jnp.argmax(hit, axis=3)
+    offs = _flat_offsets_jax(x.shape, ny, nx, ky, kx, sliding, q)
+    vals = jnp.take_along_axis(win, q[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+    y = jnp.zeros((x.size,), x.dtype).at[offs.reshape(-1)].set(
+        vals.reshape(-1))
+    return y.reshape(x.shape), offs.astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("input_size", "input_shape"))
 def max_pooling_backward_jax(err_output, input_offset, input_size,
                              input_shape):
@@ -269,6 +306,48 @@ def stochastic_pooling_numpy(x, rand_u16, ky, kx, sliding, use_abs=False):
                     offs[bi, i, j, ci] = numpy.ravel_multi_index(
                         (bi, y1 + di, x1 + dj, ci), x.shape)
     return out, offs
+
+
+def stochastic_pool_depool_numpy(x, rand_u16, ky, kx, use_abs=False):
+    """Numpy twin of :func:`stochastic_pool_depool_jax` — a direct port of
+    the OpenCL kernel's three-pass walk (sum, select, zero-fill)."""
+    sliding = (kx, ky)
+    b, sy, sx, c = x.shape
+    ny, nx = output_spatial(sy, sx, ky, kx, sliding)
+    y = numpy.zeros_like(x)
+    offs = numpy.empty((b, ny, nx, c), dtype=numpy.int32)
+    oshape = (b, ny, nx, c)
+    for bi in range(b):
+        for i in range(ny):
+            y1 = i * sliding[1]
+            y2 = min(y1 + ky, sy)
+            for j in range(nx):
+                x1 = j * sliding[0]
+                x2 = min(x1 + kx, sx)
+                for ci in range(c):
+                    cut = x[bi, y1:y2, x1:x2, ci]
+                    vals = cut.ravel()
+                    key = numpy.abs(vals) if use_abs else \
+                        numpy.maximum(vals, 0)
+                    vsum = key.sum()
+                    index = numpy.ravel_multi_index((bi, i, j, ci), oshape)
+                    rnd = int(rand_u16[index])
+                    pos_add = 1.0 if vsum == 0 else 0.0
+                    pos_factor = vals.size if vsum == 0 else vsum
+                    pos = pos_factor * rnd / 65536.0
+                    acc = 0.0
+                    k = 0
+                    for t in range(vals.size):
+                        acc += key[t] + pos_add
+                        if pos <= acc:
+                            k = t
+                            break
+                    di, dj = numpy.unravel_index(k, cut.shape)
+                    off = numpy.ravel_multi_index(
+                        (bi, y1 + di, x1 + dj, ci), x.shape)
+                    y[bi, y1 + di, x1 + dj, ci] = cut[di, dj]
+                    offs[bi, i, j, ci] = off
+    return y, offs
 
 
 def max_pooling_backward_numpy(err_output, input_offset, input_shape):

@@ -12,6 +12,53 @@ from znicz_tpu.units.nn_units import NNLayerBase, as_nhwc
 from znicz_tpu.ops import conv as conv_ops
 
 
+def gabor_kernel(kx, ky, sigma, theta, lambd, gamma, psi):
+    """Real Gabor kernel on a (ky, kx) grid — the cv2.getGaborKernel
+    formula (the reference fills via cv2, conv.py:425-475; cv2 is not a
+    dependency here so the kernel is computed directly)."""
+    ymax, xmax = ky // 2, kx // 2
+    y, x = numpy.mgrid[-ymax:ky - ymax, -xmax:kx - xmax]
+    xr = x * numpy.cos(theta) + y * numpy.sin(theta)
+    yr = -x * numpy.sin(theta) + y * numpy.cos(theta)
+    return (numpy.exp(-(xr ** 2 + (gamma * yr) ** 2) / (2.0 * sigma ** 2))
+            * numpy.cos(2.0 * numpy.pi * xr / lambd + psi))
+
+
+def fill_gabor_filters(w, kx, ky, n_channels, stddev, rand):
+    """Fill (n_kernels, ky*kx*C) weights with the reference's Gabor bank
+    (conv.py:425-475): 4 orientations x 2 phase shifts over wavelength /
+    deviation ratios — 96 distinct filters, each normalized to [0, 255] and
+    scaled by ``stddev``, broadcast over channels; any further kernels get
+    white noise."""
+    n_kernels = w.shape[0]
+    size = min(kx, ky)
+    orientations = (0.0, numpy.pi / 4, numpy.pi / 2, 3 * numpy.pi / 4)
+    phase_shifts = (0.0, numpy.pi)
+    count = 0
+    for wavelen_ratio in range(4):
+        for dev_ratio in range(1, 2 * wavelen_ratio + 1):
+            for ori in orientations:
+                for phase in phase_shifts:
+                    if count == n_kernels:
+                        return
+                    k2d = gabor_kernel(
+                        kx, ky, sigma=size / dev_ratio / 2.0, theta=ori,
+                        lambd=size / wavelen_ratio, gamma=1.0, psi=phase)
+                    k2d = k2d - k2d.min()
+                    mx = k2d.max()
+                    if mx:
+                        k2d = k2d * (255.0 / mx)
+                    k2d = k2d * stddev
+                    # broadcast over channels in (ky, kx, C) row-major —
+                    # the flat layout of one weights row
+                    w[count] = numpy.repeat(
+                        k2d.reshape(-1), n_channels).astype(w.dtype)
+                    count += 1
+    # white noise for kernels beyond the 96-filter bank
+    if count < n_kernels:
+        rand.fill_normal_real(w[count:], 0, stddev)
+
+
 class ConvolutionalBase(object):
     """CONV_ATTRS carrier (reference conv.py:57-67)."""
 
@@ -101,7 +148,12 @@ class Conv(ConvolutionalBase, NNLayerBase):
         if not self.weights:
             w = numpy.zeros((self.n_kernels, kernel_size),
                             dtype=self.input.dtype)
-            self.fill_array(self.weights_filling, w, self.weights_stddev)
+            if self.weights_filling == "gabor":
+                fill_gabor_filters(w, self.kx, self.ky, n_channels,
+                                   self.weights_stddev, self.rand)
+            else:
+                self.fill_array(self.weights_filling, w,
+                                self.weights_stddev)
             if self.weights_transposed:
                 w = w.T.copy()
             self.weights.reset(w)

@@ -2,7 +2,8 @@
 
 TPU-era equivalent of reference pooling.py (548 LoC — SURVEY.md §2.2).
 Type strings: max_pooling, maxabs_pooling, stochastic_pooling,
-stochastic_abs_pooling, avg_pooling.  Geometry and offset semantics in
+stochastic_abs_pooling, stochastic_pool_depool,
+stochastic_abs_pool_depool, avg_pooling.  Geometry and offset semantics in
 :mod:`znicz_tpu.ops.pooling` (ceil-mode windows, flat input offsets).
 """
 
@@ -107,12 +108,15 @@ class OffsetPooling(Pooling):
 
     def initialize(self, device=None, **kwargs):
         super(OffsetPooling, self).initialize(device=device, **kwargs)
+        # offsets live on the window grid — which equals output.shape for
+        # plain pooling but NOT for the in-place depooling variants
+        grid = (self.input_batch_size, self.out_sy, self.out_sx,
+                self.n_channels)
         if self.input_offset:
-            assert self.input_offset.shape[1:] == self.output.shape[1:]
+            assert self.input_offset.shape[1:] == grid[1:]
         if (not self.input_offset or
-                self.input_offset.shape[0] != self.output.shape[0]):
-            self.input_offset.reset(numpy.zeros(self.output.shape,
-                                                dtype=numpy.int32))
+                self.input_offset.shape[0] != grid[0]):
+            self.input_offset.reset(numpy.zeros(grid, dtype=numpy.int32))
 
 
 class MaxPooling(OffsetPooling):
@@ -191,6 +195,59 @@ class StochasticPooling(StochasticPoolingBase):
 class StochasticAbsPooling(StochasticPoolingBase):
     """(reference pooling.py:462-480)."""
     MAPPING = {"stochastic_abs_pooling"}
+    USE_ABS = True
+
+
+class StochasticPoolingDepooling(StochasticPoolingBase):
+    """Stochastic pooling + depooling in place (reference pooling.py:485-505
+    + ocl/pooling.cl ``stochastic_pooling_depooling``): one winner per
+    non-overlapping window, sampled proportionally to max(x, 0); the output
+    has the INPUT shape — the winner keeps its value, the rest become 0."""
+
+    MAPPING = {"stochastic_pool_depool"}
+
+    @property
+    def output_shape(self):
+        return tuple(self.input.shape)
+
+    def initialize(self, device=None, **kwargs):
+        if tuple(self.sliding) != (self.kx, self.ky):
+            # the reference kernel statically rejects this too
+            raise ValueError(
+                "stochastic_pool_depool requires sliding == (kx, ky), "
+                "have %r != (%d, %d)" % (self.sliding, self.kx, self.ky))
+        super(StochasticPoolingDepooling, self).initialize(
+            device=device, **kwargs)
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.input_offset.map_invalidate()
+        out, offs = pool_ops.stochastic_pool_depool_numpy(
+            as_nhwc(self.input.mem), self._rand_u16(), self.ky, self.kx,
+            use_abs=self.USE_ABS)
+        self.output.mem[...] = out.reshape(self.output.shape)
+        self.input_offset.mem[...] = offs
+
+    def jax_run(self):
+        out, offs = pool_ops.stochastic_pool_depool_jax(
+            as_nhwc(self.input.dev), self._rand_u16(), self.ky, self.kx,
+            use_abs=self.USE_ABS)
+        self.output.set_dev(out.reshape(self.output.shape))
+        self.input_offset.set_dev(offs)
+
+    def _rand_u16(self):
+        # one draw per WINDOW (grid-sized), not per output element
+        size = (self.input_batch_size * self.out_sy * self.out_sx *
+                self.n_channels)
+        return self.uniform.randint(0, 1 << 16, size=size,
+                                    dtype=numpy.uint16)
+
+
+class StochasticAbsPoolingDepooling(StochasticPoolingDepooling):
+    """|x|-proportional variant (reference pooling.py:508-519)."""
+
+    MAPPING = {"stochastic_abs_pool_depool"}
     USE_ABS = True
 
 
