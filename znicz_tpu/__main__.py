@@ -1,0 +1,80 @@
+"""``python -m znicz_tpu`` — the workflow CLI (the veles launcher's
+user-facing contract: run a workflow module with config overrides).
+
+Examples::
+
+    python -m znicz_tpu wine
+    python -m znicz_tpu znicz_tpu.samples.mnist \
+        --config mnistr.decision.max_epochs=3
+    python -m znicz_tpu samples/mnist.py --snapshot snap.pickle
+    python -m znicz_tpu mnist --testing
+    python -m znicz_tpu --list
+"""
+
+import argparse
+import ast
+import sys
+
+from znicz_tpu.core.config import root
+from znicz_tpu.launcher import list_samples, run_workflow
+
+
+def apply_override(root_cfg, assignment):
+    """Apply one ``dotted.path=value`` override onto the config root.
+    Values parse as Python literals, falling back to strings."""
+    path, sep, raw = assignment.partition("=")
+    if not sep:
+        raise SystemExit("--config needs KEY=VALUE, got %r" % assignment)
+    try:
+        value = ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        value = raw
+    parts = path.strip().split(".")
+    node = root_cfg
+    for p in parts[:-1]:
+        node = getattr(node, p)
+    setattr(node, parts[-1], value)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m znicz_tpu",
+        description="Run a znicz_tpu workflow (module path, file, or "
+                    "sample name).")
+    parser.add_argument("workflow", nargs="?",
+                        help="dotted module, .py file, or sample name")
+    parser.add_argument("--config", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="config-root override, e.g. "
+                             "wine.decision.max_epochs=5")
+    parser.add_argument("--snapshot", help="snapshot file to resume from")
+    parser.add_argument("--testing", action="store_true",
+                        help="forward-only run (reference --test)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="build + initialize only")
+    parser.add_argument("--list", action="store_true",
+                        help="list bundled samples and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in list_samples():
+            print(name)
+        return 0
+    if not args.workflow:
+        parser.error("workflow required (or --list)")
+    # import FIRST: sample modules install their root.<ns> defaults at
+    # import time, which would clobber any override applied before it
+    from znicz_tpu.launcher import resolve_workflow_module
+    module = resolve_workflow_module(args.workflow)
+    for assignment in args.config:
+        apply_override(root, assignment)
+    wf = run_workflow(module, snapshot=args.snapshot,
+                      testing=args.testing, dry_run=args.dry_run)
+    decision = getattr(wf, "decision", None)
+    if decision is not None and hasattr(decision, "best_n_err_pt"):
+        print("best val/train err%%: %s" % (decision.best_n_err_pt,))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

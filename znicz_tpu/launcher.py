@@ -1,0 +1,166 @@
+"""Workflow launcher — the reference ``run(load, main)`` contract + CLI
+backend.
+
+Reference contract (every sample module ends with it — samples/MNIST/
+mnist.py:128-137, samples/Wine/wine.py:178-181): the veles CLI imports the
+workflow module and calls ``module.run(load, main)`` where
+
+* ``load(factory, **kwargs) -> (workflow, snapshot_loaded)`` constructs
+  the workflow — or marks it for restoration when the launcher carries a
+  ``--snapshot`` path;
+* ``main(**kwargs)`` initializes (forwarding kwargs), applies any pending
+  snapshot state, and runs.
+
+The reference launcher's other role — master/slave distribution over
+sockets (veles launcher.py, nn_units.py:178-211 broadcast/aggregate) — is
+deliberately NOT reproduced: the TPU-native equivalent is SPMD over a
+``jax.sharding.Mesh`` (:mod:`znicz_tpu.parallel`), where XLA's collectives
+replace the parameter-server cycle.  This launcher runs the unit-graph
+control plane in one process, standalone.
+"""
+
+import importlib
+import importlib.util
+import os
+
+from znicz_tpu.core.logger import Logger
+
+
+class Launcher(Logger):
+    """Standalone launcher implementing ``load``/``main``.
+
+    Modes:
+    * ``testing`` — forward-only run (the reference ``--test`` flag):
+      after initialize, decision/loader are put into testing mode when
+      they support it;
+    * ``dry_run`` — build + initialize only, skip ``run()``;
+    * ``snapshot`` — path of a :class:`SnapshotterToFile` pickle to
+      restore into the freshly-built workflow before running.
+    """
+
+    def __init__(self, testing=False, snapshot=None, device=None,
+                 dry_run=False):
+        super(Launcher, self).__init__(logger_name="Launcher")
+        self.testing = testing
+        self.snapshot_path = snapshot
+        self.device = device
+        self.dry_run = dry_run
+        self.workflow = None
+        self.interactive = False
+        self._state = None
+
+    # -- the role the workflow sees (reference Launcher interface) ----------
+    @property
+    def is_master(self):
+        return False
+
+    @property
+    def is_slave(self):
+        return False
+
+    @property
+    def is_standalone(self):
+        return True
+
+    def add_unit(self, unit):
+        # a Workflow constructed with the launcher as parent registers here
+        self.workflow = unit
+
+    add_ref = add_unit
+
+    def del_ref(self, unit):
+        pass
+
+    # -- run(load, main) contract -------------------------------------------
+    def load(self, factory, **kwargs):
+        """Construct the workflow.  ``factory`` is a Workflow subclass
+        (instantiated with this launcher as parent) or a builder callable
+        returning the workflow.  Returns (workflow, snapshot_loaded)."""
+        if self.snapshot_path:
+            from znicz_tpu.core.snapshotter import SnapshotterToFile
+            self._state = SnapshotterToFile.import_(self.snapshot_path)
+            self.info("will restore snapshot %s", self.snapshot_path)
+        if isinstance(factory, type):
+            wf = factory(self, **kwargs)
+        else:
+            wf = factory(**kwargs)
+        self.workflow = wf
+        return wf, self._state is not None
+
+    def main(self, **kwargs):
+        """Initialize (+restore), then run unless dry_run."""
+        wf = self.workflow
+        if wf is None:
+            raise RuntimeError("main() before load()")
+        wf.initialize(device=self.device, **kwargs)
+        if self._state is not None:
+            from znicz_tpu.units.nn_units import load_snapshot_into_workflow
+            load_snapshot_into_workflow(self._state, wf)
+        if self.testing:
+            for unit in wf.units:
+                if hasattr(unit, "testing"):
+                    unit.testing = True
+        if not self.dry_run:
+            wf.run()
+        return wf
+
+
+def resolve_workflow_module(spec):
+    """CLI workflow argument -> imported module.
+
+    Accepts a file path (``samples/mnist.py``), a dotted module name
+    (``znicz_tpu.samples.mnist``), or a bare registered sample name
+    (``mnist``)."""
+    if os.path.sep in spec or spec.endswith(".py"):
+        path = os.path.abspath(spec)
+        name = os.path.splitext(os.path.basename(path))[0]
+        module_spec = importlib.util.spec_from_file_location(name, path)
+        module = importlib.util.module_from_spec(module_spec)
+        module_spec.loader.exec_module(module)
+        return module
+    try:
+        return importlib.import_module(spec)
+    except ImportError as e:
+        # fall back to the samples namespace only when SPEC itself was not
+        # found — an ImportError raised INSIDE the module must surface
+        if e.name != spec:
+            raise
+        return importlib.import_module("znicz_tpu.samples." + spec)
+
+
+def list_samples():
+    """Registered sample names (modules under znicz_tpu.samples that
+    expose the run contract)."""
+    import znicz_tpu.samples as samples_pkg
+    names = []
+    pkg_dir = os.path.dirname(samples_pkg.__file__)
+    for fn in sorted(os.listdir(pkg_dir)):
+        if fn.endswith(".py") and not fn.startswith("_"):
+            names.append(fn[:-3])
+    return names
+
+
+def run_workflow(spec, snapshot=None, testing=False, dry_run=False,
+                 device=None):
+    """Drive a workflow module's ``run(load, main)``.
+
+    ``spec`` is a module object or anything
+    :func:`resolve_workflow_module` accepts.  Falls back to the module's
+    ``run_sample()`` when no ``run`` is exported (plain-run only — the
+    fallback cannot honor snapshot/testing/dry_run).  Returns the
+    workflow."""
+    module = spec if hasattr(spec, "__file__") else \
+        resolve_workflow_module(spec)
+    launcher = Launcher(testing=testing, snapshot=snapshot,
+                        device=device, dry_run=dry_run)
+    if hasattr(module, "run"):
+        module.run(launcher.load, launcher.main)
+        return launcher.workflow
+    if hasattr(module, "run_sample"):
+        if snapshot or testing or dry_run:
+            raise SystemExit(
+                "%s exposes only run_sample(); --snapshot/--testing/"
+                "--dry-run need the run(load, main) contract" % spec)
+        return module.run_sample(device=device)
+    raise SystemExit(
+        "%s exposes neither run(load, main) nor run_sample()" % spec)

@@ -126,3 +126,9 @@ def run_sample(device=None, **kwargs):
 if __name__ == "__main__":
     wf = run_sample()
     print("weights diff at stop:", wf.decision.weights_diff)
+
+
+def run(load, main):
+    """Launcher contract (reference samples/DemoKohonen/kohonen.py)."""
+    load(KohonenWorkflow)
+    main()

@@ -136,3 +136,9 @@ def run_sample(device=None, **kwargs):
 if __name__ == "__main__":
     wf = run_sample()
     print("best epoch MSE:", wf.decision.best_metrics)
+
+
+def run(load, main):
+    """Launcher contract (reference samples/Kanji/kanji.py run())."""
+    load(build)
+    main()

@@ -116,3 +116,9 @@ def run_sample(device=None, **kwargs):
 if __name__ == "__main__":
     wf = run_sample()
     print("best validation/train err%:", wf.decision.best_n_err_pt)
+
+
+def run(load, main):
+    """Launcher contract (reference samples/Lines/lines.py run())."""
+    load(build)
+    main()

@@ -156,3 +156,9 @@ def run_sample(device=None, **kwargs):
 if __name__ == "__main__":
     wf = run_sample()
     print("reconstruction MSE sum:", wf.reconstruction_mse())
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/MnistRBM)."""
+    load(MnistRBMWorkflow)
+    main()
