@@ -1,0 +1,171 @@
+"""StandardWorkflow aux linkers: avatar, publisher, data_saver, the
+extended plotter set, downloader, ipython (reference
+standard_workflow.py:386-411, 648-670, 738-1149)."""
+
+import glob
+import znicz_tpu.loader.loader_wine  # noqa: F401 (registers wine_loader)
+import os
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.saver import (MinibatchesLoader, MinibatchesSaver,
+                                    read_minibatch_stream)
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 12,
+                                    "weights_stddev": 0.05,
+                                    "bias_stddev": 0.05},
+     "<-": {"learning_rate": 0.3}},
+    {"type": "softmax", "->": {"output_sample_shape": 3,
+                               "weights_stddev": 0.05,
+                               "bias_stddev": 0.05},
+     "<-": {"learning_rate": 0.3}},
+]
+
+
+def _build(tmp_path, max_epochs=2, **kwargs):
+    return StandardWorkflow(
+        None,
+        layers=[dict(l) for l in LAYERS],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": 50},
+        snapshotter_config={"prefix": "aux-test", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": str(tmp_path)},
+        **kwargs)
+
+
+def test_aux_linkers_full_graph(tmp_path):
+    """Publisher + data saver + the extended plotters all wired into a
+    real training run."""
+    root.common.dirs.cache = str(tmp_path / "cache")
+    wf = _build(tmp_path)
+    stream = str(tmp_path / "stream.sav")
+    wf.link_data_saver(wf.loader, file_name=stream, only_epoch=0)
+    wf.link_err_y_plotter(wf.decision)
+    wf.link_multi_hist_plotter(wf.decision)
+    wf.link_similar_weights_plotter(wf.decision)
+    wf.link_table_plotter(wf.decision)
+    wf.link_publisher(wf.decision, directory=str(tmp_path / "reports"))
+    wf.link_ipython(wf.decision)
+    wf.initialize()
+    wf.run()
+
+    assert wf.decision.epoch_number >= 2
+    # publisher fired exactly at completion
+    assert wf.publisher.report is not None
+    assert wf.publisher.destinations
+    md = [d for d in wf.publisher.destinations if d.endswith(".md")][0]
+    assert "decision" in open(md).read()
+    # the shell must never have interacted (headless)
+    assert wf.ipython.interactions == 0
+    # plotters gathered data
+    assert wf.err_y_plotters[-1].values
+    assert wf.table_plotter.rows
+
+    # data saver recorded epoch 0's full stream: wine = 178 samples
+    header, records = read_minibatch_stream(stream)
+    assert header["class_lengths"] == [0, 0, 178]
+    total = sum(r["minibatch_size"] for r in records)
+    assert total == 178
+    assert all(r["labels"] is not None for r in records)
+
+
+def test_minibatches_loader_replays_stream(tmp_path):
+    root.common.dirs.cache = str(tmp_path / "cache")
+    wf = _build(tmp_path)
+    stream = str(tmp_path / "stream.sav")
+    wf.link_data_saver(wf.loader, file_name=stream, only_epoch=0)
+    wf.initialize()
+    wf.run()
+
+    ldr = MinibatchesLoader(None, file_name=stream, minibatch_size=10)
+    ldr.initialize()
+    assert list(ldr.class_lengths) == [0, 0, 178]
+    ldr.run()
+    assert int(ldr.minibatch_size) == 10
+    assert ldr.minibatch_data.mem.shape[1:] == (13,)
+
+
+def test_avatar_in_standard_workflow(tmp_path):
+    """The avatar replaces the loader and the workflow still trains."""
+    root.common.dirs.cache = str(tmp_path / "cache")
+    wf = _build(tmp_path, preprocessing=True)
+    wf.link_repeater(wf.start_point)
+    wf.link_loader(wf.repeater)
+    wf.link_avatar()
+    wf.link_forwards(("input", "minibatch_data"), wf.loader)
+    wf.link_evaluator(wf.forwards[-1])
+    wf.link_decision(wf.evaluator)
+    wf.link_snapshotter(wf.decision)
+    last_gd = wf.link_gds(wf.snapshotter)
+    wf.link_loop(last_gd)
+    wf.link_end_point(last_gd)
+    wf.initialize()
+    wf.run()
+    assert type(wf.loader).__name__ == "Avatar"
+    assert type(wf.real_loader).__name__ == "WineLoader"
+    assert wf.decision.epoch_number >= 2
+    # trains: error should drop below trivial
+    assert wf.decision.best_n_err_pt[2] < 50.0
+
+
+def test_plotter_linkers_on_weightless_layers(tmp_path):
+    """Conv/pooling/activation topologies carry EMPTY weight Arrays in
+    some units; the hist/similar/table/image/immediate plotters must
+    skip them rather than crash (review regression)."""
+    import znicz_tpu.loader.loader_mnist  # noqa: F401
+    root.common.dirs.cache = str(tmp_path / "cache")
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "conv_tanh", "->": {"n_kernels": 2, "kx": 3,
+                                         "ky": 3},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+            {"type": "activation_tanh"},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.1}},
+        ],
+        loader_name="mnist_loader",
+        loader_config={"synthetic_train": 40, "synthetic_valid": 20,
+                       "minibatch_size": 20},
+        decision_config={"max_epochs": 1, "fail_iterations": 10},
+        snapshotter_config={"prefix": "wl", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.link_multi_hist_plotter(wf.decision)
+    wf.link_similar_weights_plotter(wf.decision)
+    wf.link_table_plotter(wf.decision)
+    wf.link_image_plotter(wf.decision)
+    wf.initialize()
+    wf.run()
+    assert wf.decision.epoch_number >= 1
+    assert wf.table_plotter.rows  # ran without crashing
+    assert wf.image_plotter.current  # resolved sample 0 of the output
+
+
+def test_has_labels_reflects_dataset():
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.loader.base import FullBatchLoaderMSE
+    from znicz_tpu.loader.loader_wine import WineLoader
+
+    wine = WineLoader(None, minibatch_size=10)
+    wine.initialize()
+    assert wine.has_labels  # real labels
+
+    class TargetsOnly(FullBatchLoaderMSE):
+        def load_data(self):
+            self.class_lengths[2] = 8
+            self.original_data.reset(numpy.zeros((8, 4), numpy.float32))
+            self.original_targets.reset(
+                numpy.zeros((8, 2), numpy.float32))
+
+    t = TargetsOnly(None, minibatch_size=4)
+    t.initialize()
+    assert not t.has_labels  # label-free MSE dataset

@@ -1,0 +1,160 @@
+"""Observability tier: Publisher, StatusServer, Avatar, Downloader, Shell
+(reference veles.publishing / web status server / avatar.py /
+downloader.py / interaction.py — SURVEY.md §2.9, §5.5)."""
+
+import json
+import os
+import tarfile
+import urllib.request
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.core.publishing import Publisher
+from znicz_tpu.core.status_server import StatusServer
+from znicz_tpu.core.avatar import Avatar
+from znicz_tpu.core.downloader import Downloader
+from znicz_tpu.core.interaction import Shell
+
+
+class _FakeDecision(object):
+    name = "decision"
+
+    def get_metric_names(self):
+        return {"best_err", "epochs"}
+
+    def get_metric_values(self):
+        return {"best_err": numpy.float64(1.5), "epochs": 3}
+
+
+def test_publisher_renders_markdown_and_json(tmp_path):
+    w = DummyWorkflow()
+    p = Publisher(w, directory=str(tmp_path),
+                  backends=("markdown", "json", "html"))
+    p.initialize()
+    p.result_providers.add(_FakeDecision())
+    p.run()
+    assert len(p.destinations) == 3
+    exts = {os.path.splitext(d)[1] for d in p.destinations}
+    assert exts == {".md", ".json", ".html"}
+    with open([d for d in p.destinations if d.endswith(".json")][0]) as f:
+        report = json.load(f)
+    assert report["metrics"]["decision"]["best_err"] == 1.5
+    md = open([d for d in p.destinations if d.endswith(".md")][0]).read()
+    assert "best_err" in md and "| 1.5 |" in md
+
+
+def test_publisher_rejects_unknown_backend():
+    with pytest.raises(ValueError):
+        Publisher(DummyWorkflow(), backends=("carrier-pigeon",))
+
+
+def test_status_server_serves_json_and_page():
+    from znicz_tpu.samples import wine
+    root.wine.decision.max_epochs = 2
+    try:
+        wf = wine.run_sample()
+    finally:
+        root.wine.decision.max_epochs = 100
+    server = StatusServer(wf, port=0).start()
+    try:
+        base = "http://127.0.0.1:%d" % server.port
+        with urllib.request.urlopen(base + "/status.json", timeout=10) as r:
+            st = json.loads(r.read())
+        assert st["workflow"] == "WineWorkflow"
+        assert "loader" in st["units"]
+        assert st["run_counts"]["loader"] >= 2
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            page = r.read().decode()
+        assert "WineWorkflow" in page
+        with urllib.request.urlopen(base + "/nope", timeout=10) as r:
+            pass
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        server.stop()
+
+
+def test_avatar_mirrors_loader_stream():
+    """The avatar yields the same minibatch sequence as a twin loader,
+    one step behind, through its own Arrays."""
+    from znicz_tpu.loader.loader_wine import WineLoader
+    from znicz_tpu.core import prng
+
+    # private PRNGs: the producer thread draws concurrently with the twin
+    real = WineLoader(None, minibatch_size=16,
+                      prng=prng.RandomGenerator().seed(4321))
+    w = DummyWorkflow()
+    av = Avatar(w, loader=real, queue_depth=2)
+    av.initialize()
+
+    twin = WineLoader(None, minibatch_size=16,
+                      prng=prng.RandomGenerator().seed(4321))
+    twin.initialize()
+
+    try:
+        for _ in range(8):
+            av.run()
+            twin.run()
+            assert int(av.minibatch_size) == int(twin.minibatch_size)
+            a = av.minibatch_data.mem[:int(av.minibatch_size)]
+            b = twin.minibatch_data.mem[:int(twin.minibatch_size)]
+            assert numpy.abs(a - b).max() == 0
+            assert (av.minibatch_labels.mem[:int(av.minibatch_size)] ==
+                    twin.minibatch_labels.mem[:int(twin.minibatch_size)]
+                    ).all()
+    finally:
+        av.stop()
+
+
+def test_avatar_requires_loader():
+    av = Avatar(DummyWorkflow())
+    with pytest.raises(ValueError):
+        av.initialize()
+
+
+def test_downloader_skips_when_files_exist(tmp_path):
+    (tmp_path / "data.bin").write_bytes(b"x")
+    d = Downloader(DummyWorkflow(), directory=str(tmp_path),
+                   files=("data.bin",))
+    d.initialize()
+    d.run()  # no url needed — satisfied
+
+
+def test_downloader_fetches_and_extracts_tar(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "payload.txt").write_text("hello")
+    archive = tmp_path / "data.tar.gz"
+    with tarfile.open(archive, "w:gz") as t:
+        t.add(src / "payload.txt", arcname="payload.txt")
+    dest = tmp_path / "dest"
+    d = Downloader(DummyWorkflow(), url="file://" + str(archive),
+                   directory=str(dest), files=("payload.txt",))
+    d.initialize()
+    d.run()
+    assert (dest / "payload.txt").read_text() == "hello"
+    # second run: satisfied, no re-download
+    os.remove(archive)
+    d.run()
+
+
+def test_downloader_missing_url_raises(tmp_path):
+    d = Downloader(DummyWorkflow(), directory=str(tmp_path),
+                   files=("nope.bin",))
+    d.initialize()
+    with pytest.raises(ValueError):
+        d.run()
+
+
+def test_shell_is_noop_headless():
+    s = Shell(DummyWorkflow())
+    s.run()
+    assert s.interactions == 0
+    # explicit enable still refuses without a tty
+    s2 = Shell(DummyWorkflow(), enabled=True)
+    assert not s2.should_interact
+    s2.run()
+    assert s2.interactions == 0

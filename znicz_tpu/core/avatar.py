@@ -1,0 +1,190 @@
+"""Avatar — loader decoupling for pipelined input.
+
+TPU-era equivalent of the reference ``veles.avatar.Avatar`` (SURVEY.md
+§2.9: "decouples the loader into a separate producer process/pipeline",
+wired by standard_workflow.py:386-404 link_avatar).  The reference ships
+minibatches between processes over ZeroMQ; the win — host-side IO and
+augmentation overlapping device compute — is had here with a producer
+THREAD and a bounded queue: the numpy/file work in loaders releases the
+GIL, and the device step runs from the consumer side one minibatch
+behind.
+
+The Avatar mirrors the loader's minibatch attributes, so downstream
+``link_attrs(loader, ...)`` wiring works identically against the avatar.
+"""
+
+import queue
+import threading
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.units import Unit
+
+#: loader attributes mirrored each minibatch (reference Avatar.reals is
+#: loader.exports + extras; these cover the Loader contract in
+#: znicz_tpu/loader/base.py)
+MINIBATCH_ATTRS = (
+    "minibatch_data", "minibatch_labels", "minibatch_indices",
+    "minibatch_targets", "minibatch_class", "minibatch_size",
+    "minibatch_offset", "epoch_ended", "epoch_number", "last_minibatch",
+)
+
+#: static attributes cloned once at initialize
+STATIC_ATTRS = (
+    "class_lengths", "max_minibatch_size", "total_samples", "has_labels",
+    "labels_mapping", "normalizer", "target_normalizer", "class_targets",
+)
+
+
+class Avatar(Unit):
+    """Prefetching mirror of a loader.
+
+    kwargs: ``loader`` (the real loader unit), ``queue_depth``
+    (prefetched minibatches, default 2), ``extra_attrs`` (additional
+    attribute names to mirror each minibatch).
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(Avatar, self).__init__(workflow, **kwargs)
+        self.loader = kwargs.get("loader")
+        self.queue_depth = int(kwargs.get("queue_depth", 2))
+        self.extra_attrs = tuple(kwargs.get("extra_attrs", ()))
+        self._queue = None
+        self._thread = None
+        self._stop_evt = threading.Event()
+        self._error = None
+        self._cloned = False
+        if self.loader is not None:
+            # clone NOW so link-time gate expressions (~avatar.epoch_ended
+            # etc.) capture this unit's own mutable objects
+            self.clone()
+
+    # -- cloning ------------------------------------------------------------
+    def clone(self):
+        """Copy the loader's static + current minibatch attributes onto
+        this unit (reference Avatar.clone).  Array/Bool attributes become
+        NEW objects owned by the avatar — created exactly once, then
+        updated in place — so downstream link_attrs and gate expressions
+        against the avatar stay valid while the loader races ahead."""
+        if self._cloned:
+            self._merge({
+                name: _snapshot(getattr(self.loader, name))
+                for name in (STATIC_ATTRS + MINIBATCH_ATTRS +
+                             self.extra_attrs)
+                if hasattr(self.loader, name)})
+            return
+        self._cloned = True
+        for name in STATIC_ATTRS + MINIBATCH_ATTRS + self.extra_attrs:
+            if not hasattr(self.loader, name):
+                continue
+            value = getattr(self.loader, name)
+            if isinstance(value, Array):
+                mirror = Array(name="%s@avatar" % name)
+                if value:
+                    value.map_read()
+                    mirror.reset(numpy.array(value.mem))
+                setattr(self, name, mirror)
+            elif type(value).__name__ == "Bool":
+                # own Bool object so gate expressions built against the
+                # avatar keep observing updates
+                from znicz_tpu.core.mutable import Bool
+                setattr(self, name, Bool(bool(value)))
+            else:
+                setattr(self, name, _snapshot(value))
+
+    def initialize(self, device=None, **kwargs):
+        super(Avatar, self).initialize(device=device, **kwargs)
+        if self.loader is None:
+            raise ValueError("Avatar needs a loader")
+        if not self.loader.initialized:
+            self.loader.initialize(device=device, **kwargs)
+        self.clone()
+        self._queue = queue.Queue(maxsize=self.queue_depth)
+        self._stop_evt.clear()
+        if self.workflow is not None and \
+                hasattr(self.workflow, "on_workflow_finished"):
+            self.workflow.on_workflow_finished(self.stop)
+
+    def _ensure_producer(self):
+        # started lazily at the first minibatch, NOT in initialize: the
+        # workflow's initialize pass may still touch the real loader, and
+        # the producer must not race it
+        if self._thread is None:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._produce,
+                name="avatar-%s" % self.loader.name, daemon=True)
+            self._thread.start()
+
+    # -- producer side ------------------------------------------------------
+    def _produce(self):
+        try:
+            while not self._stop_evt.is_set():
+                self.loader.run()
+                item = {}
+                for name in MINIBATCH_ATTRS + self.extra_attrs:
+                    if hasattr(self.loader, name):
+                        item[name] = _snapshot(
+                            getattr(self.loader, name))
+                while not self._stop_evt.is_set():
+                    try:
+                        self._queue.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surface on the consumer side
+            self._error = e
+            self._queue.put(None)
+
+    # -- consumer side ------------------------------------------------------
+    def run(self):
+        self._ensure_producer()
+        item = self._queue.get()
+        if item is None:
+            raise RuntimeError("avatar producer failed") from self._error
+        self._merge(item)
+
+    def _merge(self, item):
+        """Update this unit's mirrored attributes IN PLACE."""
+        for name, value in item.items():
+            cur = getattr(self, name, None)
+            if isinstance(cur, Array):
+                if isinstance(value, numpy.ndarray):
+                    if cur and cur.shape == value.shape:
+                        cur.map_write()
+                        cur.mem[...] = value
+                    else:
+                        cur.reset(value)
+                # else: still-empty source Array — keep the mirror as is
+            elif type(cur).__name__ == "Bool":
+                cur <<= bool(value)
+            else:
+                setattr(self, name, value)
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread is not None:
+            # unblock a producer waiting on a full queue
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def _snapshot(value):
+    """Deep-ish copy safe to hand across the thread boundary.  Empty
+    Arrays snapshot to None (the consumer keeps its empty mirror)."""
+    if isinstance(value, Array):
+        if not value:
+            return None
+        value.map_read()
+        return numpy.array(value.mem)
+    if isinstance(value, numpy.ndarray):
+        return value.copy()
+    if hasattr(value, "__bool__") and type(value).__name__ == "Bool":
+        return bool(value)
+    return value

@@ -1,0 +1,51 @@
+"""Interactive shell unit.
+
+TPU-era equivalent of the reference ``veles.interaction.Shell`` (wired by
+standard_workflow.py link_ipython: a unit that drops into a live console
+between epochs, gated on ``decision.epoch_ended``).  The reference embeds
+IPython; here the stdlib :mod:`code` console is used, with IPython picked
+up when importable.  Interaction only happens when explicitly enabled
+(kwarg or ``root.common.interactive``) AND stdin is a tty — so headless
+runs and tests are never blocked.
+"""
+
+import sys
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.units import Unit
+
+
+class Shell(Unit):
+    """Opens an interactive console with the workflow in scope.
+
+    The banner documents the conventional locals: ``workflow``, ``unit``
+    (this shell), and ``root`` (the config tree)."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "SERVICE")
+        super(Shell, self).__init__(workflow, **kwargs)
+        self.enabled = kwargs.get("enabled", None)
+        self.interactions = 0
+
+    @property
+    def should_interact(self):
+        enabled = self.enabled
+        if enabled is None:
+            enabled = bool(getattr(root.common, "interactive", False))
+        return enabled and sys.stdin is not None and \
+            hasattr(sys.stdin, "isatty") and sys.stdin.isatty()
+
+    def run(self):
+        if not self.should_interact:
+            self.debug("non-interactive, skipping shell")
+            return
+        self.interactions += 1
+        banner = ("znicz_tpu shell — locals: workflow, unit, root. "
+                  "Ctrl-D to continue the workflow.")
+        local = {"workflow": self.workflow, "unit": self, "root": root}
+        try:
+            import IPython
+            IPython.embed(banner1=banner, user_ns=local)
+        except ImportError:
+            import code
+            code.interact(banner=banner, local=local)

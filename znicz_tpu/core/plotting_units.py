@@ -60,11 +60,21 @@ class Plotter(Unit, IPlotter):
 
     @staticmethod
     def resolve(value, field=None):
-        """Shared input resolution: optional field lookup (attr or index),
-        Array map_read, numpy view."""
+        """Shared input resolution: optional field lookup (attr name,
+        container key, or integer row index into array-likes), Array
+        map_read, numpy view."""
         if field is not None:
             if isinstance(value, (dict, list, tuple)):
                 value = value[field]
+            elif isinstance(field, int):
+                # integer field on an array-valued input = row index
+                # (reference input_fields semantics: inputs[i][field])
+                if hasattr(value, "map_read"):
+                    value.map_read()
+                    value = value.mem
+                if value is None:
+                    return None
+                value = numpy.asarray(value)[field]
             else:
                 value = getattr(value, field)
         if value is None:
@@ -145,9 +155,13 @@ class MultiHistogram(Plotter):
         self.histograms = []
 
     def fill(self):
-        if self.input is None:
+        # weightless layers carry EMPTY Arrays, not None
+        if self.input is None or \
+                (hasattr(self.input, "__bool__") and not self.input):
             return
         mem = self.resolve(self.input)
+        if mem is None or mem.ndim == 0:
+            return
         rows = mem.reshape(mem.shape[0], -1)
         self.histograms = [
             numpy.histogram(rows[i], bins=self.n_bars)
@@ -235,7 +249,14 @@ class TableMaxMin(Plotter):
     def fill(self):
         row = []
         for v in self.y:
+            # skip empty Arrays (weightless layers)
+            if v is None or (hasattr(v, "__bool__") and not v):
+                row.append((float("nan"), float("nan")))
+                continue
             arr = self.resolve(v)
+            if arr is None or arr.ndim == 0:
+                row.append((float("nan"), float("nan")))
+                continue
             row.append((float(arr.max()), float(arr.min())))
         self.rows.append(row)
         for label, (mx, mn) in zip(self.col_labels, row):

@@ -1,0 +1,152 @@
+"""Web status server — live workflow observability.
+
+TPU-era equivalent of the reference core's tornado web UI (SURVEY.md
+§5.5: workflow status + matplotlib plot streaming).  Dependency-free:
+a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
+
+* ``/``            — a small auto-refreshing HTML dashboard,
+* ``/status.json`` — workflow status (units, metrics, timings),
+* ``/plots/``      — the pngs the plotters render into <cache>/plots.
+
+Usage::
+
+    server = StatusServer(workflow, port=8080).start()
+    ...
+    server.stop()
+"""
+
+import glob
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+
+_PAGE = """<html><head><title>znicz_tpu status</title>
+<meta http-equiv="refresh" content="5"></head>
+<body><h1>znicz_tpu — %(name)s</h1>
+<pre id="status">%(status)s</pre>
+%(plots)s
+</body></html>"""
+
+
+class StatusServer(Logger):
+    """Serves one workflow's live status over HTTP."""
+
+    def __init__(self, workflow=None, port=0, host="127.0.0.1"):
+        super(StatusServer, self).__init__(logger_name="StatusServer")
+        self.workflow = workflow
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    # -- status payload -----------------------------------------------------
+    def status(self):
+        wf = self.workflow
+        payload = {"workflow": None}
+        if wf is not None:
+            payload = {
+                "workflow": type(wf).__name__,
+                "units": [u.name for u in wf.units],
+                "run_counts": {u.name: u.run_count_ for u in wf.units},
+            }
+            decision = getattr(wf, "decision", None)
+            if decision is not None:
+                for attr in ("epoch_number", "complete",
+                             "best_n_err_pt", "epoch_n_err_pt"):
+                    v = getattr(decision, attr, None)
+                    if v is not None:
+                        payload[attr] = _plain(v)
+            if hasattr(wf, "unit_timings"):
+                payload["unit_timings"] = [
+                    {"unit": u.name, "seconds": round(t, 4), "runs": n}
+                    for u, t, n in wf.unit_timings()]
+        payload["plots"] = [os.path.basename(p)
+                            for p in self._plot_files()]
+        return payload
+
+    @staticmethod
+    def _plot_files():
+        return sorted(glob.glob(os.path.join(
+            root.common.dirs.cache, "plots", "*.png")))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                server.debug(fmt, *args)
+
+            def do_GET(self):
+                try:
+                    if self.path in ("/", "/index.html"):
+                        self._send(200, "text/html",
+                                   server._render_page().encode())
+                    elif self.path == "/status.json":
+                        self._send(200, "application/json", json.dumps(
+                            server.status(), default=str).encode())
+                    elif self.path.startswith("/plots/"):
+                        name = os.path.basename(self.path)
+                        path = os.path.join(root.common.dirs.cache,
+                                            "plots", name)
+                        if os.path.exists(path):
+                            with open(path, "rb") as f:
+                                self._send(200, "image/png", f.read())
+                        else:
+                            self._send(404, "text/plain", b"not found")
+                    else:
+                        self._send(404, "text/plain", b"not found")
+                except BrokenPipeError:
+                    pass
+
+            def _send(self, code, ctype, body):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="status-server",
+            daemon=True)
+        self._thread.start()
+        self.info("status server on http://%s:%d/", self.host, self.port)
+        return self
+
+    def _render_page(self):
+        st = self.status()
+        plots = "".join('<img src="/plots/%s" width="400"/>' % p
+                        for p in st.get("plots", ()))
+        return _PAGE % {
+            "name": st.get("workflow") or "(no workflow)",
+            "status": json.dumps(st, indent=2, default=str),
+            "plots": plots,
+        }
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def _plain(obj):
+    import numpy
+    if isinstance(obj, (list, tuple)):
+        return [_plain(v) for v in obj]
+    if isinstance(obj, numpy.ndarray):
+        return obj.tolist()
+    if isinstance(obj, numpy.generic):
+        return obj.item()
+    if hasattr(obj, "__bool__") and type(obj).__name__ == "Bool":
+        return bool(obj)
+    return obj

@@ -148,6 +148,14 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
     def labels_mapping(self):
         return self._labels_mapping
 
+    @property
+    def has_labels(self):
+        """Whether the dataset carries labels (reference loader/base.py
+        Loader.has_labels).  NOT derived from minibatch_labels — that
+        buffer is always allocated; subclasses override from their actual
+        label source (see FullBatchLoader)."""
+        return bool(self._labels_mapping)
+
     def _serve_order(self):
         return [c for c in SERVE_ORDER if self.class_lengths[c] > 0]
 
@@ -259,6 +267,10 @@ class FullBatchLoader(Loader):
     @property
     def original_labels(self):
         return self._original_labels
+
+    @property
+    def has_labels(self):
+        return bool(self._original_labels) or bool(self._labels_mapping)
 
     def create_minibatch_data(self):
         sample_shape = self.original_data.shape[1:]

@@ -269,6 +269,195 @@ class StandardWorkflow(StandardWorkflowBase):
         self.mse_plotter.gate_skip = ~self.decision.epoch_ended
         return self.mse_plotter
 
+    def link_err_y_plotter(self, *parents):
+        """Last-layer max gradient sum curve
+        (reference standard_workflow.py:738-771)."""
+        from znicz_tpu.core.plotting_units import AccumulatingPlotter
+        self.err_y_plotters = []
+        prev = parents
+        for i in (1, 2):  # validation, train
+            p = AccumulatingPlotter(
+                self, name="err_y_%d" % i, input_field=i)
+            p.input = self.decision.max_err_y_sums
+            p.link_from(*prev)
+            p.gate_skip = ~self.decision.epoch_ended
+            self.err_y_plotters.append(p)
+            prev = (p,)
+        return self.err_y_plotters[-1]
+
+    def link_multi_hist_plotter(self, *parents, **kwargs):
+        """Per-layer weight histograms
+        (reference standard_workflow.py:773-816)."""
+        from znicz_tpu.core.plotting_units import MultiHistogram
+        weights_input = kwargs.get("weights_input", "weights")
+        self.multi_hist_plotter = []
+        prev = parents
+        for i, fwd in enumerate(self.forwards):
+            if getattr(fwd, weights_input, None) is None:
+                continue
+            p = MultiHistogram(self, name="hist_%d" % i,
+                               hist_number=kwargs.get("hist_number", 16),
+                               n_bars=kwargs.get("n_bars", 25))
+            p.input = getattr(fwd, weights_input)
+            p.link_from(*prev)
+            p.gate_skip = ~self.decision.epoch_ended
+            self.multi_hist_plotter.append(p)
+            prev = (p,)
+        return self.multi_hist_plotter[-1] if self.multi_hist_plotter \
+            else parents[0]
+
+    def link_similar_weights_plotter(self, *parents, **kwargs):
+        """Weight-diversity grids (reference standard_workflow.py:874-931,
+        znicz diversity.SimilarWeights2D)."""
+        from znicz_tpu.units.diversity import SimilarWeights2D
+        weights_input = kwargs.pop("weights_input", "weights")
+        self.similar_weights_plotter = []
+        prev = parents
+        for i, fwd in enumerate(self.forwards):
+            if getattr(fwd, weights_input, None) is None:
+                continue
+            # non-square weight rows are skipped at RUN time by
+            # SimilarWeights2D.fill (shapes are unknown at link time)
+            p = SimilarWeights2D(self, name="similar_%d" % i, **kwargs)
+            p.input = getattr(fwd, weights_input)
+            p.link_from(*prev)
+            p.gate_skip = ~self.decision.epoch_ended
+            self.similar_weights_plotter.append(p)
+            prev = (p,)
+        return self.similar_weights_plotter[-1] \
+            if self.similar_weights_plotter else parents[0]
+
+    def link_table_plotter(self, *parents):
+        """Max/min table over weights and gradients
+        (reference standard_workflow.py:934-969)."""
+        from znicz_tpu.core.plotting_units import TableMaxMin
+        self.table_plotter = TableMaxMin(self, name="table")
+        for i, fwd in enumerate(self.forwards):
+            if getattr(fwd, "weights", None) is None:
+                continue
+            self.table_plotter.y.append(fwd.weights)
+            self.table_plotter.col_labels.append("weights_%d" % i)
+        for i, g in enumerate(self.gds):
+            if g is None or getattr(g, "gradient_weights", None) is None:
+                continue
+            self.table_plotter.y.append(g.gradient_weights)
+            self.table_plotter.col_labels.append("gd_%d" % i)
+        self.table_plotter.link_from(*parents)
+        self.table_plotter.gate_skip = ~self.decision.epoch_ended
+        return self.table_plotter
+
+    def link_min_max_plotter(self, is_min, *parents):
+        """Epoch-metric extremum curve
+        (reference standard_workflow.py:1004-1042)."""
+        from znicz_tpu.core.plotting_units import AccumulatingPlotter
+        p = AccumulatingPlotter(
+            self, name="mse_min" if is_min else "mse_max",
+            input_field=2, input_offset=2 if is_min else 1)
+        p.input = self.decision.epoch_metrics
+        p.link_from(*parents)
+        p.gate_skip = ~self.decision.epoch_ended
+        if is_min:
+            self.min_plotter = p
+        else:
+            self.max_plotter = p
+        return p
+
+    def link_image_plotter(self, *parents):
+        """Output vs input sample images
+        (reference standard_workflow.py:1044-1066)."""
+        from znicz_tpu.core.plotting_units import ImagePlotter
+        self.image_plotter = ImagePlotter(self, name="output_sample")
+        self.image_plotter.inputs.append(self.forwards[-1].output)
+        self.image_plotter.input_fields.append(0)
+        self.image_plotter.inputs.append(self.forwards[0].input)
+        self.image_plotter.input_fields.append(0)
+        self.image_plotter.link_from(*parents)
+        self.image_plotter.gate_skip = ~self.decision.epoch_ended
+        return self.image_plotter
+
+    def link_immediate_plotter(self, *parents):
+        """Data / target / output curves
+        (reference standard_workflow.py:1068-1101)."""
+        from znicz_tpu.core.plotting_units import ImmediatePlotter
+        self.immediate_plotter = ImmediatePlotter(
+            self, name="immediate")
+        del self.immediate_plotter.inputs[:]
+        del self.immediate_plotter.input_fields[:]
+        for src in (self.loader.minibatch_data,
+                    getattr(self.loader, "minibatch_targets", None),
+                    self.forwards[-1].output):
+            if src is None:
+                continue
+            self.immediate_plotter.inputs.append(src)
+            self.immediate_plotter.input_fields.append(0)
+        self.immediate_plotter.link_from(*parents)
+        self.immediate_plotter.gate_skip = ~self.decision.epoch_ended
+        return self.immediate_plotter
+
+    # -- aux-service linkers (reference 386-411, 648-670, 1121-1149) --------
+    def link_avatar(self, *extra_attrs):
+        """Replace the just-linked loader with its prefetching Avatar so
+        host-side loading overlaps device compute.  Call right after
+        link_loader, BEFORE anything links against the loader (same
+        constraint as the reference, standard_workflow.py:386-404)."""
+        from znicz_tpu.core.avatar import Avatar
+        real = self.loader
+        avatar = Avatar(self, loader=real, extra_attrs=tuple(extra_attrs),
+                        name="avatar")
+        parents = list(real.links_from)
+        real.unlink_all()  # the producer thread drives the real loader
+        # and remove it from the unit container: the snapshotter must not
+        # pickle loader state the producer thread is mutating (and which
+        # runs AHEAD of the consumed stream).  Trade-off vs the plain
+        # loader: snapshots of avatar workflows restart the data stream
+        # at an epoch boundary instead of the exact minibatch position.
+        self.del_ref(real)
+        if parents:
+            avatar.link_from(*parents)
+        self.real_loader = real
+        self.loader = avatar
+        return avatar
+
+    def link_downloader(self, *parents, **kwargs):
+        """(reference standard_workflow.py:407-411)"""
+        from znicz_tpu.core.downloader import Downloader
+        self.downloader = Downloader(self, name="downloader", **kwargs)
+        self.downloader.link_from(*parents)
+        return self.downloader
+
+    def link_ipython(self, *parents):
+        """Between-epochs interactive shell
+        (reference standard_workflow.py:648-661)."""
+        from znicz_tpu.core.interaction import Shell
+        self.ipython = Shell(self, name="shell")
+        self.ipython.link_from(*parents)
+        self.ipython.gate_skip = ~self.decision.epoch_ended
+        return self.ipython
+
+    def link_publisher(self, *parents, **kwargs):
+        """End-of-training report (reference standard_workflow.py:663-670)."""
+        from znicz_tpu.core.publishing import Publisher
+        self.publisher = Publisher(self, name="publisher", **kwargs)
+        self.publisher.link_from(*parents)
+        self.publisher.result_providers.add(self.decision)
+        self.publisher.loader_unit = getattr(self, "real_loader",
+                                             self.loader)
+        self.publisher.gate_skip = ~self.decision.complete
+        return self.publisher
+
+    def link_data_saver(self, *parents, **kwargs):
+        """Record the observed minibatch stream
+        (reference standard_workflow.py:1121-1149)."""
+        from znicz_tpu.loader.saver import MinibatchesSaver
+        self.data_saver = MinibatchesSaver(self, name="data_saver",
+                                           **kwargs)
+        self.data_saver.link_attrs(
+            self.loader, "minibatch_data", "minibatch_labels",
+            "minibatch_class", "minibatch_size", "class_lengths",
+            "max_minibatch_size", "has_labels", "epoch_ended")
+        self.data_saver.link_from(*parents)
+        return self.data_saver
+
     def link_end_point(self, *parents):
         self.end_point.link_from(*parents)
         self.end_point.gate_block = ~self.decision.complete

@@ -101,9 +101,33 @@ class SimilarWeights2D(Weights2D):
         self.similar_pairs = []
 
     def fill(self):
+        # weightless layers carry EMPTY Arrays (same guard as
+        # Weights2D.fill)
+        if self.input is None or \
+                (hasattr(self.input, "__bool__") and not self.input):
+            self.similar_pairs = []
+            self.grid = None
+            return
         mem = self._mem().reshape(self._mem().shape[0], -1)
+        # the correlation needs square (or channels x square) kernels;
+        # non-image-like weight rows (e.g. a 13-feature FC layer) are
+        # skipped rather than crashed on
+        n_in = mem.shape[1]
+        channels = self.channels
+        s = int(numpy.round(numpy.sqrt(n_in / channels)))
+        if s * s * channels != n_in:
+            s = int(numpy.round(numpy.sqrt(n_in)))
+            if s * s == n_in:
+                channels = 1
+            else:
+                self.debug("rows of %d are not square kernels, skipping",
+                           n_in)
+                self.similar_pairs = []
+                self.grid = None
+                return
+        self.channels = channels
         self.similar_pairs = get_similar_kernels(
-            mem, channels=self.channels,
+            mem, channels=channels,
             params=SimilarityCalculationParameters(
                 self.form_threshold, self.peak_threshold,
                 self.magnitude_threshold))
