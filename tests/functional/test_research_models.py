@@ -1,0 +1,147 @@
+"""Research-model tier smoke tests (reference tests/research/*): each
+model builds via its sample module and trains >= 1 epoch with sane
+outputs.  MnistRBM is covered by tests/functional/test_samples.py."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+
+MNIST_SYNTH = {"synthetic_train": 120, "synthetic_valid": 60,
+               "minibatch_size": 30}
+
+
+def test_mnist_simple_trains():
+    from znicz_tpu.samples.research import mnist_simple
+    wf = mnist_simple.run_sample(
+        loader_config=dict(MNIST_SYNTH),
+        decision_config={"max_epochs": 3, "fail_iterations": 20})
+    assert wf.decision.epoch_number >= 3
+    assert wf.decision.best_n_err_pt[1] < 60.0
+
+
+def test_wine_relu_converges():
+    from znicz_tpu.samples.research import wine_relu
+    wf = wine_relu.run_sample(decision_config={"max_epochs": 25})
+    # softplus-relu MLP memorizes wine quickly
+    assert wf.decision.best_n_err_pt[2] < 10.0
+
+
+def test_mnist7_mse_pipeline():
+    from znicz_tpu.samples.research import mnist7
+    wf = mnist7.run_sample(
+        loader_config=dict(MNIST_SYNTH),
+        decision_config={"max_epochs": 3, "fail_iterations": 20})
+    metrics = wf.decision.epoch_metrics
+    assert metrics[1] is not None and metrics[2] is not None
+    assert 0.0 < metrics[2][0] < 4.0  # avg mse within tanh target range
+    # class_targets drive the nearest-target n_err metric
+    assert wf.decision.epoch_n_err_pt[1] is not None
+
+
+def test_hands_trains(tmp_path):
+    from znicz_tpu.samples.research import hands
+    data = hands.materialize_synthetic(str(tmp_path / "hands"))
+    wf = hands.run_sample(
+        loader_config={"train_paths": [data]},
+        decision_config={"max_epochs": 5, "fail_iterations": 10})
+    assert wf.decision.best_n_err_pt[1] < 50.0  # 2 classes, separable
+
+
+def test_tv_channels_trains(tmp_path):
+    from znicz_tpu.samples.research import tv_channels
+    data = tv_channels.materialize_synthetic(str(tmp_path / "ch"))
+    wf = tv_channels.run_sample(
+        loader_config={"train_paths": [data]},
+        decision_config={"max_epochs": 5, "fail_iterations": 10})
+    assert wf.decision.epoch_number >= 1
+
+
+def test_video_ae_reconstructs():
+    from znicz_tpu.samples.research import video_ae
+    wf = video_ae.run_sample(
+        decision_config={"max_epochs": 6, "fail_iterations": 10})
+    mse = wf.decision.epoch_metrics[2]
+    assert mse is not None
+    assert mse[0] < 0.5  # bottleneck reconstructs the blob video
+
+
+def test_mnist_ae_conv_autoencoder():
+    from znicz_tpu.samples.research import mnist_ae
+    wf = mnist_ae.run_sample(
+        loader_config=dict(MNIST_SYNTH),
+        decision_config={"max_epochs": 2, "fail_iterations": 10})
+    mse = wf.reconstruction_mse()
+    assert mse is not None and numpy.isfinite(mse[0])
+    # the deconv shares the conv's weights (reference contract)
+    assert wf.deconv.weights is wf.conv.weights
+
+
+def test_stl10_conv_stack(tmp_path):
+    from znicz_tpu.samples.research import stl10
+    data = stl10.materialize_synthetic(str(tmp_path / "stl"), n_train=20,
+                                       n_valid=8)
+    wf = stl10.run_sample(
+        loader_config={"directory": data, "minibatch_size": 10},
+        decision_config={"max_epochs": 1, "fail_iterations": 5})
+    assert wf.decision.epoch_number >= 1
+    # the graph really is the two-stage conv/pool/str/norm stack
+    types = [type(f).__name__ for f in wf.forwards]
+    assert types.count("Conv") == 2
+    assert "LRNormalizerForward" in str(types) or len(types) == 9
+
+
+def test_spam_kohonen_som(tmp_path):
+    from znicz_tpu.samples.research import spam_kohonen
+    wf = spam_kohonen.run_sample(
+        epochs=6,
+        loader_config={"file": str(tmp_path / "spam.txt.gz")},
+        exporter_file=str(tmp_path / "classified.txt"))
+    assert wf.validator.fitness > 0
+    lines = open(str(tmp_path / "classified.txt")).read().splitlines()
+    assert len(lines) == 400
+    winners = {int(v) for v in lines}
+    assert len(winners) > 1  # spread over the map
+
+
+def test_alexnet_builds_and_steps():
+    from znicz_tpu.samples.research import alexnet
+    wf = alexnet.build(
+        loader_config={"n_train": 8, "n_valid": 4, "minibatch_size": 4},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"interval": 1000, "time_interval": 1e9})
+    wf.initialize()
+    # the full 21-layer reference topology materialized
+    names = [type(f).__name__ for f in wf.forwards]
+    assert names.count("ConvStrictRELU") == 5
+    assert names.count("ZeroFiller") == 4
+    wf.run()
+    assert wf.decision.epoch_number >= 1
+
+
+def test_imagenet_ae_stage():
+    from znicz_tpu.samples.research import imagenet_ae
+    wf = imagenet_ae.run_sample(
+        decision_config={"max_epochs": 2, "fail_iterations": 5})
+    mse = wf.reconstruction_mse()
+    assert mse is not None and numpy.isfinite(mse[0])
+    assert wf.conv.weights is wf.deconv.weights
+
+
+def test_shuffled_indices_matches_serve_order():
+    """shuffled_indices must follow SERVE_ORDER (TEST, TRAIN, VALID) —
+    the order minibatch_offset counts in — not numeric class order
+    (review regression)."""
+    from znicz_tpu.loader.loader_mnist import MnistLoader
+    from znicz_tpu.loader.base import TRAIN, VALID
+
+    ldr = MnistLoader(None, synthetic_train=40, synthetic_valid=20,
+                      minibatch_size=20)
+    ldr.initialize()
+    si = ldr.shuffled_indices
+    assert len(si) == 60
+    # first 40 serving positions are TRAIN indices, then VALID
+    start_v, end_v = ldr.class_index_range(VALID)
+    start_t, end_t = ldr.class_index_range(TRAIN)
+    assert set(si[:40]) == set(range(start_t, end_t))
+    assert set(si[40:]) == set(range(start_v, end_v))

@@ -156,6 +156,18 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         label source (see FullBatchLoader)."""
         return bool(self._labels_mapping)
 
+    @property
+    def shuffled_indices(self):
+        """Serving-order -> dataset-index permutation across the whole
+        epoch (segments in SERVE_ORDER, matching minibatch_offset) — what
+        result exporters need to write per-sample outputs in dataset
+        order (reference loader exposes shuffled_indices)."""
+        parts = [self._indices[c] for c in self._serve_order()
+                 if c in self._indices and len(self._indices[c])]
+        if not parts:
+            return numpy.arange(0)
+        return numpy.concatenate(parts)
+
     def _serve_order(self):
         return [c for c in SERVE_ORDER if self.class_lengths[c] > 0]
 

@@ -1,0 +1,8 @@
+"""Research-model tier — the reference's tests/research model zoo
+(SURVEY.md §2: MnistSimple, Mnist7, WineRelu, Hands, TvChannels,
+MnistAE, VideoAE, Stl10, SpamKohonen, AlexNet, ImagenetAE; MnistRBM
+lives in znicz_tpu.samples.mnist_rbm).
+
+Each module follows the sample contract: config in ``root.<ns>``,
+``build()``, ``run_sample()``, and the launcher's ``run(load, main)``.
+"""

@@ -1,0 +1,176 @@
+"""AlexNet — the reference's ImageNet classification workflow.
+
+Parity target: reference tests/research/AlexNet
+(imagenet_workflow_config.py:111-230): conv_str 96 11x11 s4 ->
+max_pool 3x3 s2 -> LRN -> ZeroFiller(grouping 2) -> conv_str 256 5x5
+pad 2 -> pool -> LRN -> ZeroFiller -> conv_str 384 3x3 pad 1 ->
+conv_str 384 -> ZeroFiller -> conv_str 256 -> pool -> ZeroFiller ->
+fc 4096 -> str -> dropout .5 -> fc 4096 -> str -> dropout .5 ->
+softmax 1000; gaussian init, arbitrary_step LR policy, momentum 0.9.
+Published baseline 40.68% val err (BASELINE.md).  The reference feeds
+preprocessed ImageNet pickles; absent data is synthesized as
+prototype-class 227x227x3 images through the same full-batch contract."""
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.base import (FullBatchLoader, IFullBatchLoader,
+                                   TEST, VALID, TRAIN)
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+BASE_LR = 0.01
+WD = 0.0005
+_CONV_BWD = {"learning_rate": BASE_LR, "learning_rate_bias": BASE_LR * 2,
+             "weights_decay": WD, "weights_decay_bias": 0,
+             "gradient_moment": 0.9, "gradient_moment_bias": 0.9}
+
+
+def make_layers(n_classes=1000):
+    """The AlexNet layer list (reference config:111-230)."""
+    return [
+        {"name": "conv_str1", "type": "conv_str",
+         "->": {"n_kernels": 96, "kx": 11, "ky": 11,
+                "padding": (0, 0, 0, 0), "sliding": (4, 4),
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": dict(_CONV_BWD, factor_ortho=0.001)},
+        {"name": "max_pool1", "type": "max_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "norm1", "type": "norm",
+         "n": 5, "alpha": 0.0001, "beta": 0.75},
+        {"name": "grouping1", "type": "zero_filter", "grouping": 2},
+        {"name": "conv_str2", "type": "conv_str",
+         "->": {"n_kernels": 256, "kx": 5, "ky": 5,
+                "padding": (2, 2, 2, 2), "sliding": (1, 1),
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 1},
+         "<-": dict(_CONV_BWD)},
+        {"name": "max_pool2", "type": "max_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "norm2", "type": "norm",
+         "n": 5, "alpha": 0.0001, "beta": 0.75},
+        {"name": "grouping2", "type": "zero_filter", "grouping": 2},
+        {"name": "conv_str3", "type": "conv_str",
+         "->": {"n_kernels": 384, "kx": 3, "ky": 3,
+                "padding": (1, 1, 1, 1), "sliding": (1, 1),
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": dict(_CONV_BWD)},
+        {"name": "conv_str4", "type": "conv_str",
+         "->": {"n_kernels": 384, "kx": 3, "ky": 3,
+                "padding": (1, 1, 1, 1), "sliding": (1, 1),
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 1},
+         "<-": dict(_CONV_BWD)},
+        {"name": "grouping3", "type": "zero_filter", "grouping": 2},
+        {"name": "conv_str5", "type": "conv_str",
+         "->": {"n_kernels": 256, "kx": 3, "ky": 3,
+                "padding": (1, 1, 1, 1), "sliding": (1, 1),
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 1},
+         "<-": dict(_CONV_BWD)},
+        {"name": "max_pool5", "type": "max_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "grouping5", "type": "zero_filter", "grouping": 2},
+        {"name": "fc6", "type": "all2all",
+         "->": {"output_sample_shape": 4096,
+                "weights_filling": "gaussian", "weights_stddev": 0.005,
+                "bias_filling": "constant", "bias_stddev": 1},
+         "<-": dict(_CONV_BWD)},
+        {"name": "relu6", "type": "activation_str"},
+        {"name": "drop6", "type": "dropout", "dropout_ratio": 0.5},
+        {"name": "fc7", "type": "all2all",
+         "->": {"output_sample_shape": 4096,
+                "weights_filling": "gaussian", "weights_stddev": 0.005,
+                "bias_filling": "constant", "bias_stddev": 1},
+         "<-": dict(_CONV_BWD)},
+        {"name": "relu7", "type": "activation_str"},
+        {"name": "drop7", "type": "dropout", "dropout_ratio": 0.5},
+        {"name": "fc_softmax8", "type": "softmax",
+         "->": {"output_sample_shape": n_classes,
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": dict(_CONV_BWD)}]
+
+
+class SyntheticImagenetLoader(FullBatchLoader, IFullBatchLoader):
+    """Prototype-class RGB images through the full-batch contract."""
+
+    MAPPING = "synthetic_imagenet_loader"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super(SyntheticImagenetLoader, self).__init__(workflow, **kwargs)
+        self.n_classes = kwargs.get("n_classes", 10)
+        self.n_train = kwargs.get("n_train", 40)
+        self.n_valid = kwargs.get("n_valid", 20)
+        self.size = kwargs.get("size", 227)
+
+    def load_data(self):
+        r = numpy.random.RandomState(0x1337)
+        n = self.n_train + self.n_valid
+        protos = r.uniform(0, 255,
+                           (self.n_classes, self.size, self.size, 3))
+        labels = (numpy.arange(n) % self.n_classes).astype(int)
+        data = numpy.empty((n, self.size, self.size, 3), numpy.float32)
+        for i in range(n):
+            data[i] = protos[labels[i]] + r.normal(
+                0, 25, (self.size, self.size, 3))
+        self.original_data.reset(data)
+        del self._original_labels[:]
+        self._original_labels.extend(int(v) for v in labels)
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = self.n_valid
+        self.class_lengths[TRAIN] = self.n_train
+
+
+root.alexnet.update({
+    "decision": {"fail_iterations": 10000, "max_epochs": 10000},
+    "snapshotter": {"prefix": "alexnet", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loss_function": "softmax",
+    "loader_name": "synthetic_imagenet_loader",
+    "loader": {"minibatch_size": 4, "n_classes": 10},
+    "lr_adjuster": {"do": True, "lr_policy_name": "arbitrary_step",
+                    "bias_lr_policy_name": "arbitrary_step",
+                    "lr_parameters": {
+                        "lrs_with_lengths": [(1, 100000), (0.1, 100000),
+                                             (0.01, 100000000)]},
+                    "bias_lr_parameters": {
+                        "lrs_with_lengths": [(1, 100000), (0.1, 100000),
+                                             (0.01, 100000000)]}},
+})
+
+
+class AlexNetWorkflow(StandardWorkflow):
+    """(reference tests/research/AlexNet/imagenet_workflow.py)"""
+
+
+def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+    cfg = root.alexnet
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    kwargs.setdefault("loss_function", cfg.loss_function)
+    n_classes = loader_cfg.get("n_classes", 10)
+    snap_cfg = cfg.snapshotter.as_dict()
+    snap_cfg.update(kwargs.pop("snapshotter_config", None) or {})
+    return AlexNetWorkflow(
+        layers=layers if layers is not None else make_layers(n_classes),
+        loader_name=cfg.loader_name, loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=snap_cfg, **kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/AlexNet)."""
+    load(build)
+    main()

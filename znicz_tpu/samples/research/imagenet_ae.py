@@ -1,0 +1,187 @@
+"""ImagenetAE — convolutional autoencoder stage on ImageNet-scale images.
+
+Parity target: reference tests/research/ImagenetAE (imagenet_ae.py +
+imagenet_ae_config.py): stacked conv AE trained stage-wise (conv 108
+9x9 s3 as the first stage, later 192/224/256 stages added from
+snapshots), each stage conv -> stochastic abs pooling -> depooling ->
+weight-shared Deconv with MSE against the stage input; published
+baseline score 55.29pt (BASELINE.md).  This module implements the
+canonical single-stage AE graph (the same structure the reference
+retrains per added layer); stage stacking is driven by resuming from a
+snapshot and widening, which the snapshot/CLI tier covers."""
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.base import (FullBatchLoader, IFullBatchLoader,
+                                   TEST, VALID, TRAIN)
+from znicz_tpu.units import nn_units
+from znicz_tpu.units import conv as conv_units
+from znicz_tpu.units import pooling as pooling_units
+from znicz_tpu.units import gd_pooling as gd_pooling_units
+from znicz_tpu.units import deconv as deconv_units
+from znicz_tpu.units import evaluator as evaluator_units
+from znicz_tpu.units import decision as decision_units
+
+root.imagenet_ae.update({
+    "decision": {"fail_iterations": 20, "max_epochs": 1000},
+    "snapshotter": {"prefix": "imagenet_ae", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loader": {"minibatch_size": 8, "size": 63, "n_images": 32},
+    "learning_rate": 0.0000003,
+    "weights_decay": 0.00005,
+    "gradient_moment": 0.00001,
+    "n_kernels": 108,
+    "kx": 9,
+    "ky": 9,
+    "sliding": (3, 3),
+    "include_bias": False,
+    "unsafe_padding": True,
+    "pooling": {"kx": 3, "ky": 3, "sliding": (2, 2)},
+})
+
+
+class SyntheticImageLoader(FullBatchLoader, IFullBatchLoader):
+    """Natural-image-like synthetic RGB frames (smooth random fields)."""
+
+    MAPPING = "imagenet_ae_loader"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super(SyntheticImageLoader, self).__init__(workflow, **kwargs)
+        self.size = kwargs.get("size", 63)
+        self.n_images = kwargs.get("n_images", 32)
+
+    def load_data(self):
+        r = numpy.random.RandomState(0xAE)
+        n, s = self.n_images, self.size
+        # smooth fields: low-frequency cosine mixtures + noise
+        yy, xx = numpy.mgrid[0:s, 0:s].astype(numpy.float32) / s
+        data = numpy.empty((n, s, s, 3), numpy.float32)
+        for i in range(n):
+            img = numpy.zeros((s, s))
+            for _ in range(4):
+                fx, fy = r.uniform(1, 4, 2)
+                ph = r.uniform(0, 2 * numpy.pi, 2)
+                img += r.uniform(0.2, 1.0) * numpy.cos(
+                    2 * numpy.pi * fx * xx + ph[0]) * numpy.cos(
+                    2 * numpy.pi * fy * yy + ph[1])
+            for c in range(3):
+                data[i, :, :, c] = img * r.uniform(0.5, 1.0) + \
+                    r.normal(0, 0.05, (s, s))
+        self.original_data.reset(data)
+        n_valid = n // 4
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = n_valid
+        self.class_lengths[TRAIN] = n - n_valid
+
+
+class ImagenetAEWorkflow(nn_units.NNWorkflow):
+    """One AE stage: conv -> abs-pool -> depool -> weight-shared deconv,
+    MSE to the stage input (reference imagenet_ae.py:182-266)."""
+
+    def __init__(self, workflow=None, **kwargs):
+        super(ImagenetAEWorkflow, self).__init__(workflow, **kwargs)
+        cfg = root.imagenet_ae
+        loader_cfg = cfg.loader.as_dict()
+        loader_cfg.update(kwargs.get("loader_config") or {})
+        decision_cfg = cfg.decision.as_dict()
+        decision_cfg.update(kwargs.get("decision_config") or {})
+
+        self.repeater.link_from(self.start_point)
+        self.loader = SyntheticImageLoader(self, name="loader",
+                                           **loader_cfg)
+        self.loader.link_from(self.repeater)
+
+        self.conv = conv_units.Conv(
+            self, n_kernels=cfg.n_kernels, kx=cfg.kx, ky=cfg.ky,
+            sliding=tuple(cfg.sliding), weights_filling="uniform",
+            include_bias=cfg.include_bias)
+        self.conv.link_from(self.loader)
+        self.conv.link_attrs(self.loader, ("input", "minibatch_data"))
+
+        self.pool = pooling_units.StochasticAbsPooling(
+            self, kx=cfg.pooling.kx, ky=cfg.pooling.ky,
+            sliding=tuple(cfg.pooling.sliding))
+        self.pool.link_from(self.conv)
+        self.pool.link_attrs(self.conv, ("input", "output"))
+
+        self.depool = gd_pooling_units.GDMaxAbsPooling(
+            self, kx=cfg.pooling.kx, ky=cfg.pooling.ky,
+            sliding=tuple(cfg.pooling.sliding))
+        self.depool.link_from(self.pool)
+        self.depool.link_attrs(self.pool, "input", "input_offset",
+                               ("err_output", "output"))
+
+        self.deconv = deconv_units.Deconv(
+            self, unsafe_padding=cfg.unsafe_padding)
+        self.deconv.link_from(self.depool)
+        self.deconv.link_attrs(self.conv, "weights")
+        self.deconv.link_conv_attrs(self.conv)
+        self.deconv.link_attrs(self.depool, ("input", "err_input"))
+        self.deconv.link_attrs(self.conv, ("output_shape_source", "input"))
+
+        self.evaluator = evaluator_units.EvaluatorMSE(self)
+        self.evaluator.link_from(self.deconv)
+        self.evaluator.link_attrs(self.deconv, "output")
+        self.evaluator.link_attrs(
+            self.loader,
+            ("batch_size", "minibatch_size"),
+            ("normalizer", "target_normalizer"),
+            ("target", "minibatch_data"))
+
+        self.decision = decision_units.DecisionMSE(
+            self, fail_iterations=decision_cfg.get("fail_iterations", 20),
+            max_epochs=decision_cfg.get("max_epochs", 1000))
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "minibatch_size", "last_minibatch",
+                                 "class_lengths", "epoch_ended",
+                                 "epoch_number")
+        self.decision.link_attrs(self.evaluator,
+                                 ("minibatch_metrics", "metrics"))
+
+        self.snapshotter = nn_units.NNSnapshotterToFile(
+            self, **cfg.snapshotter.as_dict())
+        self.snapshotter.link_from(self.decision)
+        self.snapshotter.link_attrs(self.decision,
+                                    ("suffix", "snapshot_suffix"))
+        self.snapshotter.gate_skip = \
+            ~self.loader.epoch_ended | ~self.decision.improved
+
+        self.gd_deconv = deconv_units.GDDeconv(
+            self, learning_rate=cfg.learning_rate,
+            weights_decay=cfg.weights_decay,
+            gradient_moment=cfg.gradient_moment)
+        self.gd_deconv.link_attrs(self.evaluator, "err_output")
+        self.gd_deconv.link_attrs(
+            self.deconv, "weights", "input", "hits", "n_kernels",
+            "kx", "ky", "sliding", "padding")
+        self.gd_deconv.link_from(self.snapshotter)
+        self.gd_deconv.gate_skip = self.decision.gd_skip
+        self.gd_deconv.need_err_input = False
+
+        self.repeater.link_from(self.gd_deconv)
+        self.end_point.link_from(self.gd_deconv)
+        self.end_point.gate_block = ~self.decision.complete
+        self.loader.gate_block = self.decision.complete
+
+    def reconstruction_mse(self):
+        return self.decision.epoch_metrics[2]
+
+
+def build(**kwargs):
+    return ImagenetAEWorkflow(**kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/ImagenetAE)."""
+    load(ImagenetAEWorkflow)
+    main()

@@ -1,0 +1,96 @@
+"""Mnist7 — MNIST digits regressed onto 7-segment display codes (MSE).
+
+Parity target: reference tests/research/Mnist7 (mnist7.py:60-90: each
+digit's target is its seven-segment encoding in {-1, 1}^7; layers
+[100, 100, 7], EvaluatorMSE with class_targets for the
+nearest-class-target error metric; published baseline 2.83% val err /
+MSE 0.111, BASELINE.md)."""
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.base import FullBatchLoaderMSEMixin, IFullBatchLoader
+from znicz_tpu.loader.loader_mnist import MnistLoader
+from znicz_tpu.core.memory import Array
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+#: seven-segment encodings of 0..9 (reference mnist7.py:72-82)
+SEVEN_SEGMENT = numpy.array(
+    [[1, 1, 1, -1, 1, 1, 1],      # 0
+     [-1, -1, 1, -1, -1, 1, -1],  # 1
+     [1, -1, 1, 1, 1, -1, 1],     # 2
+     [1, -1, 1, 1, -1, 1, 1],     # 3
+     [-1, 1, 1, 1, -1, 1, -1],    # 4
+     [1, 1, -1, 1, -1, 1, 1],     # 5
+     [1, 1, -1, 1, 1, 1, 1],      # 6
+     [1, 1, 1, -1, -1, 1, -1],    # 7
+     [1, 1, 1, 1, 1, 1, 1],       # 8
+     [1, 1, 1, 1, -1, 1, 1]],     # 9
+    dtype=numpy.float32)
+
+
+class Mnist7Loader(FullBatchLoaderMSEMixin, MnistLoader, IFullBatchLoader):
+    """MNIST data with 7-segment MSE targets."""
+
+    MAPPING = "mnist7_loader"
+
+    def load_data(self):
+        super(Mnist7Loader, self).load_data()
+        self.class_targets = Array(SEVEN_SEGMENT.copy(),
+                                   name="class_targets")
+        targets = numpy.zeros((len(self.original_labels), 7),
+                              dtype=numpy.float32)
+        for i, label in enumerate(self.original_labels):
+            targets[i] = SEVEN_SEGMENT[label]
+        self.original_targets.reset(targets)
+
+
+root.mnist7.update({
+    "decision": {"fail_iterations": 25, "max_epochs": 1000},
+    "snapshotter": {"prefix": "mnist7", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loss_function": "mse",
+    "loader_name": "mnist7_loader",
+    "loader": {"minibatch_size": 60, "normalization_type": "linear"},
+    "layers": [
+        {"name": "fc_tanh1", "type": "all2all_tanh",
+         "->": {"output_sample_shape": 100},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.00005}},
+        {"name": "fc_tanh2", "type": "all2all_tanh",
+         "->": {"output_sample_shape": 100},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.00005}},
+        {"name": "fc_out", "type": "all2all_tanh",
+         "->": {},  # width auto-set from targets_shape
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.00005}}],
+})
+
+
+class Mnist7Workflow(StandardWorkflow):
+    """(reference tests/research/Mnist7/mnist7.py:92+)"""
+
+
+def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+    cfg = root.mnist7
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    kwargs.setdefault("loss_function", cfg.loss_function)
+    return Mnist7Workflow(
+        layers=layers if layers is not None else cfg.layers,
+        loader_name=cfg.loader_name, loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=cfg.snapshotter.as_dict(), **kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/Mnist7)."""
+    load(build)
+    main()

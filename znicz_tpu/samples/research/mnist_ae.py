@@ -1,0 +1,162 @@
+"""MnistAE — convolutional autoencoder on MNIST.
+
+Parity target: reference tests/research/MnistAE (mnist_ae.py:64-190):
+conv 5x5x5 (no bias) -> StochasticAbsPooling 3x3 slide (2,2) ->
+depooling (the GDMaxAbsPooling scatter reused as a forward unit) ->
+Deconv SHARING the conv's weights (output shaped from the conv's input)
+-> EvaluatorMSE against the input frames -> DecisionMSE -> GDDeconv as
+the only trained gradient unit.  Published baseline MSE 0.5478/0.5482
+(BASELINE.md)."""
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.units import nn_units
+from znicz_tpu.units import conv as conv_units
+from znicz_tpu.units import pooling as pooling_units
+from znicz_tpu.units import gd_pooling as gd_pooling_units
+from znicz_tpu.units import deconv as deconv_units
+from znicz_tpu.units import evaluator as evaluator_units
+from znicz_tpu.units import decision as decision_units
+from znicz_tpu.loader.loader_mnist import MnistLoader
+
+
+class MnistAELoader(MnistLoader):
+    """MNIST with an explicit channel axis — Deconv's output shape
+    source must be NHWC (reference mnist_ae.py:64-70)."""
+
+    MAPPING = "mnist_ae_loader"
+
+    def load_data(self):
+        super(MnistAELoader, self).load_data()
+        d = self.original_data.mem
+        self.original_data.reset(d.reshape(d.shape[0], 28, 28, 1))
+
+root.mnist_ae.update({
+    "decision": {"fail_iterations": 20, "max_epochs": 1000},
+    "snapshotter": {"prefix": "mnist_ae", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loader": {"minibatch_size": 100, "normalization_type": "linear"},
+    "learning_rate": 0.000001,
+    "weights_decay": 0.00005,
+    "gradient_moment": 0.00001,
+    "n_kernels": 5,
+    "kx": 5,
+    "ky": 5,
+    "include_bias": False,
+    "unsafe_padding": True,
+    "pooling": {"kx": 3, "ky": 3, "sliding": (2, 2)},
+})
+
+
+class MnistAEWorkflow(nn_units.NNWorkflow):
+    """conv -> abs-pool -> depool -> weight-shared deconv, MSE to input
+    (reference mnist_ae.py:107-190)."""
+
+    def __init__(self, workflow=None, **kwargs):
+        super(MnistAEWorkflow, self).__init__(workflow, **kwargs)
+        cfg = root.mnist_ae
+        loader_cfg = cfg.loader.as_dict()
+        loader_cfg.update(kwargs.get("loader_config") or {})
+        decision_cfg = cfg.decision.as_dict()
+        decision_cfg.update(kwargs.get("decision_config") or {})
+
+        self.repeater.link_from(self.start_point)
+
+        self.loader = MnistAELoader(self, **loader_cfg)
+        self.loader.link_from(self.repeater)
+
+        self.conv = conv_units.Conv(
+            self, n_kernels=cfg.n_kernels, kx=cfg.kx, ky=cfg.ky,
+            weights_filling="uniform",
+            include_bias=cfg.include_bias)
+        self.conv.link_from(self.loader)
+        self.conv.link_attrs(self.loader, ("input", "minibatch_data"))
+
+        self.pool = pooling_units.StochasticAbsPooling(
+            self, kx=cfg.pooling.kx, ky=cfg.pooling.ky,
+            sliding=tuple(cfg.pooling.sliding))
+        self.pool.link_from(self.conv)
+        self.pool.link_attrs(self.conv, ("input", "output"))
+
+        # depooling: the abs-pool backward scatter reused as a forward
+        # stage (err_output = pool.output -> err_input has input shape)
+        self.depool = gd_pooling_units.GDMaxAbsPooling(
+            self, kx=cfg.pooling.kx, ky=cfg.pooling.ky,
+            sliding=tuple(cfg.pooling.sliding))
+        self.depool.link_from(self.pool)
+        self.depool.link_attrs(self.pool, "input", "input_offset",
+                               ("err_output", "output"))
+
+        self.deconv = deconv_units.Deconv(
+            self, unsafe_padding=cfg.unsafe_padding)
+        self.deconv.link_from(self.depool)
+        self.deconv.link_attrs(self.conv, "weights")
+        self.deconv.link_conv_attrs(self.conv)
+        self.deconv.link_attrs(self.depool, ("input", "err_input"))
+        self.deconv.link_attrs(self.conv, ("output_shape_source", "input"))
+
+        self.evaluator = evaluator_units.EvaluatorMSE(self)
+        self.evaluator.link_from(self.deconv)
+        self.evaluator.link_attrs(self.deconv, "output")
+        self.evaluator.link_attrs(
+            self.loader,
+            ("batch_size", "minibatch_size"),
+            ("normalizer", "target_normalizer"),
+            ("target", "minibatch_data"))
+
+        self.decision = decision_units.DecisionMSE(
+            self, fail_iterations=decision_cfg.get("fail_iterations", 20),
+            max_epochs=decision_cfg.get("max_epochs", 1000))
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "minibatch_size", "last_minibatch",
+                                 "class_lengths", "epoch_ended",
+                                 "epoch_number")
+        self.decision.link_attrs(self.evaluator,
+                                 ("minibatch_metrics", "metrics"))
+
+        self.snapshotter = nn_units.NNSnapshotterToFile(
+            self, **cfg.snapshotter.as_dict())
+        self.snapshotter.link_from(self.decision)
+        self.snapshotter.link_attrs(self.decision,
+                                    ("suffix", "snapshot_suffix"))
+        self.snapshotter.gate_skip = \
+            ~self.loader.epoch_ended | ~self.decision.improved
+
+        self.gd_deconv = deconv_units.GDDeconv(
+            self, learning_rate=cfg.learning_rate,
+            weights_decay=cfg.weights_decay,
+            gradient_moment=cfg.gradient_moment)
+        self.gd_deconv.link_attrs(self.evaluator, "err_output")
+        self.gd_deconv.link_attrs(
+            self.deconv, "weights", "input", "hits", "n_kernels",
+            "kx", "ky", "sliding", "padding")
+        self.gd_deconv.link_from(self.snapshotter)
+        self.gd_deconv.gate_skip = self.decision.gd_skip
+        self.gd_deconv.need_err_input = False
+
+        self.repeater.link_from(self.gd_deconv)
+        self.end_point.link_from(self.gd_deconv)
+        self.end_point.gate_block = ~self.decision.complete
+        self.loader.gate_block = self.decision.complete
+
+    def reconstruction_mse(self):
+        return self.decision.epoch_metrics[2]
+
+
+def build(**kwargs):
+    return MnistAEWorkflow(**kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/MnistAE)."""
+    load(MnistAEWorkflow)
+    main()

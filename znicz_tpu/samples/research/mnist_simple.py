@@ -1,0 +1,60 @@
+"""MnistSimple — tuned single-hidden-layer MNIST MLP.
+
+Parity target: reference tests/research/MnistSimple (mnist_config.py:
+layers [364, 10], GA-tuned learning_rate/weights_decay/factor_ortho,
+linear normalization, minibatch 88; published baseline 1.48% val err,
+BASELINE.md)."""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.standard_workflow import StandardWorkflow
+import znicz_tpu.loader.loader_mnist  # noqa: F401 (registers mnist_loader)
+
+root.mnist_simple.update({
+    "decision": {"fail_iterations": 300, "max_epochs": 1000},
+    "snapshotter": {"prefix": "mnist_simple", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loader_name": "mnist_loader",
+    "loader": {"minibatch_size": 88, "normalization_type": "linear"},
+    "layers": [
+        {"name": "fc_tanh1", "type": "all2all_tanh",
+         "->": {"output_sample_shape": 364, "weights_stddev": 0.05,
+                "bias_stddev": 0.05},
+         "<-": {"learning_rate": 0.028557478339518444,
+                "weights_decay": 0.00012315096341168246,
+                "factor_ortho": 0.001}},
+        {"name": "fc_softmax2", "type": "softmax",
+         "->": {"output_sample_shape": 10, "weights_stddev": 0.05,
+                "bias_stddev": 0.05},
+         "<-": {"learning_rate": 0.028557478339518444,
+                "weights_decay": 0.00012315096341168246}}],
+})
+
+
+class MnistSimpleWorkflow(StandardWorkflow):
+    """(reference tests/research/MnistSimple/mnist.py)"""
+
+
+def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+    cfg = root.mnist_simple
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    return MnistSimpleWorkflow(
+        layers=layers if layers is not None else cfg.layers,
+        loader_name=cfg.loader_name, loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=cfg.snapshotter.as_dict(), **kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/MnistSimple)."""
+    load(build)
+    main()

@@ -1,0 +1,103 @@
+"""VideoAE — fully-connected frame autoencoder.
+
+Parity target: reference tests/research/VideoAE (video_ae_config.py:
+layers [9, [90, 160]] — 9-unit bottleneck reconstructing 90x160
+grayscale frames, MSE vs the input frames, lr 0.01; published baseline
+MSE 0.0000/0.2596, BASELINE.md).  The reference downloads video_ae.tar
+of frames; absent files are synthesized as smooth moving-blob frames
+(a 'video') with the same loader contract (targets == data)."""
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader.base import (FullBatchLoaderMSE, IFullBatchLoader,
+                                   TEST, VALID, TRAIN)
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+FRAME = (18, 32)  # scaled-down 90x160 for the zero-egress box
+
+
+class VideoAELoader(FullBatchLoaderMSE, IFullBatchLoader):
+    """Frames in, the SAME frames as targets (autoencoder contract)."""
+
+    MAPPING = "video_ae_loader"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super(VideoAELoader, self).__init__(workflow, **kwargs)
+        self.n_frames = kwargs.get("n_frames", 120)
+        self.frame_shape = tuple(kwargs.get("frame_shape", FRAME))
+
+    def load_data(self):
+        h, w = self.frame_shape
+        r = numpy.random.RandomState(0x51DE0)
+        t = numpy.arange(self.n_frames, dtype=numpy.float32)
+        yy, xx = numpy.mgrid[0:h, 0:w].astype(numpy.float32)
+        # one blob orbiting + one bouncing: smooth, low-dimensional video
+        cx1 = w * (0.5 + 0.3 * numpy.cos(t / 9))
+        cy1 = h * (0.5 + 0.3 * numpy.sin(t / 9))
+        cx2 = w * (0.5 + 0.4 * numpy.sin(t / 5))
+        cy2 = numpy.full_like(t, h * 0.5)
+        frames = numpy.empty((self.n_frames, h, w), numpy.float32)
+        for i in range(self.n_frames):
+            frames[i] = (
+                numpy.exp(-((xx - cx1[i]) ** 2 + (yy - cy1[i]) ** 2) /
+                          (2 * (h / 6) ** 2)) +
+                numpy.exp(-((xx - cx2[i]) ** 2 + (yy - cy2[i]) ** 2) /
+                          (2 * (h / 8) ** 2)))
+        frames += r.normal(0, 0.01, frames.shape).astype(numpy.float32)
+        self.original_data.reset(frames)
+        self.original_targets.reset(frames.reshape(self.n_frames, -1)
+                                    .copy())
+        n_valid = self.n_frames // 5
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = n_valid
+        self.class_lengths[TRAIN] = self.n_frames - n_valid
+
+
+root.video_ae.update({
+    "decision": {"fail_iterations": 100, "max_epochs": 1000},
+    "snapshotter": {"prefix": "video_ae", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loss_function": "mse",
+    "loader_name": "video_ae_loader",
+    "loader": {"minibatch_size": 50},
+    "layers": [
+        {"name": "bottleneck", "type": "all2all_tanh",
+         "->": {"output_sample_shape": 9},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.00005}},
+        {"name": "reconstruct", "type": "all2all_tanh",
+         "->": {},  # width auto-set from targets_shape
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.00005}}],
+})
+
+
+class VideoAEWorkflow(StandardWorkflow):
+    """(reference tests/research/VideoAE/video_ae.py)"""
+
+
+def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+    cfg = root.video_ae
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    kwargs.setdefault("loss_function", cfg.loss_function)
+    return VideoAEWorkflow(
+        layers=layers if layers is not None else cfg.layers,
+        loader_name=cfg.loader_name, loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=cfg.snapshotter.as_dict(), **kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/VideoAE)."""
+    load(build)
+    main()

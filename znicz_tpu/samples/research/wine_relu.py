@@ -1,0 +1,54 @@
+"""WineRelu — Wine classification through the softplus-"relu" activation.
+
+Parity target: reference tests/research/WineRelu (wine_relu_config.py:
+all2all_relu 10 -> softmax, lr 0.03, minibatch 10; published baseline
+0.00% train err, BASELINE.md)."""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.standard_workflow import StandardWorkflow
+import znicz_tpu.loader.loader_wine  # noqa: F401 (registers wine_loader)
+
+root.wine_relu.update({
+    "decision": {"fail_iterations": 250, "max_epochs": 200},
+    "snapshotter": {"prefix": "wine_relu", "interval": 1,
+                    "time_interval": 0, "compression": ""},
+    "loader_name": "wine_loader",
+    "loader": {"minibatch_size": 10},
+    "layers": [
+        {"name": "fc_relu1", "type": "all2all_relu",
+         "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.03, "weights_decay": 0.0}},
+        {"name": "fc_softmax2", "type": "softmax",
+         "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": 0.03, "weights_decay": 0.0}}],
+})
+
+
+class WineReluWorkflow(StandardWorkflow):
+    """(reference tests/research/WineRelu/wine_relu.py)"""
+
+
+def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+    cfg = root.wine_relu
+    loader_cfg = cfg.loader.as_dict()
+    loader_cfg.update(loader_config or {})
+    decision_cfg = cfg.decision.as_dict()
+    decision_cfg.update(decision_config or {})
+    return WineReluWorkflow(
+        layers=layers if layers is not None else cfg.layers,
+        loader_name=cfg.loader_name, loader_config=loader_cfg,
+        decision_config=decision_cfg,
+        snapshotter_config=cfg.snapshotter.as_dict(), **kwargs)
+
+
+def run_sample(device=None, **kwargs):
+    wf = build(**kwargs)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    """Launcher contract (reference tests/research/WineRelu)."""
+    load(build)
+    main()

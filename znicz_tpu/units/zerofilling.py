@@ -45,26 +45,34 @@ class ZeroFiller(ForwardBase):
     def initialize(self, device=None, **kwargs):
         super(ZeroFiller, self).initialize(device=device, **kwargs)
         if not self.weights:
+            # the linked next-layer weights may not be allocated yet
+            # (graph order initializes this unit first) — the mask is
+            # then built lazily on the first run
             return True
-        if not self.mask:
-            if self.effective_shape[1] % self.grouping != 0:
-                raise ValueError(
-                    "Non-multiple of grouping weights shape: %s, grouping=%d"
-                    % (self.weights.shape, self.grouping))
-            kernels, chans = self.effective_shape
-            k = numpy.arange(kernels)[:, None] % self.grouping
-            c = numpy.arange(chans)[None, :] % self.grouping
-            self.mask.reset((k != c).astype(self.weights.dtype))
-        else:
+        self._ensure_mask()
+
+    def _ensure_mask(self):
+        if self.mask:
             assert self.mask.shape == self.effective_shape
+            return
+        if self.effective_shape[1] % self.grouping != 0:
+            raise ValueError(
+                "Non-multiple of grouping weights shape: %s, grouping=%d"
+                % (self.weights.shape, self.grouping))
+        kernels, chans = self.effective_shape
+        k = numpy.arange(kernels)[:, None] % self.grouping
+        c = numpy.arange(chans)[None, :] % self.grouping
+        self.mask.reset((k != c).astype(self.weights.dtype))
 
     def numpy_run(self):
+        self._ensure_mask()
         self.mask.map_read()
         self.weights.map_write()
         w2 = self.weights.mem.reshape(self.effective_shape)
         w2 *= self.mask.mem
 
     def jax_run(self):
+        self._ensure_mask()
         w = self.weights.dev
         self.weights.set_dev(
             (w.reshape(self.effective_shape) * self.mask.dev).reshape(
