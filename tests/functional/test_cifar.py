@@ -7,7 +7,6 @@ on the deterministic synthetic set and asserts the error decreases and the
 lr_adjuster graph surgery holds together.
 """
 
-import numpy
 
 from znicz_tpu.core.backends import JaxDevice
 from znicz_tpu.core import prng

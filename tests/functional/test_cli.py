@@ -5,7 +5,6 @@ import subprocess
 import sys
 
 import numpy
-import pytest
 
 from znicz_tpu.core.config import root
 from znicz_tpu.launcher import (Launcher, list_samples, run_workflow,

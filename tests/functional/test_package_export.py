@@ -53,7 +53,6 @@ def _trained_mlp(tmp_path):
 
 def _python_forward(wf, x):
     """Run the trained workflow's own forward stack on a fresh batch."""
-    from znicz_tpu.core.memory import Array
     wf.forwards[0].input.reset(x.astype(
         wf.forwards[0].weights.mem.dtype))
     for fwd in wf.forwards:

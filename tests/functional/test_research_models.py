@@ -3,9 +3,7 @@ model builds via its sample module and trains >= 1 epoch with sane
 outputs.  MnistRBM is covered by tests/functional/test_samples.py."""
 
 import numpy
-import pytest
 
-from znicz_tpu.core.config import root
 
 MNIST_SYNTH = {"synthetic_train": 120, "synthetic_valid": 60,
                "minibatch_size": 30}

@@ -2,15 +2,12 @@
 extended plotter set, downloader, ipython (reference
 standard_workflow.py:386-411, 648-670, 738-1149)."""
 
-import glob
 import znicz_tpu.loader.loader_wine  # noqa: F401 (registers wine_loader)
-import os
 
 import numpy
-import pytest
 
 from znicz_tpu.core.config import root
-from znicz_tpu.loader.saver import (MinibatchesLoader, MinibatchesSaver,
+from znicz_tpu.loader.saver import (MinibatchesLoader,
                                     read_minibatch_stream)
 from znicz_tpu.standard_workflow import StandardWorkflow
 

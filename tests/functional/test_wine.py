@@ -1,7 +1,6 @@
 """Functional test: the Wine MLP converges (reference contract:
 samples/Wine/wine.py:58 — within 100 epochs)."""
 
-import numpy
 
 from znicz_tpu.core import prng
 

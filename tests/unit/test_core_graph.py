@@ -9,8 +9,7 @@ import pytest
 
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.units import Unit
-from znicz_tpu.core.workflow import (
-    Workflow, DummyWorkflow, Repeater, NoMoreJobs)
+from znicz_tpu.core.workflow import DummyWorkflow, Repeater
 from znicz_tpu.core.memory import Array, roundup
 from znicz_tpu.core import prng
 

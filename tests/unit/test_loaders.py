@@ -10,10 +10,9 @@ import os
 import pickle
 
 import numpy
-import pytest
 
 from znicz_tpu.core.workflow import DummyWorkflow
-from znicz_tpu.loader.base import TEST, VALID, TRAIN, UserLoaderRegistry
+from znicz_tpu.loader.base import VALID, TRAIN, UserLoaderRegistry
 from znicz_tpu.loader.caffe import Datum, BlobProto
 from znicz_tpu.loader.lmdb_native import LMDBReader, write_lmdb
 

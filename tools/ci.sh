@@ -1,0 +1,10 @@
+#!/bin/sh
+# CI entry: lint + build the C++ runtime + full test suite.
+set -e
+cd "$(dirname "$0")/.."
+echo "== lint"
+python tools/lint.py
+echo "== cpp"
+make -C cpp -s
+echo "== tests"
+python -m pytest tests/ -q

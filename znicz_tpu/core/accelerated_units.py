@@ -14,7 +14,7 @@ buffers allocated, mirroring the reference lifecycle.
 
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core.memory import Array
-from znicz_tpu.core.backends import NumpyDevice, JaxDevice, get_device
+from znicz_tpu.core.backends import NumpyDevice, get_device
 from znicz_tpu.core.workflow import Workflow
 
 

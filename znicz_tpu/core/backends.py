@@ -7,7 +7,6 @@ hardware, CPU in tests) — XLA JIT specialization replaces the reference's
 per-shape ``#define`` kernel builds (conv.py:185-213).
 """
 
-import numpy
 
 from znicz_tpu.core.config import root
 

@@ -91,10 +91,10 @@ class Config(object):
         file = file or sys.stdout
         for k, v in sorted(self.items()):
             if isinstance(v, Config):
-                print("%s%s:" % ("  " * indent, k), file=file)
+                print("%s%s:" % ("  " * indent, k), file=file)  # noqa
                 v.print_(indent + 1, file)
             else:
-                print("%s%s: %s" % ("  " * indent, k, v), file=file)
+                print("%s%s: %s" % ("  " * indent, k, v), file=file)  # noqa
 
     def to_json(self):
         def default(o):

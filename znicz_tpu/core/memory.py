@@ -20,7 +20,8 @@ HOST, DEV, SYNC = "host", "dev", "sync"
 
 
 def roundup(n, m):
-    """Round ``n`` up to a multiple of ``m`` (reference: veles.memory.roundup)."""
+    """Round ``n`` up to a multiple of ``m``
+    (reference: veles.memory.roundup)."""
     r = n % m
     return n if r == 0 else n + m - r
 
@@ -223,4 +224,5 @@ class NumDiff(object):
 
     @property
     def derivative(self):
-        return (self.errs * NumDiff.coeffs).sum() / (NumDiff.divizor * NumDiff.h)
+        return (self.errs * NumDiff.coeffs).sum() / (
+            NumDiff.divizor * NumDiff.h)

@@ -19,7 +19,6 @@ a C++ inference runtime covering the libZnicz unit scope.
 
 import io
 import json
-import os
 import zipfile
 
 import numpy

@@ -14,7 +14,6 @@ records whose Datum omits geometry; ``db_splitted_channels`` selects CHW
 
 import numpy
 
-from znicz_tpu.loader.base import TEST, VALID, TRAIN
 from znicz_tpu.loader.caffe import Datum
 from znicz_tpu.loader.image import ImageLoaderBase, FullBatchImageLoader, \
     IImageLoader

@@ -10,7 +10,8 @@ normalizer.
 yann.lecun.com when files are missing (loader_mnist.py:77-107).  Here,
 ``synthetic="auto"`` (default) falls back to a deterministic synthetic
 MNIST-like dataset — per-class prototype blobs + noise, drawn from a
-fixed seed so every run sees the same data — sized by ``synthetic_train``/``synthetic_valid``.  Set
+fixed seed so every run sees the same data — sized by
+``synthetic_train``/``synthetic_valid``.  Set
 ``synthetic=False`` to require the real files, ``synthetic=True`` to force
 the fallback.
 """

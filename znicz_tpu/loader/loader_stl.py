@@ -12,7 +12,7 @@ import os
 
 import numpy
 
-from znicz_tpu.loader.base import TEST, VALID, TRAIN
+from znicz_tpu.loader.base import VALID, TRAIN
 from znicz_tpu.loader.image import FullBatchImageLoader, IImageLoader
 
 

@@ -15,7 +15,6 @@ import pickle
 import numpy
 
 from znicz_tpu.core.config import root
-from znicz_tpu.core.memory import Array
 from znicz_tpu.core.units import Unit
 from znicz_tpu.loader.base import FullBatchLoader, TEST, VALID, TRAIN
 

@@ -2,11 +2,14 @@
 
 Parity targets (constants from the reference kernel tree):
 * scaled tanh  f(x) = 1.7159 tanh(0.6666 x)          (all2all.py:271-279)
-  f'(y) = 1.14381894 - 0.388484177 y^2               (cuda/gradient_descent_tanh.cu)
-* relu (softplus) f(x) = log(1+e^x), clamped at x>15 (all2all.py:298-317)
-  f'(y) = 1 - e^{-y}                                 (cuda/gradient_descent_relu.cu)
-* strict relu f(x) = max(x, 0), f'(y) = [y > 0]      (cuda/gradient_descent_strict_relu.cu)
-* sigmoid f(x) = 1/(1+e^{-x}), f'(y) = y(1-y)        (cuda/gradient_descent_sigmoid.cu)
+  f'(y) = 1.14381894 - 0.388484177 y^2
+  (cuda/gradient_descent_tanh.cu)
+* relu (softplus) f(x) = log(1+e^x), clamp at x>15 (all2all.py:298-317)
+  f'(y) = 1 - e^{-y} (cuda/gradient_descent_relu.cu)
+* strict relu f(x) = max(x, 0), f'(y) = [y > 0]
+  (cuda/gradient_descent_strict_relu.cu)
+* sigmoid f(x) = 1/(1+e^{-x}), f'(y) = y(1-y)
+  (cuda/gradient_descent_sigmoid.cu)
 
 All derivatives are functions of the OUTPUT y, matching the reference's
 ``err_y_update`` kernels so backward units need only the forward's output.

@@ -11,7 +11,6 @@ Math parity (reference kohonen.py:473-496):
   W += sum_i gravity_i[:, None] * (x_i - W) * gmult
 """
 
-from functools import partial
 
 import numpy
 import jax

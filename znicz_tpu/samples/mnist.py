@@ -44,12 +44,14 @@ root.mnistr_conv.update({
     "layers": [
         {"name": "conv1", "type": "conv",
          "->": {"n_kernels": 64, "kx": 5, "ky": 5, "sliding": (1, 1),
-                "weights_filling": "uniform", "weights_stddev": 0.0944569801138958,
+                "weights_filling": "uniform",
+                "weights_stddev": 0.0944569801138958,
                 "bias_filling": "constant", "bias_stddev": 0.048000},
          "<-": {"learning_rate": 0.03, "learning_rate_bias": 0.358000,
                 "gradient_moment": 0.36508255921752014,
                 "gradient_moment_bias": 0.385000,
-                "weights_decay": 0.0005, "weights_decay_bias": 0.1980997902551238,
+                "weights_decay": 0.0005,
+                "weights_decay_bias": 0.1980997902551238,
                 "factor_ortho": 0.001}},
         {"name": "pool1", "type": "max_pooling",
          "->": {"kx": 2, "ky": 2, "sliding": (2, 2)}},

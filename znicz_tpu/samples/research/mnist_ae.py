@@ -8,7 +8,6 @@ Deconv SHARING the conv's weights (output shaped from the conv's input)
 the only trained gradient unit.  Published baseline MSE 0.5478/0.5482
 (BASELINE.md)."""
 
-import numpy
 
 from znicz_tpu.core.config import root
 from znicz_tpu.units import nn_units

@@ -15,7 +15,6 @@ from znicz_tpu.core.units import Unit
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.workflow import NoMoreJobs
 from znicz_tpu.loader.base import TEST, VALID, TRAIN, CLASS_NAME
-from znicz_tpu.units.evaluator import IResultProvider
 
 
 def nvl(value, default):

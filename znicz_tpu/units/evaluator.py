@@ -11,7 +11,6 @@ import numpy
 
 from znicz_tpu.core.accelerated_units import AcceleratedUnit
 from znicz_tpu.core.memory import Array
-from znicz_tpu.core.mutable import Bool
 from znicz_tpu.ops import evaluator as ev_ops
 
 

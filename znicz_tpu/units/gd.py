@@ -10,8 +10,6 @@ algebra (:mod:`znicz_tpu.ops.gd_math`) with pluggable solvers
 On the jax path all four stages are jitted and stay device-resident.
 """
 
-import jax.numpy as jnp
-import numpy
 
 from znicz_tpu.units.nn_units import (
     GradientDescentBase, GradientDescentWithActivation)

@@ -24,7 +24,6 @@ import numpy
 
 from znicz_tpu.core.accelerated_units import (
     AcceleratedUnit, AcceleratedWorkflow)
-from znicz_tpu.core.backends import NumpyDevice
 from znicz_tpu.core.distributable import IDistributable
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core import prng
