@@ -54,3 +54,70 @@ def test_cifar_caffe_topology_trains():
     mb = LOADER_CFG["minibatch_size"]
     assert shapes[0] == (mb, 32, 32, 32)     # conv1
     assert shapes[-1] == (mb, 10)            # softmax head
+
+
+def test_cifar_mlp_variant():
+    """cifar_config MLP: all2all + sincos stack (baseline 45.80%)."""
+    from znicz_tpu.samples import cifar
+    wf = cifar.build_variant(
+        "mlp",
+        loader_config={"synthetic_train": 60, "synthetic_valid": 30,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 3, "fail_iterations": 10})
+    wf.initialize()
+    wf.run()
+    types = [type(f).__name__ for f in wf.forwards]
+    assert types.count("ForwardSinCos") == 2
+    assert wf.decision.epoch_number >= 3
+
+
+def test_cifar_nin_variant():
+    """cifar_nin_config: 5x5 + 1x1 mlpconv stages, global avg pool
+    (baseline 9.09%)."""
+    from znicz_tpu.samples import cifar
+    wf = cifar.build_variant(
+        "nin",
+        loader_config={"synthetic_train": 30, "synthetic_valid": 10,
+                       "minibatch_size": 10},
+        decision_config={"max_epochs": 1, "fail_iterations": 5})
+    wf.initialize()
+    # 9 convs incl. the 1x1 stages; final avg pool is global (8x8)
+    convs = [f for f in wf.forwards if type(f).__name__ == "Conv"]
+    assert len(convs) == 9
+    assert sum(1 for c in convs if c.kx == 1) == 6
+    wf.run()
+    assert wf.decision.epoch_number >= 1
+
+
+def test_mnist_caffe_variant():
+    """mnist_caffe_config LeNet (baseline 0.80%): trains and the error
+    decreases."""
+    from znicz_tpu.core.config import root
+    from znicz_tpu.samples import mnist
+    wf = mnist.build(
+        layers=root.mnistr_caffe.layers,
+        loader_config={"synthetic_train": 120, "synthetic_valid": 60,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 8, "fail_iterations": 20})
+    wf.initialize()
+    wf.run()
+    assert wf.decision.best_n_err_pt[1] < 80.0  # improving from ~90%
+
+
+def test_run_profiled_writes_trace(tmp_path):
+    """Workflow.run_profiled captures an XLA trace (SURVEY.md 5.1)."""
+    import os
+    from znicz_tpu.core.config import root
+    from znicz_tpu.samples import wine
+    saved = root.wine.decision.max_epochs
+    root.wine.decision.max_epochs = 2
+    try:
+        wf = wine.WineWorkflow()
+        wf.initialize()
+        wf.run_profiled(str(tmp_path / "trace"))
+    finally:
+        root.wine.decision.max_epochs = saved
+    found = []
+    for dirpath, _, files in os.walk(str(tmp_path / "trace")):
+        found.extend(files)
+    assert found, "no profiler artifacts written"

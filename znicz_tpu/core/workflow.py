@@ -206,6 +206,22 @@ class Workflow(Unit):
     def run_is_blocked(self):
         return False
 
+    def run_profiled(self, log_dir):
+        """Run under the JAX/XLA profiler: device traces land in
+        ``log_dir`` (view with xprof/tensorboard).  The TPU-era
+        replacement for the reference's per-kernel GPU profiling
+        (SURVEY.md §5.1) — pair with :meth:`log_unit_timings` for the
+        host-side view."""
+        import jax
+        import jax.numpy as jnp
+        with jax.profiler.trace(str(log_dir)):
+            result = self.run()
+            # drain the device queue before the trace closes: dispatch
+            # is async and per-device program-ordered, so blocking on a
+            # trailing no-op covers all in-flight work
+            jax.block_until_ready(jnp.zeros(()) + 0)
+        return result
+
     # -- per-unit timing stats (reference nn_units.py:217-239) ---------------
     def unit_timings(self):
         """[(unit, total_seconds, run_count)] sorted by total time desc —

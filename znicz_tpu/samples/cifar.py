@@ -83,9 +83,16 @@ root.cifar.update({
 class CifarWorkflow(StandardWorkflow):
     """(reference samples/CIFAR10/cifar.py:69-104)"""
 
+    def __init__(self, workflow=None, **kwargs):
+        # consumed by create_workflow(), which super().__init__ calls
+        self.lr_adjuster_cfg = kwargs.pop("lr_adjuster_config", None)
+        super(CifarWorkflow, self).__init__(workflow, **kwargs)
+
     def create_workflow(self):
         super(CifarWorkflow, self).create_workflow()
-        adj_cfg = root.cifar.lr_adjuster.as_dict()
+        adj_cfg = dict(self.lr_adjuster_cfg
+                       if self.lr_adjuster_cfg is not None
+                       else root.cifar.lr_adjuster.as_dict())
         if adj_cfg.pop("do", False):
             # schedule applies per minibatch before the GD units fire
             self.link_lr_adjuster(self.snapshotter, **adj_cfg)
@@ -94,19 +101,22 @@ class CifarWorkflow(StandardWorkflow):
             self.gds[-1].link_from(self.lr_adjuster)
 
 
-def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+def build(layers=None, loader_config=None, decision_config=None,
+          snapshotter_config=None, **kwargs):
     cfg = root.cifar
     loader_cfg = cfg.loader.as_dict()
     loader_cfg.update(loader_config or {})
     decision_cfg = cfg.decision.as_dict()
     decision_cfg.update(decision_config or {})
+    snap_cfg = cfg.snapshotter.as_dict()
+    snap_cfg.update(snapshotter_config or {})
     kwargs.setdefault("loss_function", cfg.loss_function)
     return CifarWorkflow(
         layers=layers if layers is not None else cfg.layers,
         loader_name=cfg.loader_name,
         loader_config=loader_cfg,
         decision_config=decision_cfg,
-        snapshotter_config=cfg.snapshotter.as_dict(),
+        snapshotter_config=snap_cfg,
         **kwargs)
 
 
@@ -126,3 +136,95 @@ def run(load, main):
     """Launcher contract (reference samples/CIFAR10/cifar.py run())."""
     load(build)
     main()
+
+
+#: CIFAR-10 MLP (reference cifar_config.py: all2all 486 -> sincos x2 ->
+#: softmax; baseline 45.80% val err)
+root.cifar_mlp.update({
+    "layers": [
+        {"name": "fc_linear1", "type": "all2all",
+         "->": {"output_sample_shape": 486},
+         "<-": {"learning_rate": 0.0005, "weights_decay": 0.0}},
+        {"name": "sincos1", "type": "activation_sincos"},
+        {"name": "fc_linear2", "type": "all2all",
+         "->": {"output_sample_shape": 486},
+         "<-": {"learning_rate": 0.0005, "weights_decay": 0.0}},
+        {"name": "sincos2", "type": "activation_sincos"},
+        {"name": "fc_softmax3", "type": "softmax",
+         "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.0005, "weights_decay": 0.0}}],
+})
+
+
+def _nin_conv(name, n_kernels, k, padding=(0, 0, 0, 0), stddev=0.05):
+    return {"name": name, "type": "conv",
+            "->": {"n_kernels": n_kernels, "kx": k, "ky": k,
+                   "padding": padding, "sliding": (1, 1),
+                   "weights_filling": "gaussian",
+                   "weights_stddev": stddev,
+                   "bias_filling": "constant", "bias_stddev": 0},
+            "<-": {"learning_rate": 0.01, "learning_rate_bias": 0.02,
+                   "weights_decay": 0.0001, "weights_decay_bias": 0,
+                   "gradient_moment": 0.9, "gradient_moment_bias": 0.9}}
+
+
+#: CIFAR-10 Network-in-Network (reference cifar_nin_config.py: 5x5 convs
+#: followed by 1x1 "mlpconv" stages, str activations, global avg pool;
+#: baseline 9.09% val err)
+root.cifar_nin.update({
+    "layers": [
+        _nin_conv("conv1", 192, 5, (2, 2, 2, 2)),
+        {"name": "relu1", "type": "activation_str"},
+        _nin_conv("conv2", 160, 1),
+        {"name": "relu2", "type": "activation_str"},
+        _nin_conv("conv3", 96, 1),
+        {"name": "relu3", "type": "activation_str"},
+        {"name": "pool3", "type": "max_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "drop3", "type": "dropout", "dropout_ratio": 0.5},
+        _nin_conv("conv4", 192, 5, (2, 2, 2, 2)),
+        {"name": "relu4", "type": "activation_str"},
+        _nin_conv("conv5", 192, 1),
+        {"name": "relu5", "type": "activation_str"},
+        _nin_conv("conv6", 192, 1),
+        {"name": "relu6", "type": "activation_str"},
+        {"name": "pool6", "type": "avg_pooling",
+         "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"name": "drop6", "type": "dropout", "dropout_ratio": 0.5},
+        _nin_conv("conv7", 192, 3, (1, 1, 1, 1)),
+        {"name": "relu7", "type": "activation_str"},
+        _nin_conv("conv8", 192, 1),
+        {"name": "relu8", "type": "activation_str"},
+        _nin_conv("conv9", 10, 1),
+        {"name": "relu9", "type": "activation_str"},
+        {"name": "pool9", "type": "avg_pooling",
+         "->": {"kx": 8, "ky": 8, "sliding": (1, 1)}},
+        {"name": "fc_softmax10", "type": "softmax",
+         "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.0001,
+                "gradient_moment": 0.9}}],
+})
+
+VARIANT_LAYERS = {
+    "caffe": None,            # the default root.cifar.layers
+    "mlp": "cifar_mlp",
+    "nin": "cifar_nin",
+}
+
+
+def build_variant(variant, **kwargs):
+    """Build one of the reference's three CIFAR-10 configs:
+    ``caffe`` (cifar_caffe_config, 17.21%), ``mlp`` (cifar_config,
+    45.80%), ``nin`` (cifar_nin_config, 9.09%)."""
+    ns = VARIANT_LAYERS[variant]
+    if ns is not None and "layers" not in kwargs:
+        kwargs["layers"] = getattr(root, ns).layers
+    if variant != "caffe":
+        # the arbitrary_step schedule and the snapshot prefix belong to
+        # the caffe config only (reference cifar_config/cifar_nin_config
+        # have neither)
+        kwargs.setdefault("lr_adjuster_config", {"do": False})
+        snap = dict(kwargs.get("snapshotter_config") or {})
+        snap.setdefault("prefix", "cifar_" + variant)
+        kwargs["snapshotter_config"] = snap
+    return build(**kwargs)

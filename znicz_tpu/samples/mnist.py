@@ -83,6 +83,39 @@ root.mnistr_conv.update({
 })
 
 
+#: LeNet-caffe variant (reference mnist_caffe_config.py: conv 20C5 ->
+#: MP2 -> conv 50C5 -> MP2 -> fc_relu 500 -> softmax 10; baseline
+#: 0.80% val err)
+_CAFFE_BWD = {"learning_rate": 0.01, "learning_rate_bias": 0.02,
+              "weights_decay": 0.0005, "weights_decay_bias": 0,
+              "gradient_moment": 0.9, "gradient_moment_bias": 0.9}
+root.mnistr_caffe.update({
+    "layers": [
+        {"name": "conv1", "type": "conv",
+         "->": {"n_kernels": 20, "kx": 5, "ky": 5, "sliding": (1, 1),
+                "weights_filling": "uniform",
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": dict(_CAFFE_BWD)},
+        {"name": "pool1", "type": "max_pooling",
+         "->": {"kx": 2, "ky": 2}},
+        {"name": "conv2", "type": "conv",
+         "->": {"n_kernels": 50, "kx": 5, "ky": 5, "sliding": (1, 1),
+                "weights_filling": "uniform",
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": dict(_CAFFE_BWD)},
+        {"name": "pool2", "type": "max_pooling",
+         "->": {"kx": 2, "ky": 2}},
+        {"name": "fc_relu3", "type": "all2all_relu",
+         "->": {"output_sample_shape": 500, "weights_filling": "uniform",
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": dict(_CAFFE_BWD)},
+        {"name": "fc_softmax4", "type": "softmax",
+         "->": {"output_sample_shape": 10, "weights_filling": "uniform",
+                "bias_filling": "constant", "bias_stddev": 0},
+         "<-": dict(_CAFFE_BWD)}],
+})
+
+
 class MnistWorkflow(StandardWorkflow):
     """Model created for digits recognition (reference mnist.py:54)."""
 
@@ -106,9 +139,13 @@ def build(layers=None, loader_config=None, decision_config=None,
         **kwargs)
 
 
-def run_sample(device=None, conv=False, **kwargs):
+def run_sample(device=None, conv=False, caffe=False, **kwargs):
+    if conv and caffe:
+        raise ValueError("pick ONE of conv=True / caffe=True")
     if conv and "layers" not in kwargs:
         kwargs["layers"] = root.mnistr_conv.layers
+    if caffe and "layers" not in kwargs:
+        kwargs["layers"] = root.mnistr_caffe.layers
     wf = build(**kwargs)
     wf.initialize(device=device)
     wf.run()
