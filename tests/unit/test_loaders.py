@@ -285,3 +285,112 @@ def test_file_list_image_loader_with_scale(tmp_path):
     assert loader.class_lengths == [0, 0, 4]
     assert loader.original_data.shape == (4, 6, 6, 3)
     assert sorted(set(loader.original_labels)) == [0, 1]
+
+
+def test_pickles_image_loader(tmp_path):
+    """PicklesImageFullBatchLoader: CIFAR-dict and raw-array pickles,
+    CHW -> NHWC reshape, per-file labels for unlabeled pickles."""
+    import pickle as _pickle
+    from znicz_tpu.loader.pickles import PicklesImageFullBatchLoader
+
+    r = numpy.random.RandomState(3)
+    # CIFAR-style dict batch (flat rows + labels)
+    train = {b"data": r.randint(0, 256, (20, 3 * 8 * 8), numpy.uint8),
+             b"labels": list(numpy.arange(20) % 4)}
+    p_train = tmp_path / "data_batch_1"
+    with open(p_train, "wb") as f:
+        _pickle.dump(train, f)
+    # raw array batch, unlabeled -> gets a per-file label
+    valid = r.randint(0, 256, (6, 3 * 8 * 8)).astype(numpy.uint8)
+    p_valid = tmp_path / "valid_batch"
+    with open(p_valid, "wb") as f:
+        _pickle.dump(valid, f)
+
+    ldr = PicklesImageFullBatchLoader(
+        None, train_pickles=[str(p_train)],
+        validation_pickles=[str(p_valid)],
+        image_shape=(3, 8, 8), minibatch_size=5)
+    ldr.initialize()
+    assert ldr.class_lengths == [0, 6, 20]
+    assert ldr.original_data.shape == (26, 8, 8, 3)
+    # CHW->HWC round trip of the first validation image
+    want = valid[0].reshape(3, 8, 8).transpose(1, 2, 0)
+    assert numpy.array_equal(ldr.original_data.mem[0], want)
+    ldr.run()
+    assert int(ldr.minibatch_size) == 5
+
+
+def test_interactive_loader_drives_forward_workflow():
+    """InteractiveLoader feeds a forward-only workflow one queue at a
+    time (reference AlexNet forward service pattern)."""
+    from znicz_tpu.core.workflow import DummyWorkflow
+    from znicz_tpu.loader.interactive import InteractiveLoader
+    from znicz_tpu.units.all2all import All2AllTanh
+    from znicz_tpu.core import prng
+
+    w = DummyWorkflow()
+    loader = InteractiveLoader(w, sample_shape=(4,), minibatch_size=2)
+    loader.initialize()
+    fwd = All2AllTanh(w, output_sample_shape=3,
+                      weights_stddev=0.05, bias_stddev=0.05,
+                      rand=prng.RandomGenerator().seed(5))
+    fwd.input = loader.minibatch_data
+    fwd.initialize()
+
+    r = numpy.random.RandomState(0)
+    for _ in range(3):
+        loader.feed(r.uniform(-1, 1, 4))
+    loader.finish()
+
+    outs = []
+    while not bool(loader.complete):
+        loader.run()
+        fwd.run()
+        fwd.output.map_read()
+        outs.append(numpy.array(
+            fwd.output.mem[:int(loader.minibatch_size)]))
+    got = numpy.concatenate(outs, axis=0)
+    assert got.shape == (3, 3)
+    assert bool(loader.epoch_ended)
+    # empty queue without finish() is an error
+    l2 = InteractiveLoader(None, sample_shape=(4,))
+    l2.initialize()
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        l2.run()
+
+
+def test_pickles_and_interactive_registered():
+    import znicz_tpu.loader  # noqa: F401 (registration side effects)
+    from znicz_tpu.loader.base import UserLoaderRegistry
+    from znicz_tpu.loader.pickles import PicklesImageFullBatchLoader
+    from znicz_tpu.loader.interactive import InteractiveLoader
+    assert UserLoaderRegistry.get_factory(
+        "full_batch_pickles_image") is PicklesImageFullBatchLoader
+    assert UserLoaderRegistry.get_factory(
+        "interactive") is InteractiveLoader
+    assert UserLoaderRegistry.get_factory("minibatches")
+
+
+def test_pickles_per_split_fallback_labels(tmp_path):
+    """Unlabeled per-file labels restart per split so position means
+    the same class in train and valid (review regression)."""
+    import pickle as _pickle
+    from znicz_tpu.loader.pickles import PicklesImageFullBatchLoader
+    r = numpy.random.RandomState(1)
+
+    def dump(name):
+        p = tmp_path / name
+        with open(p, "wb") as f:
+            _pickle.dump(r.randint(0, 256, (4, 3 * 8 * 8)).astype(
+                numpy.uint8), f)
+        return str(p)
+
+    ldr = PicklesImageFullBatchLoader(
+        None, validation_pickles=[dump("cat_v"), dump("dog_v")],
+        train_pickles=[dump("cat_t"), dump("dog_t")],
+        image_shape=(3, 8, 8), minibatch_size=4)
+    ldr.initialize()
+    labels = list(ldr.original_labels)
+    # [VALID cat=0 x4, dog=1 x4 | TRAIN cat=0 x4, dog=1 x4]
+    assert labels == [0] * 4 + [1] * 4 + [0] * 4 + [1] * 4

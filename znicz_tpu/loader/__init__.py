@@ -17,3 +17,6 @@ from znicz_tpu.loader.image import (  # noqa: F401
 import znicz_tpu.loader.loader_lmdb  # noqa: F401
 import znicz_tpu.loader.loader_stl  # noqa: F401
 import znicz_tpu.loader.imagenet_loader  # noqa: F401
+import znicz_tpu.loader.pickles  # noqa: F401
+import znicz_tpu.loader.interactive  # noqa: F401
+import znicz_tpu.loader.saver  # noqa: F401

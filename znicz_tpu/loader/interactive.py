@@ -1,0 +1,100 @@
+"""Interactive loader — feed samples one at a time.
+
+TPU-era equivalent of the veles-core ``loader.interactive.
+InteractiveLoader`` (used by the reference's AlexNet forward service,
+tests/research/AlexNet/imagenet_workflow.py:131): an inference workflow
+pulls minibatches from a host-side queue filled by ``feed()`` calls —
+the serving-time counterpart of the file loaders.
+
+Usage::
+
+    loader = InteractiveLoader(wf, sample_shape=(28, 28, 1))
+    loader.feed(img1); loader.feed(img2)
+    loader.finish()           # no more samples; epoch ends when drained
+    wf.run()                  # forward workflow consumes the queue
+"""
+
+import collections
+
+import numpy
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core.units import Unit
+from znicz_tpu.loader.base import TEST, UserLoaderRegistry
+
+
+class InteractiveLoader(Unit):
+    """Loader-contract unit backed by a host queue (class TEST)."""
+
+    MAPPING = "interactive"
+
+    def __init__(self, workflow, **kwargs):
+        super(InteractiveLoader, self).__init__(workflow, **kwargs)
+        self.sample_shape = tuple(kwargs["sample_shape"])
+        self.max_minibatch_size = int(kwargs.get("minibatch_size", 1))
+        self.minibatch_data = Array(name="minibatch_data")
+        self.minibatch_labels = Array(name="minibatch_labels")
+        self.minibatch_size = 0
+        self.minibatch_class = TEST
+        self.minibatch_offset = 0
+        self.epoch_number = 0
+        self.epoch_ended = Bool(False)
+        self.last_minibatch = Bool(False)
+        self.train_ended = Bool(False)
+        self.complete = Bool(False)
+        self.class_lengths = [0, 0, 0]
+        self._queue = collections.deque()
+        self._finished = False
+        self._served = 0
+
+    def initialize(self, device=None, **kwargs):
+        super(InteractiveLoader, self).initialize(device=device, **kwargs)
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape,
+            numpy.float32))
+        self.minibatch_labels.reset(numpy.zeros(
+            self.max_minibatch_size, numpy.int32))
+
+    # -- producer side ------------------------------------------------------
+    def feed(self, sample, label=-1):
+        """Queue one sample (host array shaped ``sample_shape``)."""
+        sample = numpy.asarray(sample, numpy.float32)
+        if tuple(sample.shape) != self.sample_shape:
+            raise ValueError("sample shape %s != %s"
+                             % (sample.shape, self.sample_shape))
+        self._queue.append((sample, int(label)))
+
+    def finish(self):
+        """No further samples: the current epoch ends once drained."""
+        self._finished = True
+
+    # -- consumer side ------------------------------------------------------
+    def run(self):
+        n = min(len(self._queue), self.max_minibatch_size)
+        if n == 0 and not self._finished:
+            raise RuntimeError(
+                "InteractiveLoader ran with an empty queue — feed() "
+                "samples or finish() before running the workflow")
+        self.minibatch_data.map_invalidate()
+        self.minibatch_labels.map_write()
+        for i in range(n):
+            sample, label = self._queue.popleft()
+            self.minibatch_data.mem[i] = sample
+            self.minibatch_labels.mem[i] = label
+        self.minibatch_size = n
+        self.minibatch_offset = self._served + n
+        self._served += n
+        self.class_lengths[TEST] = self._served
+        drained = self._finished and not self._queue
+        self.last_minibatch <<= drained
+        self.epoch_ended <<= drained
+        self.train_ended <<= drained
+        self.complete <<= drained
+        if drained:
+            self.epoch_number += 1
+
+
+# Unit-based (not a Loader subclass), so the metaclass registration does
+# not fire — register the type string explicitly for loader_name use
+UserLoaderRegistry.loaders[InteractiveLoader.MAPPING] = InteractiveLoader
