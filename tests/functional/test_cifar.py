@@ -92,8 +92,11 @@ def test_cifar_nin_variant():
 def test_mnist_caffe_variant():
     """mnist_caffe_config LeNet (baseline 0.80%): trains and the error
     decreases."""
+    from znicz_tpu.core import prng
     from znicz_tpu.core.config import root
     from znicz_tpu.samples import mnist
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
     wf = mnist.build(
         layers=root.mnistr_caffe.layers,
         loader_config={"synthetic_train": 120, "synthetic_valid": 60,

@@ -110,3 +110,19 @@ def test_cli_process_end_to_end(tmp_path):
     # import-time default 100)
     assert "Epoch 2" in out.stderr or "Epoch 2" in out.stdout
     assert "Epoch 5" not in out.stderr and "Epoch 5" not in out.stdout
+
+
+def test_dump_graph(tmp_path):
+    """--dump-graph writes a DOT file of the control graph."""
+    out = subprocess.run(
+        [sys.executable, "-m", "znicz_tpu", "wine",
+         "--dump-graph", str(tmp_path / "g.dot")],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT,
+                 HOME=str(tmp_path)),
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    dot = (tmp_path / "g.dot").read_text()
+    assert dot.startswith("digraph")
+    assert "loader" in dot and "decision" in dot
+    assert "->" in dot

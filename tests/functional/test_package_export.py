@@ -140,3 +140,119 @@ def test_cpp_unit_tests_pass():
     res = subprocess.run([os.path.join(build, "test_units")],
                          capture_output=True, text=True, timeout=60)
     assert res.returncode == 0, res.stderr
+
+
+def test_train_extract_serve_pipeline(tmp_path):
+    """Full serving path: train -> extract_forward_workflow with an
+    InteractiveLoader -> feed live samples -> predictions match the
+    training workflow's forward output."""
+    import numpy
+    from znicz_tpu.core import prng
+    from znicz_tpu.loader.interactive import InteractiveLoader
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 12},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 5, "fail_iterations": 20},
+        snapshotter_config={"prefix": "serve", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.initialize()
+    wf.run()
+
+    served = []
+
+    def loader_factory(fwd_wf, **kwargs):
+        ldr = InteractiveLoader(fwd_wf, sample_shape=(13,),
+                                minibatch_size=4)
+        served.append(ldr)
+        return ldr
+
+    fwd_wf = wf.extract_forward_workflow(loader_factory=loader_factory)
+    fwd_wf.initialize()
+    ldr = served[0]
+    r = numpy.random.RandomState(0)
+    samples = r.uniform(-1, 1, (6, 13)).astype(numpy.float32)
+    for s in samples:
+        ldr.feed(s)
+    ldr.finish()
+    fwd_wf.run()
+
+    # weights really were copied: match a direct numpy forward with the
+    # TRAINER's weights
+    w0 = numpy.array(wf.forwards[0].weights.mem)
+    b0 = numpy.array(wf.forwards[0].bias.mem)
+    w1 = numpy.array(wf.forwards[1].weights.mem)
+    b1 = numpy.array(wf.forwards[1].bias.mem)
+    h = 1.7159 * numpy.tanh(0.6666 * (samples @ w0.T + b0))
+    logits = h @ w1.T + b1
+    e = numpy.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    fwd_wf.forwards[-1].output.map_read()
+    got = numpy.array(fwd_wf.forwards[-1].output.mem[:ldr.minibatch_size])
+    # the serving loader batches by 4: the LAST minibatch holds samples
+    # 4..5
+    assert numpy.abs(got[:2] - want[4:6]).max() < 1e-5
+
+
+def test_serving_workflow_is_reusable(tmp_path):
+    """A second feed()+run() session serves NEW predictions (review
+    regression: gates must re-arm, not latch)."""
+    import numpy
+    from znicz_tpu.loader.interactive import InteractiveLoader
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 2, "fail_iterations": 20},
+        snapshotter_config={"prefix": "reuse", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.initialize()
+    wf.run()
+
+    holder = []
+
+    def loader_factory(fwd_wf, **kwargs):
+        ldr = InteractiveLoader(fwd_wf, sample_shape=(13,),
+                                minibatch_size=4)
+        holder.append(ldr)
+        return ldr
+
+    fwd_wf = wf.extract_forward_workflow(loader_factory=loader_factory)
+    fwd_wf.initialize()
+    ldr = holder[0]
+    r = numpy.random.RandomState(1)
+
+    def serve(batch):
+        for s in batch:
+            ldr.feed(s)
+        ldr.finish()
+        fwd_wf.run()
+        fwd_wf.forwards[-1].output.map_read()
+        return numpy.array(
+            fwd_wf.forwards[-1].output.mem[:int(ldr.minibatch_size)])
+
+    a = serve(r.uniform(-1, 1, (2, 13)).astype(numpy.float32))
+    b = serve(r.uniform(-1, 1, (2, 13)).astype(numpy.float32))
+    assert a.shape == (2, 3) and b.shape == (2, 3)
+    assert numpy.abs(a - b).max() > 1e-9  # fresh outputs, not stale
+    assert len(ldr._queue) == 0

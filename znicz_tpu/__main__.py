@@ -52,6 +52,10 @@ def main(argv=None):
                         help="forward-only run (reference --test)")
     parser.add_argument("--dry-run", action="store_true",
                         help="build + initialize only")
+    parser.add_argument("--dump-graph", metavar="FILE.dot",
+                        help="write the workflow control graph as DOT; "
+                             "skips training unless combined with "
+                             "--testing")
     parser.add_argument("--list", action="store_true",
                         help="list bundled samples and exit")
     args = parser.parse_args(argv)
@@ -68,8 +72,11 @@ def main(argv=None):
     module = resolve_workflow_module(args.workflow)
     for assignment in args.config:
         apply_override(root, assignment)
+    dry_run = args.dry_run or (bool(args.dump_graph) and not args.testing)
     wf = run_workflow(module, snapshot=args.snapshot,
-                      testing=args.testing, dry_run=args.dry_run)
+                      testing=args.testing, dry_run=dry_run)
+    if args.dump_graph:
+        wf.dump_graph(args.dump_graph)
     decision = getattr(wf, "decision", None)
     if decision is not None and hasattr(decision, "best_n_err_pt"):
         print("best val/train err%%: %s" % (decision.best_n_err_pt,))

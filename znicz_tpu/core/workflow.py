@@ -206,6 +206,31 @@ class Workflow(Unit):
     def run_is_blocked(self):
         return False
 
+    def as_dot(self):
+        """Graphviz DOT text of the control graph (reference: the veles
+        core renders workflow.png the same way).  Solid edges = control
+        links; the box label carries the unit class."""
+        lines = ["digraph %s {" % type(self).__name__,
+                 '  rankdir=TB; node [shape=box, fontsize=10];']
+        ids = {u: "u%d" % i for i, u in enumerate(self._units)}
+        for u in self._units:
+            label = u.name if u.name == type(u).__name__ else \
+                "%s\\n(%s)" % (u.name, type(u).__name__)
+            lines.append('  %s [label="%s"];' % (ids[u], label))
+        for u in self._units:
+            for child in u.links_to:
+                if child in ids:
+                    lines.append("  %s -> %s;" % (ids[u], ids[child]))
+        lines.append("}")
+        return "\n".join(lines)
+
+    def dump_graph(self, path):
+        """Write the DOT graph to ``path`` (render with graphviz)."""
+        with open(path, "w") as f:
+            f.write(self.as_dot())
+        self.info("workflow graph -> %s", path)
+        return path
+
     def run_profiled(self, log_dir):
         """Run under the JAX/XLA profiler: device traces land in
         ``log_dir`` (view with xprof/tensorboard).  The TPU-era

@@ -33,6 +33,10 @@ class InteractiveLoader(Unit):
         super(InteractiveLoader, self).__init__(workflow, **kwargs)
         self.sample_shape = tuple(kwargs["sample_shape"])
         self.max_minibatch_size = int(kwargs.get("minibatch_size", 1))
+        #: number of classes served (0 = unknown: the softmax-width
+        #: auto-set hook then keeps the configured width)
+        self.unique_labels_count = int(
+            kwargs.get("unique_labels_count", 0))
         self.minibatch_data = Array(name="minibatch_data")
         self.minibatch_labels = Array(name="minibatch_labels")
         self.minibatch_size = 0
@@ -44,6 +48,9 @@ class InteractiveLoader(Unit):
         self.train_ended = Bool(False)
         self.complete = Bool(False)
         self.class_lengths = [0, 0, 0]
+        #: post-initialize hook (same contract as Loader.on_initialized —
+        #: StandardWorkflowBase uses it to auto-set the softmax width)
+        self.on_initialized = None
         self._queue = collections.deque()
         self._finished = False
         self._served = 0
@@ -55,14 +62,25 @@ class InteractiveLoader(Unit):
             numpy.float32))
         self.minibatch_labels.reset(numpy.zeros(
             self.max_minibatch_size, numpy.int32))
+        if self.on_initialized is not None:
+            self.on_initialized()
 
     # -- producer side ------------------------------------------------------
     def feed(self, sample, label=-1):
-        """Queue one sample (host array shaped ``sample_shape``)."""
+        """Queue one sample (host array shaped ``sample_shape``).
+
+        Feeding after a drained session re-arms the loader: complete /
+        epoch flags clear so the serving workflow can run() again."""
         sample = numpy.asarray(sample, numpy.float32)
         if tuple(sample.shape) != self.sample_shape:
             raise ValueError("sample shape %s != %s"
                              % (sample.shape, self.sample_shape))
+        if self._finished:
+            self._finished = False
+            self.complete <<= False
+            self.epoch_ended <<= False
+            self.last_minibatch <<= False
+            self.train_ended <<= False
         self._queue.append((sample, int(label)))
 
     def finish(self):

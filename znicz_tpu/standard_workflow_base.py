@@ -205,6 +205,10 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
 
             def on_initialized():
                 ulc = loader.unique_labels_count
+                if not ulc:
+                    # label-less serving loaders (InteractiveLoader)
+                    # keep the configured width
+                    return
                 oss = last_fwd.output_sample_shape
                 if oss != tuple() and numpy.prod(oss) != ulc:
                     self.warning(
@@ -268,7 +272,26 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
         return self.end_point
 
     def create_workflow(self):
+        """Forward-only graph: loop the loader until one full epoch was
+        served — or until the loader reports ``complete`` (e.g. an
+        InteractiveLoader's drained queue).  The reference forward
+        workflows run the whole set the same way (mnist_forward.py)."""
         self.link_repeater(self.start_point)
         self.link_loader(self.repeater)
         self.link_forwards(("input", "minibatch_data"), self.loader)
-        self.end_point.gate_block = ~self.loader.complete
+        done = self.loader.complete | self.loader.epoch_ended
+        self.link_end_point(self.forwards[-1])
+        self.end_point.gate_block = ~done
+        self.loader.gate_block = done
+
+    def run(self):
+        """Re-arm the per-epoch serving gates before each run, so a
+        forward workflow is REUSABLE: without this, a latched
+        epoch_ended would gate the loader off forever and a second
+        run() would silently serve stale outputs."""
+        loader = getattr(self, "loader", None)
+        for attr in ("epoch_ended", "last_minibatch"):
+            b = getattr(loader, attr, None)
+            if b is not None and getattr(b, "_expr", True) is None:
+                b <<= False
+        return super(StandardWorkflowBase, self).run()
