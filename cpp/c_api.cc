@@ -2,6 +2,7 @@
 // not in the image, so the boundary is a plain C ABI).
 #include <cstring>
 #include <string>
+#include <vector>
 
 #include "workflow.h"
 
@@ -13,8 +14,15 @@ void* znicz_load(const char* package_path);
 
 // Runs forward on (batch, sample_size) float32 input; writes
 // (batch, output_size) float32 to out.  Returns output_size, or -1.
+// FC packages only — spatial packages need znicz_infer_nhwc.
 int znicz_infer(void* workflow, const float* in, int batch,
                 int sample_size, float* out, int out_capacity);
+
+// Spatial variant: input is (batch, h, w, c) NHWC float32 — required
+// for conv/pooling packages, which thread the sample shape through
+// the layer chain.
+int znicz_infer_nhwc(void* workflow, const float* in, int batch,
+                     int h, int w, int c, float* out, int out_capacity);
 
 void znicz_free(void* workflow);
 const char* znicz_last_error();
@@ -34,14 +42,15 @@ void* znicz_load(const char* package_path) {
   }
 }
 
-int znicz_infer(void* workflow, const float* in, int batch,
-                int sample_size, float* out, int out_capacity) {
+namespace {
+
+int RunInfer(void* workflow, const float* in,
+             std::vector<size_t> shape, float* out, int out_capacity) {
   try {
     auto* wf = static_cast<znicz::Workflow*>(workflow);
     znicz::Tensor x;
-    x.shape = {static_cast<size_t>(batch),
-               static_cast<size_t>(sample_size)};
-    x.data.assign(in, in + static_cast<size_t>(batch) * sample_size);
+    x.shape = std::move(shape);
+    x.data.assign(in, in + x.size());
     znicz::Tensor y;
     wf->Execute(x, &y);
     if (y.data.size() > static_cast<size_t>(out_capacity)) {
@@ -54,6 +63,24 @@ int znicz_infer(void* workflow, const float* in, int batch,
     g_last_error = e.what();
     return -1;
   }
+}
+
+}  // namespace
+
+int znicz_infer(void* workflow, const float* in, int batch,
+                int sample_size, float* out, int out_capacity) {
+  return RunInfer(workflow, in,
+                  {static_cast<size_t>(batch),
+                   static_cast<size_t>(sample_size)},
+                  out, out_capacity);
+}
+
+int znicz_infer_nhwc(void* workflow, const float* in, int batch,
+                     int h, int w, int c, float* out, int out_capacity) {
+  return RunInfer(workflow, in,
+                  {static_cast<size_t>(batch), static_cast<size_t>(h),
+                   static_cast<size_t>(w), static_cast<size_t>(c)},
+                  out, out_capacity);
 }
 
 void znicz_free(void* workflow) {

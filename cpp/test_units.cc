@@ -83,7 +83,73 @@ void TestNpyRoundtrip() {
 
 }  // namespace
 
+
+void TestConvSpatial() {
+  // 1-channel 3x3 input, one 2x2 kernel of ones: valid conv = window sums
+  auto unit = znicz::CreateUnit("conv");
+  unit->SetParameter("weights", T({1, 4}, {1, 1, 1, 1}));
+  unit->SetParameter("kx", T({1}, {2}));
+  unit->SetParameter("ky", T({1}, {2}));
+  unit->SetParameter("n_kernels", T({1}, {1}));
+  unit->SetParameter("include_bias", T({1}, {0}));
+  auto shape = unit->Configure({3, 3, 1});
+  if (shape != znicz::Shape({2, 2, 1})) {
+    fprintf(stderr, "FAIL conv shape\n");
+    ++g_failures;
+  }
+  znicz::Tensor out;
+  unit->Execute(T({1, 9}, {1, 2, 3, 4, 5, 6, 7, 8, 9}), &out);
+  CHECK_NEAR(out.data[0], 12.f, 1e-6);  // 1+2+4+5
+  CHECK_NEAR(out.data[3], 28.f, 1e-6);  // 5+6+8+9
+}
+
+void TestPoolingOverhang() {
+  // kernel larger than the input: ceil-mode truncates to ONE window
+  // (Python ops/pooling.py output_spatial semantics) — must not wrap
+  auto unit = znicz::CreateUnit("max_pooling");
+  unit->SetParameter("kx", T({1}, {3}));
+  unit->SetParameter("ky", T({1}, {3}));
+  unit->SetParameter("sliding", T({2}, {2, 2}));
+  auto shape = unit->Configure({2, 2, 1});
+  if (shape != znicz::Shape({1, 1, 1})) {
+    fprintf(stderr, "FAIL overhang pooling shape\n");
+    ++g_failures;
+    return;
+  }
+  znicz::Tensor out;
+  unit->Execute(T({1, 4}, {1, 7, 3, 5}), &out);
+  CHECK_NEAR(out.data[0], 7.f, 1e-6);
+
+  // normal ceil-mode overhang: 5 wide, k=2, stride 2 -> 3 outputs with
+  // the last window truncated
+  auto avg = znicz::CreateUnit("avg_pooling");
+  avg->SetParameter("kx", T({1}, {2}));
+  avg->SetParameter("ky", T({1}, {1}));
+  avg->SetParameter("sliding", T({2}, {2, 1}));
+  auto s2 = avg->Configure({1, 5, 1});
+  if (s2 != znicz::Shape({1, 3, 1})) {
+    fprintf(stderr, "FAIL ceil-mode avg shape\n");
+    ++g_failures;
+    return;
+  }
+  avg->Execute(T({1, 5}, {1, 2, 3, 4, 10}), &out);
+  CHECK_NEAR(out.data[0], 1.5f, 1e-6);
+  CHECK_NEAR(out.data[2], 10.f, 1e-6);  // truncated window: just {10}
+}
+
+void TestTanhLogActivation() {
+  auto unit = znicz::CreateUnit("activation_tanhlog");
+  znicz::Tensor out;
+  unit->Execute(T({1, 2}, {0.5f, 10.f}), &out);
+  CHECK_NEAR(out.data[0], 1.7159f * std::tanh(0.6666f * 0.5f), 1e-5);
+  CHECK_NEAR(out.data[1], std::log(10.f * 305.459953195f) *
+                              0.242528761112f, 1e-4);
+}
+
 int main() {
+  TestConvSpatial();
+  TestPoolingOverhang();
+  TestTanhLogActivation();
   TestLinear();
   TestTransposedWeights();
   TestTanh();

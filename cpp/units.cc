@@ -1,5 +1,6 @@
 #include "units.h"
 
+#include <algorithm>
 #include <cmath>
 #include <stdexcept>
 
@@ -102,6 +103,287 @@ void All2AllSoftmax::Execute(const Tensor& in, Tensor* out) const {
   }
 }
 
+float Unit::Scalar(const std::string& name, float fallback) const {
+  auto it = params_.find(name);
+  if (it == params_.end() || it->second.data.empty()) return fallback;
+  return it->second.data[0];
+}
+
+// -- conv -------------------------------------------------------------------
+
+void Conv::SetParameter(const std::string& name, Tensor value) {
+  if (name == "weights") {
+    weights_ = std::move(value);
+  } else if (name == "bias") {
+    bias_ = std::move(value);
+  } else if (name == "weights_transposed") {
+    weights_transposed_ = !value.data.empty() && value.data[0] != 0.f;
+  } else if (name == "include_bias") {
+    include_bias_ = value.data.empty() || value.data[0] != 0.f;
+  } else if (name == "kx") {
+    kx_ = static_cast<size_t>(value.data.at(0));
+  } else if (name == "ky") {
+    ky_ = static_cast<size_t>(value.data.at(0));
+  } else if (name == "n_kernels") {
+    k_ = static_cast<size_t>(value.data.at(0));
+  } else if (name == "padding") {
+    for (size_t i = 0; i < 4 && i < value.data.size(); ++i)
+      pad_[i] = static_cast<long>(value.data[i]);
+  } else if (name == "sliding") {
+    for (size_t i = 0; i < 2 && i < value.data.size(); ++i)
+      slide_[i] = static_cast<size_t>(value.data[i]);
+  } else {
+    Unit::SetParameter(name, std::move(value));
+  }
+  if (!weights_.data.empty() && weights_transposed_) {
+    // stored (ky*kx*C, n_kernels): transpose once at load time
+    size_t rows = weights_.shape[0], cols = weights_.cols();
+    Tensor t;
+    t.shape = {cols, rows};
+    t.data.resize(weights_.data.size());
+    for (size_t i = 0; i < rows; ++i)
+      for (size_t j = 0; j < cols; ++j)
+        t.data[j * rows + i] = weights_.data[i * cols + j];
+    weights_ = std::move(t);
+    weights_transposed_ = false;
+  }
+}
+
+Shape Conv::Configure(const Shape& in) {
+  if (in.size() != 3)
+    throw std::runtime_error("conv needs (h, w, c) input");
+  h_ = in[0];
+  w_ = in[1];
+  c_ = in[2];
+  if (weights_.cols() != ky_ * kx_ * c_)
+    throw std::runtime_error("conv weights cols mismatch");
+  if (weights_.shape[0] != k_)
+    throw std::runtime_error("conv n_kernels mismatch");
+  // signed arithmetic: kx > padded width must error, not wrap size_t
+  long span_x = pad_[0] + static_cast<long>(w_) + pad_[2] -
+                static_cast<long>(kx_);
+  long span_y = pad_[1] + static_cast<long>(h_) + pad_[3] -
+                static_cast<long>(ky_);
+  if (span_x < 0 || span_y < 0)
+    throw std::runtime_error("conv kernel exceeds padded input");
+  nx_ = static_cast<size_t>(span_x) / slide_[0] + 1;
+  ny_ = static_cast<size_t>(span_y) / slide_[1] + 1;
+  return {ny_, nx_, k_};
+}
+
+void Conv::Execute(const Tensor& in, Tensor* out) const {
+  size_t batch = in.rows();
+  out->shape = {batch, ny_, nx_, k_};
+  out->data.assign(batch * ny_ * nx_ * k_, 0.f);
+  const float* w = weights_.data.data();
+  for (size_t b = 0; b < batch; ++b) {
+    const float* x = in.data.data() + b * h_ * w_ * c_;
+    float* y = out->data.data() + b * ny_ * nx_ * k_;
+    for (size_t oy = 0; oy < ny_; ++oy) {
+      long base_y = static_cast<long>(oy * slide_[1]) - pad_[1];
+      for (size_t ox = 0; ox < nx_; ++ox) {
+        long base_x = static_cast<long>(ox * slide_[0]) - pad_[0];
+        float* yo = y + (oy * nx_ + ox) * k_;
+        for (size_t ik = 0; ik < k_; ++ik) {
+          const float* wk = w + ik * ky_ * kx_ * c_;
+          float acc = include_bias_ && !bias_.data.empty()
+                          ? bias_.data[ik] : 0.f;
+          for (size_t dy = 0; dy < ky_; ++dy) {
+            long yy = base_y + static_cast<long>(dy);
+            if (yy < 0 || yy >= static_cast<long>(h_)) continue;
+            for (size_t dx = 0; dx < kx_; ++dx) {
+              long xx = base_x + static_cast<long>(dx);
+              if (xx < 0 || xx >= static_cast<long>(w_)) continue;
+              const float* xi = x + (yy * w_ + xx) * c_;
+              const float* wi = wk + (dy * kx_ + dx) * c_;
+              for (size_t ci = 0; ci < c_; ++ci) acc += xi[ci] * wi[ci];
+            }
+          }
+          yo[ik] = acc;
+        }
+      }
+    }
+  }
+  ApplyActivation(out->data.data(), out->data.size());
+}
+
+void ConvTanh::ApplyActivation(float* data, size_t n) const {
+  for (size_t i = 0; i < n; ++i)
+    data[i] = 1.7159f * std::tanh(0.6666f * data[i]);
+}
+
+void ConvSigmoid::ApplyActivation(float* data, size_t n) const {
+  for (size_t i = 0; i < n; ++i)
+    data[i] = 1.f / (1.f + std::exp(-data[i]));
+}
+
+void ConvRELU::ApplyActivation(float* data, size_t n) const {
+  for (size_t i = 0; i < n; ++i)
+    data[i] = data[i] > 15.f ? data[i] : std::log1p(std::exp(data[i]));
+}
+
+void ConvStrictRELU::ApplyActivation(float* data, size_t n) const {
+  for (size_t i = 0; i < n; ++i)
+    data[i] = data[i] > 0.f ? data[i] : 0.f;
+}
+
+// -- pooling ----------------------------------------------------------------
+
+void Pooling::SetParameter(const std::string& name, Tensor value) {
+  if (name == "kx") {
+    kx_ = static_cast<size_t>(value.data.at(0));
+  } else if (name == "ky") {
+    ky_ = static_cast<size_t>(value.data.at(0));
+  } else if (name == "sliding") {
+    for (size_t i = 0; i < 2 && i < value.data.size(); ++i)
+      slide_[i] = static_cast<size_t>(value.data[i]);
+  } else {
+    Unit::SetParameter(name, std::move(value));
+  }
+}
+
+Shape Pooling::Configure(const Shape& in) {
+  if (in.size() != 3)
+    throw std::runtime_error("pooling needs (h, w, c) input");
+  h_ = in[0];
+  w_ = in[1];
+  c_ = in[2];
+  if (slide_[0] == 0) slide_[0] = kx_;
+  if (slide_[1] == 0) slide_[1] = ky_;
+  // ceil mode: out = ceil((s - k) / stride) + 1 with SIGNED floor
+  // division (pooling.py:96-105 uses Python's // on a possibly
+  // negative last) — kernels overhanging a smaller input truncate to
+  // one window, they must not wrap size_t
+  auto ceil_out = [](size_t s, size_t k, size_t stride) {
+    long last = static_cast<long>(s) - static_cast<long>(k);
+    long st = static_cast<long>(stride);
+    long q = last / st, r = last % st;
+    if (r != 0 && ((r < 0) != (st < 0))) --q;  // Python floor division
+    long o = q + 1;
+    if (last - q * st != 0) ++o;  // Python: last % stride != 0
+    return static_cast<size_t>(std::max(o, 1l));
+  };
+  ny_ = ceil_out(h_, ky_, slide_[1]);
+  nx_ = ceil_out(w_, kx_, slide_[0]);
+  return {ny_, nx_, c_};
+}
+
+void Pooling::Execute(const Tensor& in, Tensor* out) const {
+  size_t batch = in.rows();
+  out->shape = {batch, ny_, nx_, c_};
+  out->data.assign(batch * ny_ * nx_ * c_, 0.f);
+  for (size_t b = 0; b < batch; ++b) {
+    const float* x = in.data.data() + b * h_ * w_ * c_;
+    float* y = out->data.data() + b * ny_ * nx_ * c_;
+    for (size_t oy = 0; oy < ny_; ++oy) {
+      size_t y0 = oy * slide_[1];
+      size_t cy = std::min(ky_, h_ - y0);  // truncated window height
+      for (size_t ox = 0; ox < nx_; ++ox) {
+        size_t x0 = ox * slide_[0];
+        size_t cx = std::min(kx_, w_ - x0);
+        for (size_t ci = 0; ci < c_; ++ci) {
+          const float* base = x + (y0 * w_ + x0) * c_ + ci;
+          y[(oy * nx_ + ox) * c_ + ci] =
+              Reduce(base, c_, cy, cx, w_ * c_);
+        }
+      }
+    }
+  }
+}
+
+float MaxPooling::Reduce(const float* x, size_t stride, size_t cy,
+                         size_t cx, size_t row_stride) const {
+  float best = x[0];
+  for (size_t dy = 0; dy < cy; ++dy)
+    for (size_t dx = 0; dx < cx; ++dx)
+      best = std::max(best, x[dy * row_stride + dx * stride]);
+  return best;
+}
+
+float AvgPooling::Reduce(const float* x, size_t stride, size_t cy,
+                         size_t cx, size_t row_stride) const {
+  float sum = 0.f;
+  for (size_t dy = 0; dy < cy; ++dy)
+    for (size_t dx = 0; dx < cx; ++dx)
+      sum += x[dy * row_stride + dx * stride];
+  return sum / static_cast<float>(cy * cx);
+}
+
+// -- LRN --------------------------------------------------------------------
+
+Shape LRN::Configure(const Shape& in) {
+  if (in.size() != 3)
+    throw std::runtime_error("LRN needs (h, w, c) input");
+  c_ = in[2];
+  size_ = in[0] * in[1] * in[2];
+  return in;
+}
+
+void LRN::Execute(const Tensor& in, Tensor* out) const {
+  const float alpha = Scalar("alpha", 1e-4f);
+  const float beta = Scalar("beta", 0.75f);
+  const float k = Scalar("k", 2.f);
+  const long n = static_cast<long>(Scalar("n", 5.f));
+  const long half = n / 2;
+  size_t total = in.data.size();
+  size_t pixels = total / c_;
+  out->shape = in.shape;
+  out->data.resize(total);
+  for (size_t p = 0; p < pixels; ++p) {
+    const float* x = in.data.data() + p * c_;
+    float* y = out->data.data() + p * c_;
+    for (long i = 0; i < static_cast<long>(c_); ++i) {
+      long lo = std::max(0l, i - half);
+      long hi = std::min(i + half, static_cast<long>(c_) - 1);
+      float s = 0.f;
+      for (long j = lo; j <= hi; ++j) s += x[j] * x[j];
+      y[i] = x[i] / std::pow(k + alpha * s, beta);
+    }
+  }
+}
+
+// -- activations ------------------------------------------------------------
+
+void Activation::Execute(const Tensor& in, Tensor* out) const {
+  out->shape = in.shape;
+  out->data.resize(in.data.size());
+  const float* x = in.data.data();
+  float* y = out->data.data();
+  size_t n = in.data.size();
+  if (kind_ == "tanh") {
+    for (size_t i = 0; i < n; ++i)
+      y[i] = 1.7159f * std::tanh(0.6666f * x[i]);
+  } else if (kind_ == "sigmoid") {
+    for (size_t i = 0; i < n; ++i) y[i] = 1.f / (1.f + std::exp(-x[i]));
+  } else if (kind_ == "relu") {
+    for (size_t i = 0; i < n; ++i)
+      y[i] = x[i] > 15.f ? x[i] : std::log1p(std::exp(x[i]));
+  } else if (kind_ == "str") {
+    for (size_t i = 0; i < n; ++i) y[i] = x[i] > 0.f ? x[i] : 0.f;
+  } else if (kind_ == "log") {
+    for (size_t i = 0; i < n; ++i)
+      y[i] = std::log(x[i] + std::sqrt(x[i] * x[i] + 1.f));
+  } else if (kind_ == "tanhlog") {
+    // hybrid tanh/log (ops/activations.py TANHLOG_* constants)
+    const float D = 3.f, A = 0.242528761112f, B = 305.459953195f;
+    for (size_t i = 0; i < n; ++i) {
+      float v = x[i];
+      if (v > D)
+        y[i] = std::log(std::fabs(v) * B + 1e-30f) * A;
+      else if (v < -D)
+        y[i] = -std::log(std::fabs(v) * B + 1e-30f) * A;
+      else
+        y[i] = 1.7159f * std::tanh(0.6666f * v);
+    }
+  } else if (kind_ == "sincos") {
+    // global flat index parity (ops/activations.py sincos)
+    for (size_t i = 0; i < n; ++i)
+      y[i] = (i % 2 == 1) ? std::sin(x[i]) : std::cos(x[i]);
+  } else {
+    throw std::runtime_error("unsupported activation kind: " + kind_);
+  }
+}
+
 std::unique_ptr<Unit> CreateUnit(const std::string& type) {
   if (type == "all2all") return std::make_unique<All2AllLinear>();
   if (type == "all2all_tanh") return std::make_unique<All2AllTanh>();
@@ -109,6 +391,17 @@ std::unique_ptr<Unit> CreateUnit(const std::string& type) {
   if (type == "all2all_relu") return std::make_unique<All2AllRELU>();
   if (type == "all2all_str") return std::make_unique<All2AllStrictRELU>();
   if (type == "softmax") return std::make_unique<All2AllSoftmax>();
+  if (type == "conv") return std::make_unique<Conv>();
+  if (type == "conv_tanh") return std::make_unique<ConvTanh>();
+  if (type == "conv_sigmoid") return std::make_unique<ConvSigmoid>();
+  if (type == "conv_relu") return std::make_unique<ConvRELU>();
+  if (type == "conv_str") return std::make_unique<ConvStrictRELU>();
+  if (type == "max_pooling") return std::make_unique<MaxPooling>();
+  if (type == "avg_pooling") return std::make_unique<AvgPooling>();
+  if (type == "norm") return std::make_unique<LRN>();
+  if (type == "dropout") return std::make_unique<DropoutIdentity>();
+  if (type.rfind("activation_", 0) == 0)
+    return std::make_unique<Activation>(type.substr(11));
   throw std::runtime_error("unknown unit type: " + type);
 }
 

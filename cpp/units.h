@@ -1,31 +1,42 @@
-// C++ inference units — libZnicz parity scope.
+// C++ inference units — libZnicz parity scope, extended to the spatial
+// tier so conv packages (the flagship LeNet/CIFAR topologies) deploy
+// natively.
 //
 // Reference: libZnicz/src/all2all.{cc,h} (All2All base: weights_, bias_,
 // Execute = GEMM + activation), all2all_linear.cc, all2all_tanh.cc
 // (y = 1.7159 tanh(0.6666 x)), all2all_softmax.cc, with units created by
-// a name factory (inc/znicz/units.h:48-50 DECLARE_UNIT).  Extended with
-// the remaining FC activations so every exported all2all* type runs.
+// a name factory (inc/znicz/units.h:48-50 DECLARE_UNIT).  Spatial
+// semantics (NHWC, ceil-mode pooling, LRN constants) match
+// znicz_tpu/ops/{conv,pooling,normalization}.py — the executable spec.
 #pragma once
 
 #include <functional>
 #include <map>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "npy.h"
 
 namespace znicz {
+
+// Sample shape between layers: (h, w, c) for spatial data or (n,) flat.
+using Shape = std::vector<size_t>;
 
 class Unit {
  public:
   virtual ~Unit() = default;
   virtual std::string Name() const = 0;
   virtual void SetParameter(const std::string& name, Tensor value);
+  // Resolve the output sample shape from the input's; called once per
+  // Execute chain before running.  Default: flatten-agnostic identity.
+  virtual Shape Configure(const Shape& in) { return in; }
   // in: (batch, sample_size) row-major; out resized by the unit.
   virtual void Execute(const Tensor& in, Tensor* out) const = 0;
   virtual size_t OutputSize() const = 0;
 
  protected:
+  float Scalar(const std::string& name, float fallback) const;
   std::map<std::string, Tensor> params_;
   bool include_bias_ = true;
   bool weights_transposed_ = false;
@@ -34,6 +45,7 @@ class Unit {
 class All2All : public Unit {
  public:
   void SetParameter(const std::string& name, Tensor value) override;
+  Shape Configure(const Shape& in) override { return {n_out_}; }
   void Execute(const Tensor& in, Tensor* out) const override;
   size_t OutputSize() const override { return n_out_; }
 
@@ -86,6 +98,129 @@ class All2AllSoftmax : public All2All {
  public:
   std::string Name() const override { return "softmax"; }
   void Execute(const Tensor& in, Tensor* out) const override;
+};
+
+// -- spatial tier (NHWC; semantics = znicz_tpu/ops/*) -----------------------
+
+// Convolution: weights (n_kernels, ky*kx*C), padding LTRB, sliding
+// (x, y) — reference conv.py geometry.
+class Conv : public Unit {
+ public:
+  std::string Name() const override { return "conv"; }
+  void SetParameter(const std::string& name, Tensor value) override;
+  Shape Configure(const Shape& in) override;
+  void Execute(const Tensor& in, Tensor* out) const override;
+  size_t OutputSize() const override { return ny_ * nx_ * k_; }
+
+ protected:
+  virtual void ApplyActivation(float* data, size_t n) const {}
+  Tensor weights_, bias_;
+  size_t kx_ = 0, ky_ = 0, k_ = 0;
+  long pad_[4] = {0, 0, 0, 0};  // left, top, right, bottom
+  size_t slide_[2] = {1, 1};    // x, y
+  size_t h_ = 0, w_ = 0, c_ = 0, ny_ = 0, nx_ = 0;
+};
+
+class ConvTanh : public Conv {
+ public:
+  std::string Name() const override { return "conv_tanh"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+class ConvSigmoid : public Conv {
+ public:
+  std::string Name() const override { return "conv_sigmoid"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+class ConvRELU : public Conv {  // softplus
+ public:
+  std::string Name() const override { return "conv_relu"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+class ConvStrictRELU : public Conv {
+ public:
+  std::string Name() const override { return "conv_str"; }
+
+ protected:
+  void ApplyActivation(float* data, size_t n) const override;
+};
+
+// Ceil-mode pooling with truncated overhang windows
+// (reference pooling.py:96-105, ops/pooling.py).
+class Pooling : public Unit {
+ public:
+  void SetParameter(const std::string& name, Tensor value) override;
+  Shape Configure(const Shape& in) override;
+  void Execute(const Tensor& in, Tensor* out) const override;
+  size_t OutputSize() const override { return ny_ * nx_ * c_; }
+
+ protected:
+  virtual float Reduce(const float* x, size_t stride, size_t count_y,
+                       size_t count_x, size_t row_stride) const = 0;
+  size_t kx_ = 0, ky_ = 0;
+  size_t slide_[2] = {0, 0};
+  size_t h_ = 0, w_ = 0, c_ = 0, ny_ = 0, nx_ = 0;
+};
+
+class MaxPooling : public Pooling {
+ public:
+  std::string Name() const override { return "max_pooling"; }
+
+ protected:
+  float Reduce(const float* x, size_t stride, size_t cy, size_t cx,
+               size_t row_stride) const override;
+};
+
+class AvgPooling : public Pooling {
+ public:
+  std::string Name() const override { return "avg_pooling"; }
+
+ protected:
+  float Reduce(const float* x, size_t stride, size_t cy, size_t cx,
+               size_t row_stride) const override;
+};
+
+// Cross-channel local response normalization
+// (reference normalization.py; ops/normalization.py).
+class LRN : public Unit {
+ public:
+  std::string Name() const override { return "norm"; }
+  Shape Configure(const Shape& in) override;
+  void Execute(const Tensor& in, Tensor* out) const override;
+  size_t OutputSize() const override { return size_; }
+
+ private:
+  size_t size_ = 0, c_ = 0;
+};
+
+// Standalone elementwise activations (reference activation.py).
+class Activation : public Unit {
+ public:
+  explicit Activation(std::string kind) : kind_(std::move(kind)) {}
+  std::string Name() const override { return "activation_" + kind_; }
+  void Execute(const Tensor& in, Tensor* out) const override;
+  size_t OutputSize() const override { return 0; }
+
+ private:
+  std::string kind_;
+};
+
+// Dropout is identity at inference (reference dropout.py TRAIN gating).
+class DropoutIdentity : public Unit {
+ public:
+  std::string Name() const override { return "dropout"; }
+  void Execute(const Tensor& in, Tensor* out) const override {
+    *out = in;
+  }
+  size_t OutputSize() const override { return 0; }
 };
 
 // Factory by type string (reference DECLARE_UNIT registration).

@@ -41,10 +41,14 @@ Workflow Workflow::Load(const std::string& path) {
           throw std::runtime_error("package missing " + attr.second);
         unit->SetParameter(attr.first, LoadNpy(fit->second));
       } else {
-        Tensor scalar;
-        scalar.shape = {1};
-        scalar.data = {std::stof(attr.second)};
-        unit->SetParameter(attr.first, scalar);
+        // scalar or comma-separated tuple (padding=0,0,0,0 etc.)
+        Tensor values;
+        std::stringstream vs(attr.second);
+        std::string item;
+        while (std::getline(vs, item, ','))
+          values.data.push_back(std::stof(item));
+        values.shape = {values.data.size()};
+        unit->SetParameter(attr.first, values);
       }
     }
     wf.units_.push_back(std::move(unit));
@@ -54,14 +58,25 @@ Workflow Workflow::Load(const std::string& path) {
   return wf;
 }
 
-void Workflow::Execute(const Tensor& in, Tensor* out) const {
+void Workflow::Execute(const Tensor& in, Tensor* out) {
+  // sample shape threads through Configure: 4-D input keeps its
+  // (h, w, c) spatial shape for the conv/pooling tier; anything else
+  // flattens
+  Shape sample;
+  if (in.shape.size() == 4) {
+    sample = {in.shape[1], in.shape[2], in.shape[3]};
+  } else {
+    sample = {in.cols()};
+  }
   Tensor cur = in;
-  // flatten whatever sample rank to (batch, features)
   cur.shape = {in.rows(), in.cols()};
   Tensor next;
   for (const auto& unit : units_) {
+    sample = unit->Configure(sample);
     unit->Execute(cur, &next);
     cur = std::move(next);
+    // units may emit 4-D shapes; downstream works on (batch, features)
+    cur.shape = {cur.rows(), cur.cols()};
     next = Tensor();
   }
   *out = std::move(cur);

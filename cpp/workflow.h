@@ -16,7 +16,10 @@ class Workflow {
   // Load a package zip written by znicz_tpu/export.py.
   static Workflow Load(const std::string& path);
 
-  void Execute(const Tensor& in, Tensor* out) const;
+  // NOT thread-safe on a shared instance: Execute configures the
+  // layer geometry for the input shape before running — clone or
+  // lock per thread.
+  void Execute(const Tensor& in, Tensor* out);
   size_t size() const { return units_.size(); }
 
  private:

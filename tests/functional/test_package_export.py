@@ -256,3 +256,125 @@ def test_serving_workflow_is_reusable(tmp_path):
     assert a.shape == (2, 3) and b.shape == (2, 3)
     assert numpy.abs(a - b).max() > 1e-9  # fresh outputs, not stale
     assert len(ldr._queue) == 0
+
+
+def _trained_conv(tmp_path):
+    from znicz_tpu.core.config import root
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    wf = mnist.build(
+        layers=root.mnistr_caffe.layers,
+        loader_config={"synthetic_train": 60, "synthetic_valid": 30,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"prefix": "pkgc", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    return wf
+
+
+def test_conv_package_numpy_runner(tmp_path):
+    """The spatial tier (conv/pool) exports and replays through the
+    numpy package runner, matching the live unit graph."""
+    wf = _trained_conv(tmp_path)
+    pkg = str(tmp_path / "conv.zip")
+    export_package(wf, pkg)
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (30, 28, 28, 1)).astype(numpy.float32)
+    y_pkg = run_package_numpy(pkg, x)
+    y_py = _python_forward(wf, x)
+    assert y_pkg.shape == (30, 10)
+    assert numpy.abs(y_pkg - y_py).max() < 1e-5
+
+
+def test_cpp_conv_cli_matches_python(tmp_path):
+    """The C++ runtime executes the CONV flagship package end to end:
+    conv 20C5 -> MP2 -> conv 50C5 -> MP2 -> fc_relu -> softmax."""
+    build = _build_cpp()
+    wf = _trained_conv(tmp_path)
+    pkg = str(tmp_path / "conv.zip")
+    export_package(wf, pkg)
+
+    x = numpy.random.RandomState(1).uniform(
+        -1, 1, (10, 28, 28, 1)).astype(numpy.float32)
+    in_npy = str(tmp_path / "in.npy")
+    out_npy = str(tmp_path / "out.npy")
+    numpy.save(in_npy, x)
+    res = subprocess.run(
+        [os.path.join(build, "znicz_infer"), pkg, in_npy, out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+
+    y_cpp = numpy.load(out_npy)
+    y_py = run_package_numpy(pkg, x)
+    assert y_cpp.shape == (10, 10)
+    assert numpy.abs(y_cpp - y_py).max() < 1e-4
+    assert numpy.array_equal(y_cpp.argmax(1), y_py.argmax(1))
+
+
+def test_cpp_cifar_topology(tmp_path):
+    """C++ runs a CIFAR-caffe-style package: conv/pool/str/LRN stack
+    with avg pooling and overhanging (ceil-mode) windows."""
+    build = _build_cpp()
+    import znicz_tpu.loader.loader_cifar  # noqa: F401
+    from znicz_tpu.samples import cifar
+    prng.get(1).seed(42)
+    prng.get(2).seed(43)
+    wf = cifar.build(
+        loader_config={"synthetic_train": 60, "synthetic_valid": 30,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"interval": 100, "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    pkg = str(tmp_path / "cifar.zip")
+    export_package(wf, pkg)
+
+    x = numpy.random.RandomState(2).uniform(
+        -1, 1, (4, 32, 32, 3)).astype(numpy.float32)
+    in_npy = str(tmp_path / "in.npy")
+    out_npy = str(tmp_path / "out.npy")
+    numpy.save(in_npy, x)
+    res = subprocess.run(
+        [os.path.join(build, "znicz_infer"), pkg, in_npy, out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    y_cpp = numpy.load(out_npy)
+    y_py = run_package_numpy(pkg, x)
+    assert numpy.abs(y_cpp - y_py).max() < 1e-4
+
+
+def test_cpp_ctypes_nhwc_binding(tmp_path):
+    """The spatial C ABI (znicz_infer_nhwc) serves a conv package from
+    Python via ctypes (review regression: the rank-2 ABI cannot)."""
+    build = _build_cpp()
+    wf = _trained_conv(tmp_path)
+    pkg = str(tmp_path / "conv.zip")
+    export_package(wf, pkg)
+
+    lib = ctypes.CDLL(os.path.join(build, "libznicz_infer.so"))
+    lib.znicz_load.restype = ctypes.c_void_p
+    lib.znicz_load.argtypes = [ctypes.c_char_p]
+    lib.znicz_infer_nhwc.restype = ctypes.c_int
+    lib.znicz_infer_nhwc.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.znicz_last_error.restype = ctypes.c_char_p
+
+    handle = lib.znicz_load(pkg.encode())
+    assert handle, lib.znicz_last_error().decode()
+    x = numpy.random.RandomState(3).uniform(
+        -1, 1, (6, 28, 28, 1)).astype(numpy.float32)
+    out = numpy.zeros((6, 10), dtype=numpy.float32)
+    n = lib.znicz_infer_nhwc(
+        ctypes.c_void_p(handle),
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 6, 28, 28, 1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size)
+    assert n == 10, lib.znicz_last_error().decode()
+    y_py = run_package_numpy(pkg, x)
+    assert numpy.abs(out - y_py).max() < 1e-4
+    lib.znicz_free(ctypes.c_void_p(handle))

@@ -64,9 +64,21 @@ def export_package(workflow, path):
         parts = ["type=%s" % entry["type"]]
         for attr, fname in sorted(entry["arrays"].items()):
             parts.append("%s=%s" % (attr, fname))
-        for attr in ("weights_transposed", "include_bias"):
-            if attr in entry:
-                parts.append("%s=%d" % (attr, int(bool(entry[attr]))))
+        # scalar / tuple hyperparameters (conv & pooling geometry, LRN
+        # constants, ...) serialize as key=value / key=a,b,c for the
+        # C++ runtime's flat parser
+        for attr in sorted(entry):
+            if attr in ("type", "name", "arrays"):
+                continue
+            value = entry[attr]
+            if isinstance(value, bool):
+                parts.append("%s=%d" % (attr, int(value)))
+            elif isinstance(value, (int, float)):
+                parts.append("%s=%s" % (attr, repr(value)))
+            elif isinstance(value, (tuple, list)) and value and \
+                    all(isinstance(v, (int, float)) for v in value):
+                parts.append("%s=%s" % (attr,
+                                        ",".join(repr(v) for v in value)))
         lines.append(" ".join(parts))
 
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
@@ -94,30 +106,77 @@ def load_package(path):
 
 def run_package_numpy(path, x):
     """Execute a package forward in pure numpy — the executable spec the
-    C++ runtime (cpp/) must match to 1e-5."""
-    from znicz_tpu.ops import dense
+    C++ runtime (cpp/) must match to 1e-5.
+
+    Supports the FC family plus the spatial tier (conv*, max/avg
+    pooling, LRN, standalone activations, dropout-as-identity).  Spatial
+    packages take NHWC input."""
+    from znicz_tpu.ops import activations, dense
+    from znicz_tpu.ops import conv as conv_ops
+    from znicz_tpu.ops import normalization as norm_ops
+    from znicz_tpu.ops import pooling as pool_ops
     manifest, arrays = load_package(path)
-    y = numpy.asarray(x, dtype=numpy.float64).reshape(len(x), -1)
+    x = numpy.asarray(x, dtype=numpy.float64)
+    y = x
     for entry in manifest["layers"]:
         tpe = entry["type"]
-        w = arrays[entry["arrays"]["weights"]]
-        if entry.get("weights_transposed"):
-            w = w.T
-        b = arrays.get(entry["arrays"].get("bias", ""), None)
-        include_bias = bool(entry.get("include_bias", True)) and \
-            b is not None
-        if tpe == "softmax":
-            y = dense.forward_numpy(y, w, b, activation="linear",
-                                    include_bias=include_bias)
-            y, _ = dense.softmax_numpy(y)
-        elif tpe.startswith("all2all"):
-            act = {"all2all": "linear", "all2all_tanh": "tanh",
-                   "all2all_relu": "relu", "all2all_str": "strict_relu",
-                   "all2all_sigmoid": "sigmoid"}[tpe]
-            y = dense.forward_numpy(y, w, b, activation=act,
-                                    include_bias=include_bias)
+        if tpe == "softmax" or tpe.startswith("all2all"):
+            w = arrays[entry["arrays"]["weights"]]
+            if entry.get("weights_transposed"):
+                w = w.T
+            b = arrays.get(entry["arrays"].get("bias", ""), None)
+            include_bias = bool(entry.get("include_bias", True)) and \
+                b is not None
+            y = y.reshape(len(y), -1)
+            if tpe == "softmax":
+                y = dense.forward_numpy(y, w, b, activation="linear",
+                                        include_bias=include_bias)
+                y, _ = dense.softmax_numpy(y)
+            else:
+                act = {"all2all": "linear", "all2all_tanh": "tanh",
+                       "all2all_relu": "relu",
+                       "all2all_str": "strict_relu",
+                       "all2all_sigmoid": "sigmoid"}[tpe]
+                y = dense.forward_numpy(y, w, b, activation=act,
+                                        include_bias=include_bias)
+        elif tpe.startswith("conv"):
+            w = arrays[entry["arrays"]["weights"]]
+            if entry.get("weights_transposed"):
+                w = w.T
+            b = arrays.get(entry["arrays"].get("bias", ""), None)
+            include_bias = bool(entry.get("include_bias", True)) and \
+                b is not None
+            act = {"conv": "linear", "conv_tanh": "tanh",
+                   "conv_relu": "relu", "conv_str": "strict_relu",
+                   "conv_sigmoid": "sigmoid"}[tpe]
+            y = conv_ops.forward_numpy(
+                y, w, b, int(entry["ky"]), int(entry["kx"]),
+                tuple(int(v) for v in entry["padding"]),
+                tuple(int(v) for v in entry["sliding"]),
+                activation=act, include_bias=include_bias)
+        elif tpe in ("max_pooling", "avg_pooling"):
+            sliding = tuple(int(v) for v in entry["sliding"])
+            if tpe == "max_pooling":
+                y, _ = pool_ops.max_pooling_numpy(
+                    y, int(entry["ky"]), int(entry["kx"]), sliding)
+            else:
+                y = pool_ops.avg_pooling_numpy(
+                    y, int(entry["ky"]), int(entry["kx"]), sliding)
+        elif tpe == "norm":
+            y = norm_ops.lrn_forward_numpy(
+                y, alpha=float(entry["alpha"]), beta=float(entry["beta"]),
+                k=float(entry["k"]), n=int(entry["n"]))
+        elif tpe.startswith("activation_"):
+            act = {"activation_tanh": "tanh", "activation_sigmoid":
+                   "sigmoid", "activation_relu": "relu",
+                   "activation_str": "strict_relu"}.get(tpe)
+            if act is not None:
+                y = activations.apply_numpy(act, y)
+            else:  # ext family: log / tanhlog / sincos
+                y = activations.ext_apply_numpy(
+                    tpe[len("activation_"):], y)
+        elif tpe == "dropout":
+            pass  # inference identity
         else:
-            raise ValueError(
-                "package runner supports the FC family only, got %r"
-                % tpe)
+            raise ValueError("package runner: unsupported type %r" % tpe)
     return y

@@ -33,6 +33,8 @@ class LRNormalizerForward(LRNParams, Forward):
         self.weights.reset()
         self.bias.reset()
         self.include_bias = False
+        # deployment packages need the LRN hyperparameters
+        self.exports.extend(("alpha", "beta", "k", "n"))
 
     def initialize(self, device=None, **kwargs):
         super(LRNormalizerForward, self).initialize(device=device, **kwargs)
