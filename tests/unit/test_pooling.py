@@ -163,3 +163,80 @@ def test_pooling_fwd_reduce_window_matches_numpy(geom, mode):
     g = jax.grad(lambda x: jnp.sum(
         pool_ops.pooling_fwd_jax(x, ky, kx, sliding, mode=mode) ** 2))(x)
     assert numpy.isfinite(numpy.asarray(g)).all()
+
+
+@pytest.mark.parametrize("geom", GEOMS + [(24, 24, 64, 2, 2, (2, 2))])
+@pytest.mark.parametrize("use_abs", [False, True])
+def test_pallas_pooling_kernel_bit_parity(geom, use_abs):
+    """The fused Pallas max-pool kernel (ops/pallas_pooling.py) is
+    bit-exact against the numpy twin — values AND winner offsets,
+    including overhanging ceil-mode windows and tie-breaking."""
+    from znicz_tpu.ops.pallas_pooling import max_pooling_offsets_pallas
+    sy, sx, c, ky, kx, sliding = geom
+    r = numpy.random.RandomState(11)
+    x = r.uniform(-1, 1, (3, sy, sx, c)).astype(numpy.float32)
+    # force exact ties inside windows to pin the first-winner rule
+    x[:, 0, :2, :] = 0.5
+    on, offn = pool_ops.max_pooling_numpy(x, ky, kx, sliding, use_abs)
+    op, offp = max_pooling_offsets_pallas(x, ky, kx, sliding, use_abs)
+    assert numpy.abs(on - numpy.asarray(op)).max() == 0
+    assert (offn == numpy.asarray(offp)).all()
+
+
+def test_max_pooling_jax_gather_fallback_parity():
+    """The non-float (gather) path stays bit-exact too."""
+    r = numpy.random.RandomState(12)
+    x = r.randint(-9, 9, (2, 6, 6, 3)).astype(numpy.int32)
+    on, offn = pool_ops.max_pooling_numpy(x, 2, 2, (2, 2))
+    oj, offj = pool_ops.max_pooling_jax(x, 2, 2, (2, 2))
+    assert (on == numpy.asarray(oj)).all()
+    assert (offn == numpy.asarray(offj)).all()
+
+
+def test_pallas_pooling_review_regressions():
+    """supported() works on tracers and bounds VMEM; sentinel-valued
+    inputs (-inf / finfo.min) still pick the right winner; maxabs
+    pooling stays differentiable through the fused forward."""
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.ops import pallas_pooling
+
+    # 1. tracer-safe dtype check (no numpy.asarray on tracers)
+    @jax.jit
+    def pooled(x):
+        return pool_ops.max_pooling_jax(x, 2, 2, (2, 2))[0]
+    r = numpy.random.RandomState(5)
+    x = r.uniform(-1, 1, (2, 6, 6, 3)).astype(numpy.float32)
+    assert pooled(x).shape == (2, 3, 3, 3)
+
+    # 2. VMEM bound: oversized maps fall back to the gather path
+    big = numpy.zeros((1, 2048, 2048, 1), numpy.float32)
+    assert not pallas_pooling.supported(big, 2, 2, (2, 2), False)
+
+    # 3. -inf / finfo.min values must win over the init sentinel
+    xm = numpy.full((1, 2, 2, 1), -numpy.inf, numpy.float32)
+    xm[0, 1, 1, 0] = numpy.float32(numpy.finfo(numpy.float32).min)
+    on, offn = pool_ops.max_pooling_numpy(xm, 2, 2, (2, 2))
+    op, offp = pool_ops.max_pooling_jax(xm, 2, 2, (2, 2))
+    assert numpy.array_equal(on, numpy.asarray(op))
+    assert numpy.array_equal(offn, numpy.asarray(offp))
+
+    # 4. fused maxabs differentiates (gather path)
+    from znicz_tpu.parallel import fused
+    g = jax.grad(lambda x: jnp.sum(
+        pool_ops._max_pooling_gather_jax(x, 2, 2, (2, 2),
+                                         use_abs=True)[0]))(
+        jnp.asarray(x, jnp.float32))
+    assert numpy.isfinite(numpy.asarray(g)).all()
+    specs = fused.build_specs(
+        [{"type": "conv_tanh", "->": {"n_kernels": 2, "kx": 3, "ky": 3}},
+         {"type": "maxabs_pooling", "->": {"kx": 2, "ky": 2}},
+         {"type": "all2all_tanh", "->": {"output_sample_shape": 4}},
+         {"type": "softmax", "->": {"output_sample_shape": 2}}],
+        (6, 6, 1))
+    params = fused.init_params(specs)
+    grads = jax.grad(lambda p: fused._loss_and_stats(
+        p, jnp.zeros((2, 6, 6, 1), jnp.float32),
+        jnp.zeros(2, jnp.int32), tuple(specs))[0])(params)
+    assert all(numpy.isfinite(numpy.asarray(v)).all()
+               for d in grads for v in d.values())

@@ -1,0 +1,105 @@
+"""Pallas TPU kernel: max/maxabs pooling WITH winner offsets, one pass.
+
+The unit-graph path needs the reference's flat ``input_offset``
+bookkeeping (pooling.py:303-312) so GD pooling can scatter gradients to
+the winners.  The XLA formulation materializes a (B, ny, nx, ky*kx, C)
+window view and gathers through argmax indices — several HBM round
+trips.  This kernel keeps one batch row in VMEM and computes value +
+winner offset in a single fused pass: a running strict-greater max over
+the ky*kx window cells (unrolled — kernels are small), which also
+reproduces the argmax first-winner tie rule.
+
+On non-TPU backends the kernel runs in interpreter mode, so the numpy
+twins remain the executable spec everywhere (guide:
+/opt/skills/guides/pallas_guide.md).
+"""
+
+import functools
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, out_ref, off_ref, *, ky, kx, sy, sx, ny, nx,
+            h, w, c, use_abs):
+    b = pl.program_id(0)
+    x = x_ref[0]  # (h, w, c) in VMEM
+    neg = jnp.finfo(x.dtype).min
+    # pad so every strided window position exists; Mosaic has no
+    # stride>1 vector slices, so striding is done by reshape-and-select
+    # enough slack that every (dy, dx) shift has ny*sy / nx*sx rows/cols
+    ph = ny * sy + ky - 1 - h
+    pw = nx * sx + kx - 1 - w
+    xp = jnp.pad(x, ((0, ph), (0, pw), (0, 0)))
+    hp, wp = h + ph, w + pw
+    best_key = jnp.full((ny, nx, c), neg, x.dtype)
+    best_val = jnp.zeros((ny, nx, c), x.dtype)
+    best_q = jnp.zeros((ny, nx, c), jnp.int32)
+    found = jnp.zeros((ny, nx, c), jnp.bool_)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (ny, nx, c), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (ny, nx, c), 1)
+    for dy in range(ky):
+        rows = jax.lax.slice(xp, (dy, 0, 0), (dy + ny * sy, wp, c))
+        rows = rows.reshape(ny, sy, wp, c)[:, 0]  # stride sy
+        for dx in range(kx):
+            cols = jax.lax.slice(rows, (0, dx, 0), (ny, dx + nx * sx, c))
+            val = cols.reshape(ny, nx, sx, c)[:, :, 0]  # stride sx
+            key = jnp.abs(val) if use_abs else val
+            # cells beyond the true input are invalid (overhang)
+            valid = (ii * sy + dy < h) & (jj * sx + dx < w)
+            # strict > keeps the FIRST window cell on ties; the ~found
+            # term lets the first VALID cell win even when its key is
+            # -inf / finfo.min (the sentinel must not beat real data).
+            # NaN windows are undefined behavior here (numpy argmax
+            # would return the NaN's index; training NaN-guards apart).
+            better = valid & (~found | (key > best_key))
+            found = found | valid
+            best_key = jnp.where(better, key, best_key)
+            best_val = jnp.where(better, val, best_val)
+            best_q = jnp.where(better, dy * kx + dx, best_q)
+    out_ref[0] = best_val
+    cc = jax.lax.broadcasted_iota(jnp.int32, (ny, nx, c), 2)
+    wy = ii * sy + best_q // kx
+    wx = jj * sx + best_q % kx
+    off_ref[0] = ((b * h + wy) * w + wx) * c + cc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("ky", "kx", "sliding", "use_abs"))
+def max_pooling_offsets_pallas(x, ky, kx, sliding, use_abs=False):
+    """(output, flat winner offsets) — drop-in for the window-view
+    formulation of ops/pooling.max_pooling_jax."""
+    from znicz_tpu.ops.pooling import output_spatial
+    b, h, w, c = x.shape
+    ny, nx = output_spatial(h, w, ky, kx, sliding)
+    kernel = functools.partial(
+        _kernel, ky=ky, kx=kx, sx=int(sliding[0]), sy=int(sliding[1]),
+        ny=ny, nx=nx, h=h, w=w, c=c, use_abs=use_abs)
+    return pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0))],
+        out_specs=[pl.BlockSpec((1, ny, nx, c), lambda i: (i, 0, 0, 0)),
+                   pl.BlockSpec((1, ny, nx, c), lambda i: (i, 0, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, ny, nx, c), x.dtype),
+                   jax.ShapeDtypeStruct((b, ny, nx, c), jnp.int32)],
+        interpret=jax.default_backend() != "tpu",
+    )(x)
+
+
+#: VMEM budget for one batch row (input + padded copy + outputs must
+#: fit in ~16MB/core; stay well under)
+_VMEM_BYTES_LIMIT = 4 * 1024 * 1024
+
+
+def supported(x, ky, kx, sliding, use_abs):
+    """Whether the kernel covers this case: float dtypes (the sentinel
+    needs a float lattice bottom) whose per-row block fits VMEM.
+    dtype inspection only — works on tracers, no host transfer."""
+    if not numpy.issubdtype(x.dtype, numpy.floating):
+        return False
+    h, w, c = x.shape[1], x.shape[2], x.shape[3]
+    return h * w * c * x.dtype.itemsize <= _VMEM_BYTES_LIMIT

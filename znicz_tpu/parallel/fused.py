@@ -459,10 +459,12 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
         elif spec.kind == "pool":
             y = y.reshape((y.shape[0],) + spec.in_shape)
             if spec.mode == "maxabs":
-                # offset path: reduce_window maxabs breaks |tie|s toward
+                # gather path: reduce_window maxabs breaks |tie|s toward
                 # the positive value, the reference toward the first
-                # occurrence — keep exact parity for this rare mode
-                y, _ = pool_ops.max_pooling_jax(
+                # occurrence — keep exact parity for this rare mode.
+                # NOT max_pooling_jax: that routes to the Pallas kernel,
+                # which has no autodiff rule (this forward is grad'd)
+                y, _ = pool_ops._max_pooling_gather_jax(
                     y, spec.ky, spec.kx, spec.sliding, use_abs=True)
             else:
                 y = pool_ops.pooling_fwd_jax(
