@@ -126,3 +126,44 @@ def test_dump_graph(tmp_path):
     assert dot.startswith("digraph")
     assert "loader" in dot and "decision" in dot
     assert "->" in dot
+
+
+def test_cli_optimize_runs_ga(tmp_path):
+    """--optimize evolves Range config values through real training runs
+    (the reference GA tier driven from the CLI)."""
+    script = tmp_path / "wine_ga.py"
+    script.write_text("""
+from znicz_tpu.core.config import root
+from znicz_tpu.core.genetics import Range
+import znicz_tpu.samples.wine  # installs defaults + WineWorkflow
+
+root.wine.decision.max_epochs = 3
+root.wine.learning_rate = Range(0.3, 0.05, 0.6)
+from znicz_tpu.samples.wine import run  # noqa: F401,E402
+""")
+    out = subprocess.run(
+        [sys.executable, "-m", "znicz_tpu", str(script),
+         "--optimize", "2x3"],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT,
+                 HOME=str(tmp_path)),
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "best fitness" in out.stdout
+    assert "learning_rate" in out.stdout
+
+
+def test_cli_optimize_validation(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT,
+               HOME=str(tmp_path))
+    for args, needle in (
+            (["wine", "--optimize", "abc"], "GENSxPOP"),
+            (["wine", "--optimize", "0x8"], "at least 1"),
+            (["wine", "--optimize", "2x3", "--dry-run"],
+             "cannot be combined")):
+        out = subprocess.run(
+            [sys.executable, "-m", "znicz_tpu"] + args,
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert out.returncode != 0
+        assert needle in out.stderr, (args, out.stderr[-500:])

@@ -224,7 +224,7 @@ def test_pallas_pooling_review_regressions():
     # 4. fused maxabs differentiates (gather path)
     from znicz_tpu.parallel import fused
     g = jax.grad(lambda x: jnp.sum(
-        pool_ops._max_pooling_gather_jax(x, 2, 2, (2, 2),
+        pool_ops.max_pooling_gather_jax(x, 2, 2, (2, 2),
                                          use_abs=True)[0]))(
         jnp.asarray(x, jnp.float32))
     assert numpy.isfinite(numpy.asarray(g)).all()

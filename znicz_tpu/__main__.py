@@ -36,6 +36,51 @@ def apply_override(root_cfg, assignment):
     setattr(node, parts[-1], value)
 
 
+def run_genetics(module, spec):
+    """--optimize GENSxPOP: evolve the Range values found anywhere under
+    the config root (the reference's GA tier, SURVEY.md §3.5 —
+    samples/MNIST/mnist_config.py:62 declares Range sites the same way).
+    Each fitness evaluation is a full training run of the workflow."""
+    from znicz_tpu.core.genetics import GeneticsOptimizer, enumerate_ranges
+    from znicz_tpu.launcher import run_workflow
+    gens_s, _, pop_s = spec.partition("x")
+    try:
+        gens = int(gens_s or 4)
+        pop = int(pop_s or 8)
+    except ValueError:
+        raise SystemExit("--optimize wants GENSxPOP (e.g. 4x8), got %r"
+                         % spec)
+    if gens < 1 or pop < 1:
+        raise SystemExit("--optimize needs at least 1 generation and 1 "
+                         "individual, got %r" % spec)
+    if not enumerate_ranges(root):
+        raise SystemExit(
+            "--optimize needs Range(...) values in the config; e.g. "
+            'root.myns.learning_rate = Range(0.01, 0.001, 0.1)')
+
+    def evaluate(_cfg):
+        wf = run_workflow(module)
+        decision = getattr(wf, "decision", None)
+        err = None
+        if decision is not None:
+            pts = getattr(decision, "best_n_err_pt", None)
+            if pts is not None:
+                err = pts[1] if pts[1] is not None else pts[2]
+        if err is None:
+            raise SystemExit("workflow exposes no error metric to "
+                             "optimize against")
+        return -float(err)
+
+    opt = GeneticsOptimizer(evaluate, root, generations=gens,
+                            population_size=pop)
+    values, fitness = opt.run()
+    print("best fitness (-err%%): %.4f" % fitness)
+    for (container, key, rng), value in zip(opt.sites, values):
+        print("  %s = %s  (range %s..%s)" % (key, value, rng.min_value,
+                                             rng.max_value))
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m znicz_tpu",
@@ -56,6 +101,11 @@ def main(argv=None):
                         help="write the workflow control graph as DOT; "
                              "skips training unless combined with "
                              "--testing")
+    parser.add_argument("--optimize", metavar="GENSxPOP",
+                        help="genetic hyperparameter search over Range "
+                             "values in the config (e.g. 4x8 = 4 "
+                             "generations, population 8); fitness is "
+                             "-validation error")
     parser.add_argument("--list", action="store_true",
                         help="list bundled samples and exit")
     args = parser.parse_args(argv)
@@ -72,6 +122,12 @@ def main(argv=None):
     module = resolve_workflow_module(args.workflow)
     for assignment in args.config:
         apply_override(root, assignment)
+    if args.optimize:
+        if args.snapshot or args.testing or args.dry_run or \
+                args.dump_graph:
+            parser.error("--optimize cannot be combined with --snapshot/"
+                         "--testing/--dry-run/--dump-graph")
+        return run_genetics(module, args.optimize)
     dry_run = args.dry_run or (bool(args.dump_graph) and not args.testing)
     wf = run_workflow(module, snapshot=args.snapshot,
                       testing=args.testing, dry_run=dry_run)

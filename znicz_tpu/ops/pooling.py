@@ -86,16 +86,16 @@ def max_pooling_jax(x, ky, kx, sliding, use_abs=False):
     NOT differentiable through the Pallas path — this is the
     unit-graph op whose backward is the offset scatter
     (max_pooling_backward_jax); autodiff users take pooling_fwd_jax
-    or _max_pooling_gather_jax."""
+    or max_pooling_gather_jax."""
     from znicz_tpu.ops import pallas_pooling
     if pallas_pooling.supported(x, ky, kx, sliding, use_abs):
         return pallas_pooling.max_pooling_offsets_pallas(
             x, ky, kx, tuple(sliding), use_abs=use_abs)
-    return _max_pooling_gather_jax(x, ky, kx, tuple(sliding), use_abs)
+    return max_pooling_gather_jax(x, ky, kx, tuple(sliding), use_abs)
 
 
 @partial(jax.jit, static_argnames=("ky", "kx", "sliding", "use_abs"))
-def _max_pooling_gather_jax(x, ky, kx, sliding, use_abs=False):
+def max_pooling_gather_jax(x, ky, kx, sliding, use_abs=False):
     win, valid, ny, nx = _window_view_jax(x, ky, kx, sliding, 0.0)
     key = jnp.abs(win) if use_abs else win
     key = jnp.where(valid[None, :, :, :, None], key, -jnp.inf)

@@ -464,7 +464,7 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
                 # occurrence — keep exact parity for this rare mode.
                 # NOT max_pooling_jax: that routes to the Pallas kernel,
                 # which has no autodiff rule (this forward is grad'd)
-                y, _ = pool_ops._max_pooling_gather_jax(
+                y, _ = pool_ops.max_pooling_gather_jax(
                     y, spec.ky, spec.kx, spec.sliding, use_abs=True)
             else:
                 y = pool_ops.pooling_fwd_jax(
