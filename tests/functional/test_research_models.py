@@ -143,3 +143,48 @@ def test_shuffled_indices_matches_serve_order():
     start_t, end_t = ldr.class_index_range(TRAIN)
     assert set(si[:40]) == set(range(start_t, end_t))
     assert set(si[40:]) == set(range(start_v, end_v))
+
+
+def test_imagenet_ae_stage_growth(tmp_path):
+    """Stage-wise AE pretraining (reference from_snapshot_add_layer):
+    train stage 1, snapshot, grow to 2 stages restoring stage-1 weights,
+    train stage 2 — stage-1 weights stay FROZEN while stage 2 learns."""
+    import glob
+    import os
+    from znicz_tpu.core.config import root
+    from znicz_tpu.samples.research import imagenet_ae
+
+    saved = dict(root.imagenet_ae.snapshotter.as_dict())
+    root.imagenet_ae.snapshotter.update({
+        "directory": str(tmp_path), "interval": 1, "time_interval": 0,
+        "compression": ""})
+    try:
+        wf1 = imagenet_ae.run_sample(
+            decision_config={"max_epochs": 2, "fail_iterations": 5})
+        snaps = sorted(glob.glob(os.path.join(str(tmp_path), "*.pickle")),
+                       key=os.path.getmtime)
+        assert snaps
+
+        wf2 = imagenet_ae.build(
+            n_stages=2,
+            decision_config={"max_epochs": 2, "fail_iterations": 5})
+        wf2.initialize()
+        restored = imagenet_ae.restore_stage_weights(snaps[-1], wf2)
+        assert restored == ["conv0"]
+        w0_restored = numpy.array(wf2.convs[0].weights.mem)
+        w1_init = numpy.array(wf2.convs[1].weights.mem)
+        wf2.run()
+        # stage 1 frozen; stage 2 (the AE tail's shared weights) trained
+        assert numpy.abs(numpy.array(wf2.convs[0].weights.mem) -
+                         w0_restored).max() == 0
+        assert numpy.abs(numpy.array(wf2.convs[1].weights.mem) -
+                         w1_init).max() > 0
+        assert numpy.isfinite(wf2.reconstruction_mse()[0])
+        # the growth graph really is conv0 -> pool0 -> conv1 -> AE tail
+        names = [u.name for u in wf2.units]
+        assert "conv0" in names and "pool0" in names and "conv1" in names
+    finally:
+        root.imagenet_ae.snapshotter.update(saved)
+        if "directory" not in saved:
+            # update() merges — it cannot REMOVE the key this test added
+            root.imagenet_ae.snapshotter.directory = None

@@ -5,10 +5,11 @@ imagenet_ae_config.py): stacked conv AE trained stage-wise (conv 108
 9x9 s3 as the first stage, later 192/224/256 stages added from
 snapshots), each stage conv -> stochastic abs pooling -> depooling ->
 weight-shared Deconv with MSE against the stage input; published
-baseline score 55.29pt (BASELINE.md).  This module implements the
-canonical single-stage AE graph (the same structure the reference
-retrains per added layer); stage stacking is driven by resuming from a
-snapshot and widening, which the snapshot/CLI tier covers."""
+baseline score 55.29pt (BASELINE.md).  Stage-wise pretraining lives
+HERE: ``n_stages`` builds earlier stages as frozen forwards and trains
+only the last stage's AE tail; ``restore_stage_weights`` carries the
+previous stage's trained conv weights into the grown workflow (the
+reference's from_snapshot_add_layer growth step)."""
 
 import numpy
 
@@ -31,13 +32,16 @@ root.imagenet_ae.update({
     "learning_rate": 0.0000003,
     "weights_decay": 0.00005,
     "gradient_moment": 0.00001,
-    "n_kernels": 108,
-    "kx": 9,
-    "ky": 9,
-    "sliding": (3, 3),
     "include_bias": False,
     "unsafe_padding": True,
     "pooling": {"kx": 3, "ky": 3, "sliding": (2, 2)},
+    #: stage-wise pretraining ladder (reference imagenet_ae_config.py:
+    #: 101-165 conv geometries 108/192/224/256)
+    "stages": [
+        {"n_kernels": 108, "kx": 9, "ky": 9, "sliding": (3, 3)},
+        {"n_kernels": 192, "kx": 5, "ky": 5, "sliding": (1, 1)},
+        {"n_kernels": 224, "kx": 5, "ky": 5, "sliding": (1, 1)},
+        {"n_kernels": 256, "kx": 3, "ky": 3, "sliding": (1, 1)}],
 })
 
 
@@ -87,21 +91,46 @@ class ImagenetAEWorkflow(nn_units.NNWorkflow):
         loader_cfg.update(kwargs.get("loader_config") or {})
         decision_cfg = cfg.decision.as_dict()
         decision_cfg.update(kwargs.get("decision_config") or {})
+        stages = kwargs.get("stages") or cfg.stages
+        self.n_stages = int(kwargs.get("n_stages", 1))
+        if not 1 <= self.n_stages <= len(stages):
+            raise ValueError("n_stages must be 1..%d" % len(stages))
 
         self.repeater.link_from(self.start_point)
         self.loader = SyntheticImageLoader(self, name="loader",
                                            **loader_cfg)
         self.loader.link_from(self.repeater)
 
-        self.conv = conv_units.Conv(
-            self, n_kernels=cfg.n_kernels, kx=cfg.kx, ky=cfg.ky,
-            sliding=tuple(cfg.sliding), weights_filling="uniform",
-            include_bias=cfg.include_bias)
-        self.conv.link_from(self.loader)
-        self.conv.link_attrs(self.loader, ("input", "minibatch_data"))
+        # earlier stages are FROZEN forwards (conv + abs pooling); the
+        # LAST stage gets the autoencoder tail and is the only one
+        # trained — the reference's stage-wise pretraining
+        # (imagenet_ae.py from_snapshot_add_layer)
+        self.convs = []
+        prev_unit, prev_attr = self.loader, "minibatch_data"
+        for s in range(self.n_stages):
+            geo = dict(stages[s])
+            conv = conv_units.Conv(
+                self, name="conv%d" % s,
+                n_kernels=geo["n_kernels"], kx=geo["kx"], ky=geo["ky"],
+                sliding=tuple(geo.get("sliding", (1, 1))),
+                weights_filling="uniform",
+                include_bias=cfg.include_bias)
+            conv.link_from(prev_unit)
+            conv.link_attrs(prev_unit, ("input", prev_attr))
+            self.convs.append(conv)
+            if s < self.n_stages - 1:
+                frozen_pool = pooling_units.StochasticAbsPooling(
+                    self, name="pool%d" % s,
+                    kx=cfg.pooling.kx, ky=cfg.pooling.ky,
+                    sliding=tuple(cfg.pooling.sliding))
+                frozen_pool.link_from(conv)
+                frozen_pool.link_attrs(conv, ("input", "output"))
+                prev_unit, prev_attr = frozen_pool, "output"
+        self.conv = self.convs[-1]
 
         self.pool = pooling_units.StochasticAbsPooling(
-            self, kx=cfg.pooling.kx, ky=cfg.pooling.ky,
+            self, name="pool%d" % (self.n_stages - 1),
+            kx=cfg.pooling.kx, ky=cfg.pooling.ky,
             sliding=tuple(cfg.pooling.sliding))
         self.pool.link_from(self.conv)
         self.pool.link_attrs(self.conv, ("input", "output"))
@@ -127,8 +156,10 @@ class ImagenetAEWorkflow(nn_units.NNWorkflow):
         self.evaluator.link_attrs(
             self.loader,
             ("batch_size", "minibatch_size"),
-            ("normalizer", "target_normalizer"),
-            ("target", "minibatch_data"))
+            ("normalizer", "target_normalizer"))
+        # reconstruct the LAST stage's input (reference imagenet_ae.py:
+        # 262 "target" <- last_conv "input") — the raw images for stage 0
+        self.evaluator.link_attrs(self.conv, ("target", "input"))
 
         self.decision = decision_units.DecisionMSE(
             self, fail_iterations=decision_cfg.get("fail_iterations", 20),
@@ -170,13 +201,44 @@ class ImagenetAEWorkflow(nn_units.NNWorkflow):
         return self.decision.epoch_metrics[2]
 
 
-def build(**kwargs):
-    return ImagenetAEWorkflow(**kwargs)
+def restore_stage_weights(snapshot_path, wf):
+    """Load the conv weights of EARLIER stages from a previous stage's
+    snapshot into a freshly-built (and initialized) workflow — the
+    growth step of stage-wise pretraining.  Only conv* units restore
+    (decision/loader/PRNG state starts fresh for the new stage), and a
+    geometry mismatch between the snapshot and the built conv fails
+    fast instead of deep inside the conv op."""
+    from znicz_tpu.core.snapshotter import SnapshotterToFile
+    from znicz_tpu.units.nn_units import load_snapshot_into_workflow
+    state = SnapshotterToFile.import_(snapshot_path)
+    units = {u.name: u for u in wf.units}
+    conv_states = {}
+    for name, ustate in state["units"].items():
+        if not name.startswith("conv") or name not in units:
+            continue
+        saved_w = ustate.get("weights")
+        built_w = units[name].weights
+        if saved_w is not None and built_w and \
+                tuple(saved_w.shape) != tuple(built_w.shape):
+            raise ValueError(
+                "%s: snapshot weights %s do not fit the built conv %s — "
+                "stage geometry changed since the snapshot"
+                % (name, saved_w.shape, built_w.shape))
+        conv_states[name] = ustate
+    load_snapshot_into_workflow({"units": conv_states}, wf)
+    return sorted(conv_states)
 
 
-def run_sample(device=None, **kwargs):
+def build(n_stages=1, **kwargs):
+    return ImagenetAEWorkflow(n_stages=n_stages, **kwargs)
+
+
+def run_sample(device=None, restore_snapshot=None, **kwargs):
     wf = build(**kwargs)
     wf.initialize(device=device)
+    if restore_snapshot:
+        names = restore_stage_weights(restore_snapshot, wf)
+        wf.info("restored stage weights: %s", ", ".join(names))
     wf.run()
     return wf
 
