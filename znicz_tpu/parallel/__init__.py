@@ -11,3 +11,5 @@ from znicz_tpu.parallel.mesh import make_mesh  # noqa: F401
 from znicz_tpu.parallel.fused import (  # noqa: F401
     FusedMLP, FusedNet, build_fc_specs, build_specs, flops_per_image)
 from znicz_tpu.parallel import multihost  # noqa: F401
+from znicz_tpu.parallel.sequence import (  # noqa: F401
+    attention_reference, ring_attention)
