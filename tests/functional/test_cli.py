@@ -167,3 +167,32 @@ def test_cli_optimize_validation(tmp_path):
             timeout=120)
         assert out.returncode != 0
         assert needle in out.stderr, (args, out.stderr[-500:])
+
+
+def test_list_includes_research_tier_and_manifests():
+    from znicz_tpu.samples import MANIFESTS
+    names = list_samples()
+    assert "research.alexnet" in names
+    assert "research.stl10" in names
+    # every manifest entry names a listable sample
+    for name in MANIFESTS:
+        assert name in names, name
+
+
+def test_resolver_surfaces_inner_import_errors(tmp_path):
+    """A fully-qualified module whose own imports fail must surface the
+    REAL ImportError, not retry under the samples namespace (review
+    regression)."""
+    import pytest as _pytest
+    bad = tmp_path / "badmod.py"
+    bad.write_text("from znicz_tpu import does_not_exist_symbol\n")
+    import sys as _sys
+    _sys.path.insert(0, str(tmp_path))
+    try:
+        with _pytest.raises(ImportError, match="does_not_exist_symbol"):
+            resolve_workflow_module("badmod")
+    finally:
+        _sys.path.remove(str(tmp_path))
+    # dotted research names still resolve via the fallback
+    m = resolve_workflow_module("research.wine_relu")
+    assert m.__name__.endswith("research.wine_relu")

@@ -111,8 +111,15 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     if args.list:
+        from znicz_tpu.samples import MANIFESTS
         for name in list_samples():
-            print(name)
+            meta = MANIFESTS.get(name)
+            if meta:
+                print("%-24s %-22s baseline: %s"
+                      % (name, meta["workflow"],
+                         meta["baseline"] or "-"))
+            else:
+                print(name)
         return 0
     if not args.workflow:
         parser.error("workflow required (or --list)")

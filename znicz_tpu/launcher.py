@@ -121,22 +121,32 @@ def resolve_workflow_module(spec):
     try:
         return importlib.import_module(spec)
     except ImportError as e:
-        # fall back to the samples namespace only when SPEC itself was not
-        # found — an ImportError raised INSIDE the module must surface
-        if e.name != spec:
+        # fall back to the samples namespace only when SPEC itself was
+        # not found (for dotted names like "research.stl10" the error
+        # names the unresolvable first component).  A spec already under
+        # the project namespace never falls back: its ImportErrors come
+        # from INSIDE the module and must surface.
+        first = spec.split(".")[0]
+        if spec.startswith("znicz_tpu") or \
+                e.name not in (spec, first) or first == "znicz_tpu":
             raise
         return importlib.import_module("znicz_tpu.samples." + spec)
 
 
 def list_samples():
-    """Registered sample names (modules under znicz_tpu.samples that
-    expose the run contract)."""
+    """Registered sample names (modules under znicz_tpu.samples,
+    including the research tier as ``research.<name>``)."""
     import znicz_tpu.samples as samples_pkg
-    names = []
     pkg_dir = os.path.dirname(samples_pkg.__file__)
-    for fn in sorted(os.listdir(pkg_dir)):
-        if fn.endswith(".py") and not fn.startswith("_"):
-            names.append(fn[:-3])
+    names = []
+    for prefix, directory in (("", pkg_dir),
+                              ("research.",
+                               os.path.join(pkg_dir, "research"))):
+        if not os.path.isdir(directory):
+            continue
+        for fn in sorted(os.listdir(directory)):
+            if fn.endswith(".py") and not fn.startswith("_"):
+                names.append(prefix + fn[:-3])
     return names
 
 
