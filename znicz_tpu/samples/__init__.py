@@ -61,4 +61,7 @@ MANIFESTS = {
     "research.imagenet_ae": {"workflow": "ImagenetAEWorkflow",
                              "config": "root.imagenet_ae",
                              "baseline": "55.29 pt"},
+    "research.long_context": {"workflow": "(pure-jax ring attention)",
+                              "config": "root.long_context",
+                              "baseline": None},
 }
