@@ -146,7 +146,22 @@ void TestTanhLogActivation() {
                               0.242528761112f, 1e-4);
 }
 
+
+void TestMulActivation() {
+  auto unit = znicz::CreateUnit("activation_mul");
+  znicz::Tensor f;
+  f.shape = {1};
+  f.data = {0.5f};
+  unit->SetParameter("factor", f);
+  znicz::Tensor out;
+  unit->Execute(T({1, 3}, {2.f, -4.f, 6.f}), &out);
+  CHECK_NEAR(out.data[0], 1.f, 1e-6);
+  CHECK_NEAR(out.data[1], -2.f, 1e-6);
+  CHECK_NEAR(out.data[2], 3.f, 1e-6);
+}
+
 int main() {
+  TestMulActivation();
   TestConvSpatial();
   TestPoolingOverhang();
   TestTanhLogActivation();

@@ -379,6 +379,9 @@ void Activation::Execute(const Tensor& in, Tensor* out) const {
     // global flat index parity (ops/activations.py sincos)
     for (size_t i = 0; i < n; ++i)
       y[i] = (i % 2 == 1) ? std::sin(x[i]) : std::cos(x[i]);
+  } else if (kind_ == "mul") {
+    const float factor = Scalar("factor", 1.f);
+    for (size_t i = 0; i < n; ++i) y[i] = x[i] * factor;
   } else {
     throw std::runtime_error("unsupported activation kind: " + kind_);
   }

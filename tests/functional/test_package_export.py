@@ -378,3 +378,72 @@ def test_cpp_ctypes_nhwc_binding(tmp_path):
     y_py = run_package_numpy(pkg, x)
     assert numpy.abs(out - y_py).max() < 1e-4
     lib.znicz_free(ctypes.c_void_p(handle))
+
+
+def test_mul_activation_exports_and_replays(tmp_path):
+    """activation_mul's (auto-set) factor travels through the package:
+    numpy runner and the C++ runtime both honor it."""
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "activation_mul", "factor": 0.5},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"prefix": "mul", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    pkg = str(tmp_path / "mul.zip")
+    export_package(wf, pkg)
+    manifest, _ = load_package(pkg)
+    entry = [l for l in manifest["layers"]
+             if l["type"] == "activation_mul"][0]
+    assert float(entry["factor"]) == 0.5
+
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (10, 13)).astype(numpy.float32)
+    y_pkg = run_package_numpy(pkg, x)
+    y_py = _python_forward(wf, x)
+    assert numpy.abs(y_pkg - y_py).max() < 1e-5
+
+    build = _build_cpp()
+    in_npy, out_npy = str(tmp_path / "i.npy"), str(tmp_path / "o.npy")
+    numpy.save(in_npy, x)
+    res = subprocess.run(
+        [os.path.join(build, "znicz_infer"), pkg, in_npy, out_npy],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    assert numpy.abs(numpy.load(out_npy) - y_pkg).max() < 1e-5
+
+
+def test_mul_export_refuses_unset_factor(tmp_path):
+    """Exporting an activation_mul whose factor was never set must fail
+    loudly (review regression: runners would otherwise diverge)."""
+    import pytest as _pytest
+    from znicz_tpu.core.workflow import DummyWorkflow
+    from znicz_tpu.units.activation import ForwardMul
+    from znicz_tpu.units.all2all import All2AllTanh
+    from znicz_tpu.core.memory import Array
+    from znicz_tpu.core import prng as _prng
+
+    wf = DummyWorkflow()
+    fwd = All2AllTanh(wf, output_sample_shape=4, weights_stddev=0.05,
+                      bias_stddev=0.05,
+                      rand=_prng.RandomGenerator().seed(3))
+    fwd.input = Array(numpy.zeros((2, 5), numpy.float32))
+    fwd.initialize(NumpyDevice())
+    mul = ForwardMul(wf)  # factor unset, never ran
+    mul.input = fwd.output
+    mul.initialize(NumpyDevice())
+    wf.forwards = [fwd, mul]
+    with _pytest.raises(ValueError, match="factor is unset"):
+        export_package(wf, str(tmp_path / "bad.zip"))

@@ -52,6 +52,15 @@ def export_package(workflow, path):
                 if isinstance(value, (tuple, set, frozenset)):
                     value = list(value)
                 entry[attr] = value
+        if entry["type"] == "activation_mul" and \
+                entry.get("factor") is None:
+            # exporting before the first minibatch auto-sets the factor
+            # would make the runners disagree (numpy: KeyError; C++:
+            # silent identity) — refuse loudly instead
+            raise ValueError(
+                "%s: activation_mul factor is unset — run at least one "
+                "minibatch (or pass factor=) before exporting"
+                % entry["name"])
         layers.append(entry)
     manifest = {
         "format": 1,
@@ -166,6 +175,8 @@ def run_package_numpy(path, x):
             y = norm_ops.lrn_forward_numpy(
                 y, alpha=float(entry["alpha"]), beta=float(entry["beta"]),
                 k=float(entry["k"]), n=int(entry["n"]))
+        elif tpe == "activation_mul":
+            y = y * float(entry["factor"])
         elif tpe.startswith("activation_"):
             act = {"activation_tanh": "tanh", "activation_sigmoid":
                    "sigmoid", "activation_relu": "relu",

@@ -197,6 +197,8 @@ class ForwardMul(ActivationForward):
     def __init__(self, workflow, **kwargs):
         super(ForwardMul, self).__init__(workflow, **kwargs)
         self._factor = kwargs.get("factor")
+        # deployment packages need the (auto-set) factor
+        self.exports.append("factor")
 
     @property
     def factor(self):
