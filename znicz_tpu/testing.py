@@ -1,0 +1,164 @@
+"""User-facing test harness.
+
+TPU-era equivalent of the reference's ``veles.tests`` helpers
+(SURVEY.md §2.9: AcceleratedTest / assign_backend / timeout /
+multi_device / doubling_reset) — the utilities unit authors use to test
+their own units the way the framework tests its:
+
+* :func:`run_both_backends` — build + run a unit on the numpy AND jax
+  devices from one factory, compare every declared output;
+* :func:`assert_rerun_stable` — the doubling_reset idea: running a unit
+  twice on the same inputs must give identical outputs (catches hidden
+  state leaking between runs);
+* :func:`multi_device_mesh` — the 8-way virtual CPU mesh used for
+  sharding tests (no-op when enough real devices exist);
+* :class:`AcceleratedTest` — unittest base wiring the above plus a
+  per-test timeout.
+"""
+
+import functools
+import os
+import threading
+import unittest
+
+import numpy
+
+from znicz_tpu.core.backends import JaxDevice, NumpyDevice
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.workflow import DummyWorkflow
+
+
+def _collect_outputs(unit, attrs):
+    out = {}
+    for attr in attrs:
+        value = getattr(unit, attr, None)
+        if isinstance(value, Array) and value:
+            value.map_read()
+            out[attr] = numpy.array(value.mem)
+    return out
+
+
+def run_both_backends(build, outputs=("output",), atol=1e-6):
+    """Build + run a unit per backend and compare its outputs.
+
+    ``build(workflow, device)`` constructs, initializes, and returns the
+    unit (call ``unit.initialize(device)`` inside).  Every attr in
+    ``outputs`` present as a non-empty Array is compared.  Returns the
+    numpy-side outputs dict.
+    """
+    results = {}
+    for name, device in (("numpy", NumpyDevice()), ("jax", JaxDevice())):
+        wf = DummyWorkflow()
+        unit = build(wf, device)
+        unit.run()
+        results[name] = _collect_outputs(unit, outputs)
+    missing = set(results["numpy"]) ^ set(results["jax"])
+    if missing:
+        raise AssertionError(
+            "backends disagree on which outputs exist: %s" % missing)
+    if not results["numpy"]:
+        raise AssertionError(
+            "no outputs to compare — none of %r is a non-empty Array "
+            "on the unit (typo in the outputs tuple?)" % (outputs,))
+    for attr, want in results["numpy"].items():
+        got = results["jax"][attr]
+        if want.shape != got.shape:
+            raise AssertionError(
+                "%s shape differs between backends: %s vs %s"
+                % (attr, want.shape, got.shape))
+        diff = numpy.abs(want.astype(numpy.float64) -
+                         got.astype(numpy.float64)).max()
+        if not diff <= atol:  # NaN must FAIL, not slip past `>`
+            raise AssertionError(
+                "%s differs between backends: max |delta| = %g > %g"
+                % (attr, diff, atol))
+    return results["numpy"]
+
+
+def assert_rerun_stable(unit, outputs=("output",)):
+    """Run ``unit`` twice; outputs must be IDENTICAL (the reference's
+    doubling_reset contract — hidden state must not leak into reruns)."""
+    unit.run()
+    first = _collect_outputs(unit, outputs)
+    unit.run()
+    second = _collect_outputs(unit, outputs)
+    if not first:
+        raise AssertionError(
+            "no outputs to compare — none of %r is a non-empty Array "
+            "on the unit (typo in the outputs tuple?)" % (outputs,))
+    for attr, want in first.items():
+        got = second[attr]
+        if not numpy.array_equal(want, got):
+            raise AssertionError(
+                "%s changed on re-run: the unit leaks state" % attr)
+
+
+def multi_device_mesh(n=8, model_parallel=1):
+    """An n-device mesh for sharding tests.  Uses the real devices when
+    enough exist; otherwise requires the virtual CPU platform (set
+    XLA_FLAGS=--xla_force_host_platform_device_count=N before jax
+    initializes — tests/conftest.py shows the recipe)."""
+    import jax
+    from znicz_tpu.parallel import make_mesh
+    if len(jax.devices()) < n:
+        raise unittest.SkipTest(
+            "need %d devices; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=%d and "
+            "JAX_PLATFORMS=cpu before the first jax use" % (n, n))
+    return make_mesh(n, model_parallel=model_parallel)
+
+
+def timeout(seconds):
+    """Fail (don't hang) when a test exceeds ``seconds`` — the reference
+    tests' @timeout decorator."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            result = {}
+
+            def target():
+                try:
+                    result["value"] = fn(*args, **kwargs)
+                except BaseException as e:  # propagated below
+                    result["error"] = e
+
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            t.join(seconds)
+            if t.is_alive():
+                raise AssertionError(
+                    "%s exceeded %ss timeout" % (fn.__name__, seconds))
+            if "error" in result:
+                raise result["error"]
+            return result.get("value")
+        return wrapper
+    return deco
+
+
+class AcceleratedTest(unittest.TestCase):
+    """unittest base for unit authors: seeded PRNGs, both devices, the
+    comparison helpers as methods, and every test_* method wrapped in
+    the class TIMEOUT (override or set ZNICZ_TEST_TIMEOUT)."""
+
+    TIMEOUT = float(os.environ.get("ZNICZ_TEST_TIMEOUT", 300))
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        for name, fn in list(vars(cls).items()):
+            if name.startswith("test") and callable(fn):
+                setattr(cls, name, timeout(cls.TIMEOUT)(fn))
+
+    def setUp(self):
+        from znicz_tpu.core import prng
+        prng.get(1).seed(1234)
+        prng.get(2).seed(5678)
+        self.numpy_device = NumpyDevice()
+        self.jax_device = JaxDevice()
+        self.workflow = DummyWorkflow()
+
+    def assertBackendsAgree(self, build, outputs=("output",),
+                            atol=1e-6):
+        return run_both_backends(build, outputs=outputs, atol=atol)
+
+    def assertRerunStable(self, unit, outputs=("output",)):
+        assert_rerun_stable(unit, outputs=outputs)
