@@ -244,3 +244,100 @@ def test_rprop_trains():
     gd_u.run()
     w2 = numpy.array(fwd.weights.mem)
     assert numpy.abs(w2 - w1).max() > 0
+
+
+def test_mean_disp_normalizer_unit():
+    """(input - mean) * rdisp per minibatch, both backends (reference
+    veles.mean_disp_normalizer)."""
+    import numpy
+    import pytest
+    from znicz_tpu import testing as zt
+    from znicz_tpu.core.memory import Array
+    from znicz_tpu.units.mean_disp_normalizer import MeanDispNormalizer
+
+    r = numpy.random.RandomState(3)
+    x = r.uniform(0, 255, (4, 5, 5, 2)).astype(numpy.float32)
+    mean = x.mean(axis=0)
+    rdisp = 1.0 / (x.std(axis=0) + 1.0)
+
+    def build(wf, device):
+        unit = MeanDispNormalizer(wf)
+        unit.input = Array(x.copy())
+        unit.mean = Array(mean.copy())
+        unit.rdisp = Array(rdisp.copy())
+        unit.initialize(device)
+        return unit
+
+    outs = zt.run_both_backends(build, atol=1e-5)
+    want = (x - mean) * rdisp
+    assert numpy.abs(outs["output"] - want).max() < 1e-5
+
+    # shape validation fails fast
+    from znicz_tpu.core.workflow import DummyWorkflow
+    from znicz_tpu.core.backends import NumpyDevice
+    bad = MeanDispNormalizer(DummyWorkflow())
+    bad.input = Array(x.copy())
+    bad.mean = Array(mean[:2].copy())
+    bad.rdisp = Array(rdisp.copy())
+    with pytest.raises(ValueError):
+        bad.initialize(NumpyDevice())
+
+
+def test_std_workflow_meandispnorm_and_gd_diff_stats_linkers(tmp_path):
+    """The two remaining reference linkers wire into a real training
+    run: meandispnorm normalizes what the forwards see, gd_diff_stats
+    records gradient statistics."""
+    import numpy
+    import znicz_tpu.loader.loader_mnist  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.1}},
+        ],
+        loader_name="mnist_loader",
+        loader_config={"synthetic_train": 60, "synthetic_valid": 30,
+                       "minibatch_size": 30,
+                       "normalization_type": "none"},
+        decision_config={"max_epochs": 2, "fail_iterations": 10},
+        snapshotter_config={"prefix": "mdn", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)},
+        preprocessing=True)
+    wf.link_repeater(wf.start_point)
+    wf.link_loader(wf.repeater)
+    # the loader serves raw data; attach mean/rdisp computed on it
+    ldr = wf.loader
+    ldr.initialize()
+    from znicz_tpu.core.memory import Array
+    data = ldr.original_data.mem
+    ldr.mean = Array(data.mean(axis=0).astype(numpy.float32))
+    rdisp = 1.0 / (data.std(axis=0) + 1.0)
+    ldr.rdisp = Array(rdisp.astype(numpy.float32))
+    norm = wf.link_meandispnorm(wf.loader)
+    wf.link_forwards(("input", "output"), norm)
+    wf.link_evaluator(wf.forwards[-1])
+    wf.link_decision(wf.evaluator)
+    wf.link_snapshotter(wf.decision)
+    last_gd = wf.link_gds(wf.snapshotter)
+    stats = wf.link_gd_diff_stats(last_gd,
+                                  file_name=str(tmp_path / "ds.pickle"))
+    wf.link_loop(stats)
+    wf.link_end_point(stats)
+    wf.initialize()
+    wf.run()
+    assert wf.decision.epoch_number >= 2
+    # the probe recorded gradient stats for the gd units
+    assert stats.history
+    rec = stats.history[-1]
+    assert any("gradient_weights" in v for v in rec.values())
+    # duplicate-type layers now get unique names, and the stats file is
+    # flushed at workflow finish
+    import os
+    assert os.path.exists(str(tmp_path / "ds.pickle"))
+    names = [u.name for u in wf.gds]
+    assert len(set(names)) == len(names)

@@ -65,7 +65,7 @@ class StandardWorkflow(StandardWorkflowBase):
         first_gd = None
         units_to_delete = []
         for i, layer in reversed(list(enumerate(self.layers))):
-            tpe, _, kwargs = self._get_layer_type_kwargs(layer)
+            tpe, _, kwargs = self._get_layer_type_kwargs(layer, i)
             if not isinstance(self.forwards[i], self.layer_map[tpe].forward):
                 raise TypeError(
                     "Forward layer %s at position %d is not an instance "
@@ -417,6 +417,33 @@ class StandardWorkflow(StandardWorkflowBase):
         self.real_loader = real
         self.loader = avatar
         return avatar
+
+    def link_meandispnorm(self, *parents):
+        """On-the-fly minibatch normalization from the loader's
+        mean/rdisp arrays (reference standard_workflow.py:603-624);
+        wire the forwards from its ("input", "output")."""
+        from znicz_tpu.units.mean_disp_normalizer import \
+            MeanDispNormalizer
+        self.meandispnorm = MeanDispNormalizer(self, name="meandispnorm")
+        self.meandispnorm.link_attrs(
+            self.loader, ("input", "minibatch_data"), "mean", "rdisp")
+        self.meandispnorm.link_from(*parents)
+        return self.meandispnorm
+
+    def link_gd_diff_stats(self, *parents, **kwargs):
+        """Gradient-statistics probe over the backward chain
+        (reference standard_workflow.py:626-646).  The history is
+        flushed to ``file_name`` when the workflow finishes."""
+        from znicz_tpu.units.diff_stats import DiffStats
+        kwargs.setdefault("arrays",
+                          {u: ("gradient_weights",)
+                           for u in self.gds if u is not None})
+        self.gd_diff_stats = DiffStats(self, name="gd_diff_stats",
+                                       **kwargs)
+        self.gd_diff_stats.link_from(*parents)
+        self.gd_diff_stats.gate_skip = self.decision.gd_skip
+        self.on_workflow_finished(self.gd_diff_stats.flush)
+        return self.gd_diff_stats
 
     def link_downloader(self, *parents, **kwargs):
         """(reference standard_workflow.py:407-411)"""

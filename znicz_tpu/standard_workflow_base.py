@@ -150,7 +150,7 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
         return kwargs
 
     # -- layer instantiation ------------------------------------------------
-    def _get_layer_type_kwargs(self, layer):
+    def _get_layer_type_kwargs(self, layer, index=None):
         """Split one layer dict into (type, forward kwargs, backward kwargs)
         (reference standard_workflow_base.py:406-422)."""
         tpe = layer.get("type", "").strip()
@@ -167,6 +167,13 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
         if "name" in layer:
             kwargs_forward["name"] = layer["name"] + "_forward"
             kwargs_backward["name"] = "gd_" + layer["name"]
+        elif index is not None:
+            # unnamed layers get INDEX-unique names: class-name defaults
+            # collide for duplicate layer types, silently merging their
+            # snapshot state and any per-unit stats keyed by name
+            kwargs_forward.setdefault("name", "%s_%d_forward"
+                                      % (tpe, index))
+            kwargs_backward.setdefault("name", "gd_%s_%d" % (tpe, index))
         return tpe, kwargs_forward, kwargs_backward
 
     # -- graph construction -------------------------------------------------
@@ -186,8 +193,8 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
     def link_forwards(self, init_attrs, *parents):
         """Create + chain forward units (reference 272-336)."""
         del self.forwards[:]
-        for layer in self.layers:
-            tpe, kwargs, _ = self._get_layer_type_kwargs(layer)
+        for index, layer in enumerate(self.layers):
+            tpe, kwargs, _ = self._get_layer_type_kwargs(layer, index)
             if not self.layer_map[tpe].has_forward:
                 raise ValueError("no Forward registered for %r" % tpe)
             unit = self.layer_map[tpe].forward(self, **kwargs)

@@ -1,0 +1,52 @@
+"""MeanDispNormalizer unit — on-the-fly minibatch normalization.
+
+TPU-era equivalent of ``veles.mean_disp_normalizer.MeanDispNormalizer``
+(SURVEY.md §2.9; wired by the reference's link_meandispnorm,
+standard_workflow.py:603-624): streams ``output = (input - mean) *
+rdisp`` per minibatch from loader-provided mean / reciprocal-dispersion
+arrays — the normalization stage for loaders that serve RAW data (the
+imagenet loader's mean file) instead of normalizing a full batch up
+front.
+"""
+
+import numpy
+
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.memory import Array
+
+
+class MeanDispNormalizer(AcceleratedUnit):
+    """demand: input (B, *sample), mean (*sample), rdisp (*sample)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MeanDispNormalizer, self).__init__(workflow, **kwargs)
+        self.output = Array(name="output")
+        self.demand("input", "mean", "rdisp")
+
+    def initialize(self, device=None, **kwargs):
+        super(MeanDispNormalizer, self).initialize(device=device,
+                                                   **kwargs)
+        if tuple(self.mean.shape) != tuple(self.input.shape[1:]):
+            raise ValueError(
+                "mean shape %s != sample shape %s"
+                % (self.mean.shape, self.input.shape[1:]))
+        if tuple(self.rdisp.shape) != tuple(self.mean.shape):
+            raise ValueError("rdisp shape %s != mean shape %s"
+                             % (self.rdisp.shape, self.mean.shape))
+        if (not self.output or
+                self.output.shape != tuple(self.input.shape)):
+            self.output.reset(numpy.zeros(self.input.shape,
+                                          numpy.float32))
+
+    def numpy_run(self):
+        self.input.map_read()
+        self.mean.map_read()
+        self.rdisp.map_read()
+        self.output.map_invalidate()
+        x = self.input.mem.astype(numpy.float32)
+        self.output.mem[...] = (x - self.mean.mem) * self.rdisp.mem
+
+    def jax_run(self):
+        import jax.numpy as jnp
+        x = self.input.dev.astype(jnp.float32)
+        self.output.set_dev((x - self.mean.dev) * self.rdisp.dev)
