@@ -196,3 +196,18 @@ def test_resolver_surfaces_inner_import_errors(tmp_path):
     # dotted research names still resolve via the fallback
     m = resolve_workflow_module("research.wine_relu")
     assert m.__name__.endswith("research.wine_relu")
+
+
+def test_every_manifest_sample_dry_runs():
+    """Zoo integrity: every sample in MANIFESTS builds + initializes
+    through the launcher contract (dry run — no training).  Catches
+    registration/config regressions across the whole zoo in one sweep."""
+    from znicz_tpu.samples import MANIFESTS
+    # pure-jax demo trains inside run() itself; everything else dry-runs
+    skip = {"research.long_context"}
+    for name in sorted(MANIFESTS):
+        if name in skip:
+            continue
+        wf = run_workflow(name, dry_run=True)
+        assert wf is not None, name
+        assert wf.initialized, name
