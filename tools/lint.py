@@ -15,7 +15,7 @@ MAX_LINE = 80
 LIB_DIRS = ("znicz_tpu",)
 SCAN_DIRS = ("znicz_tpu", "tests", "tools")
 SKIP_PARTS = ("__pycache__",)
-PRINT_OK = ("samples", "__main__.py", "launcher.py")
+PRINT_OK = ("samples", "__main__.py", "launcher.py", "parity.py")
 
 
 def iter_py(root):

@@ -58,6 +58,17 @@ def run_genetics(module, spec):
             "--optimize needs Range(...) values in the config; e.g. "
             'root.myns.learning_rate = Range(0.01, 0.001, 0.1)')
 
+    # fused population path: samples exposing population_evaluator(sites)
+    # train the whole generation as ONE vmapped XLA computation
+    evaluate_population = None
+    factory = getattr(module, "population_evaluator", None)
+    if factory is not None:
+        try:
+            evaluate_population = factory(enumerate_ranges(root))
+        except Exception as e:  # fall back to serial evaluations
+            print("population evaluator unavailable (%s); evaluating "
+                  "serially" % e)
+
     def evaluate(_cfg):
         wf = run_workflow(module)
         decision = getattr(wf, "decision", None)
@@ -72,7 +83,8 @@ def run_genetics(module, spec):
         return -float(err)
 
     opt = GeneticsOptimizer(evaluate, root, generations=gens,
-                            population_size=pop)
+                            population_size=pop,
+                            evaluate_population=evaluate_population)
     values, fitness = opt.run()
     print("best fitness (-err%%): %.4f" % fitness)
     for (container, key, rng), value in zip(opt.sites, values):
@@ -106,6 +118,11 @@ def main(argv=None):
                              "values in the config (e.g. 4x8 = 4 "
                              "generations, population 8); fitness is "
                              "-validation error")
+    parser.add_argument("--parity", action="store_true",
+                        help="real-data accuracy parity run: provision "
+                             "the dataset (network required), train the "
+                             "published config, print the BASELINE.md "
+                             "comparison row")
     parser.add_argument("--list", action="store_true",
                         help="list bundled samples and exit")
     args = parser.parse_args(argv)
@@ -129,6 +146,16 @@ def main(argv=None):
     module = resolve_workflow_module(args.workflow)
     for assignment in args.config:
         apply_override(root, assignment)
+    if args.parity:
+        if args.optimize or args.snapshot or args.testing or \
+                args.dry_run or args.dump_graph:
+            parser.error("--parity runs the published training config "
+                         "standalone")
+        from znicz_tpu import parity
+        # the module is already resolved — accept any spelling the CLI
+        # accepts ('mnist', 'znicz_tpu.samples.mnist', 'samples/mnist.py')
+        parity.run_parity(module.__name__.rsplit(".", 1)[-1])
+        return 0
     if args.optimize:
         if args.snapshot or args.testing or args.dry_run or \
                 args.dump_graph:

@@ -111,8 +111,14 @@ class GeneticsOptimizer(object):
 
     def __init__(self, evaluate, config, population_size=8,
                  generations=5, crossover_rate=0.7, mutation_rate=0.15,
-                 rand=None):
+                 rand=None, evaluate_population=None):
         self.evaluate = evaluate
+        #: optional batch evaluator: ``[value_vector, ...] -> [fitness]``
+        #: — evaluates a whole generation CONCURRENTLY (e.g. one vmapped
+        #: XLA computation training every individual at once on the
+        #: fused path).  The reference sprayed evaluations across a
+        #: cluster (SURVEY.md §3.5); on TPU the population batches.
+        self.evaluate_population = evaluate_population
         self.config = config
         self.sites = enumerate_ranges(config)
         if not self.sites:
@@ -168,6 +174,28 @@ class GeneticsOptimizer(object):
         self._fitness_cache[key] = fitness
         return fitness
 
+    def _fitness_many(self, population):
+        """Fitness of a whole generation — batched when an
+        ``evaluate_population`` callback exists, per-individual
+        otherwise; memoized either way (elites must not re-train)."""
+        if self.evaluate_population is None:
+            return [self._fitness_of(ind) for ind in population]
+        missing, seen = [], set()
+        for ind in population:
+            key = tuple(ind)
+            if key not in self._fitness_cache and key not in seen:
+                seen.add(key)
+                missing.append(list(ind))
+        if missing:
+            values = self.evaluate_population(missing)
+            if len(values) != len(missing):
+                raise ValueError(
+                    "evaluate_population returned %d fitnesses for %d "
+                    "individuals" % (len(values), len(missing)))
+            for ind, fit in zip(missing, values):
+                self._fitness_cache[tuple(ind)] = float(fit)
+        return [self._fitness_cache[tuple(ind)] for ind in population]
+
     # -- driver -------------------------------------------------------------
     def run(self):
         """Evolve; returns (best_values, best_fitness)."""
@@ -177,7 +205,7 @@ class GeneticsOptimizer(object):
             for _ in range(self.population_size - 1)]
         try:
             for gen in range(self.generations):
-                fitness = [self._fitness_of(ind) for ind in population]
+                fitness = self._fitness_many(population)
                 order = int(numpy.argmax(fitness))
                 if fitness[order] > self.best_fitness:
                     self.best_fitness = fitness[order]

@@ -99,12 +99,26 @@ class Array(object):
     # -- device side --------------------------------------------------------
     @property
     def dev(self):
-        """Device jax.Array (uploads host if the host copy is newer)."""
+        """Device jax.Array (uploads host if the host copy is newer).
+
+        On the CPU backend the upload hands the device a PRIVATE copy:
+        ``jax.device_put`` of a numpy array is zero-copy there (the
+        jax.Array aliases the host buffer), so an in-place host write —
+        e.g. the loader refilling ``minibatch_data`` for the next
+        minibatch — would otherwise race with still-pending async
+        computations that read this value.  The copy is what makes the
+        reference's map/unmap ownership contract actually hold under
+        jax's async dispatch.  Accelerator backends DMA a copy into
+        device memory anyway, so no extra host copy is paid there.
+        """
         import jax
         if self._state == HOST:
             if self._host is None:
                 return None
-            self._dev = jax.device_put(self._host)
+            host = self._host
+            if jax.default_backend() == "cpu":
+                host = numpy.array(host)
+            self._dev = jax.device_put(host)
             self._state = SYNC
         return self._dev
 

@@ -86,12 +86,34 @@ def backward_jax(inp, err_output, weights, ky, kx, padding, sliding,
                                    "out_shape"))
 def deconv_forward_jax(x, weights, ky, kx, padding, sliding, out_shape):
     """Transposed conv: the col2im scatter of ``x @ W`` (reference
-    deconv.py — the forward is the conv's err_input computation)."""
-    w4 = _w4(weights, ky, kx, out_shape[3])
-    zeros = jnp.zeros(out_shape, dtype=x.dtype)
+    deconv.py — the forward is the conv's err_input computation).
+
+    Matches the numpy twin's scatter-crop semantics for ANY geometry:
+    window (i, j) lands at canvas position (i*stride, j*stride) of a
+    (top + H + bottom, left + W + right) canvas, then the padding margins
+    are cropped away.  The reference AE stages produce geometries where
+    the conv of out_shape with this padding does NOT reproduce (ny, nx)
+    (e.g. MnistAE's 24->28 with padding 4), so a plain conv-VJP over
+    out_shape would shape-error; the scatter formulation is the spec
+    (deconv.py col2im + crop)."""
+    b, ny, nx, _ = x.shape
+    left, top, right, bottom = padding
+    c = out_shape[3]
+    # exact-geometry canvas for the windows, via the conv VJP (lowers to
+    # the XLA transposed-conv path — no explicit gathers)
+    sy_eff = (ny - 1) * sliding[1] + ky
+    sx_eff = (nx - 1) * sliding[0] + kx
+    w4 = _w4(weights, ky, kx, c)
+    zeros = jnp.zeros((b, sy_eff, sx_eff, c), dtype=x.dtype)
     _, vjp = jax.vjp(
-        lambda z: _conv_linear_jax(z, w4, padding, sliding), zeros)
-    return vjp(x)[0]
+        lambda z: _conv_linear_jax(z, w4, (0, 0, 0, 0), sliding), zeros)
+    canvas = vjp(x)[0]
+    H, W = out_shape[1], out_shape[2]
+    pad_y = max(0, top + H - sy_eff)
+    pad_x = max(0, left + W - sx_eff)
+    if pad_y or pad_x:
+        canvas = jnp.pad(canvas, ((0, 0), (0, pad_y), (0, pad_x), (0, 0)))
+    return canvas[:, top:top + H, left:left + W, :]
 
 
 @partial(jax.jit, static_argnames=("batch_ny_nx", "ky", "kx", "padding",
@@ -101,10 +123,10 @@ def deconv_hits_jax(batch_ny_nx, ky, kx, padding, sliding, out_shape):
     unsafe padding)."""
     b, ny, nx = batch_ny_nx
     w1 = jnp.ones((1, ky, kx, 1))
-    zeros = jnp.zeros((b, out_shape[1], out_shape[2], 1))
-    _, vjp = jax.vjp(
-        lambda z: _conv_linear_jax(z, w1, padding, sliding), zeros)
-    return vjp(jnp.ones((b, ny, nx, 1)))[0][:, :, :, 0]
+    x1 = jnp.ones((b, ny, nx, 1))
+    return deconv_forward_jax(
+        x1, w1.reshape(1, -1), ky, kx, padding, sliding,
+        (b, out_shape[1], out_shape[2], 1))[:, :, :, 0]
 
 
 def deconv_forward_numpy(x, weights, ky, kx, padding, sliding, out_shape):

@@ -1,0 +1,76 @@
+"""Recurrent sequence drivers — the LSTM unroll as ONE compiled scan.
+
+The unit graph runs the LSTM cell sub-workflow (units/lstm.py, reference
+lstm.py:52-144) once per timestep — a separate graph pass each step.
+TPU-first sequence training unrolls inside the compiled computation:
+``lstm_scan_jax`` carries (h, c) through ``lax.scan``, so T timesteps
+are one XLA program with one compile, and the whole unroll is
+differentiable end to end (``jax.grad`` through the scan replaces the
+per-step GDLSTM chain).
+
+Math parity with the cell sub-workflow (verified to 1e-12 by
+tests/unit/test_lstm_scan.py):
+
+* joined input z = [x, h_prev] (InputJoiner order, lstm.py:71);
+* gates use the framework's activations — the reference's SCALED tanh
+  (1.7159 tanh(2x/3), all2all.py:271) for the memory maker and the
+  output squash, plain sigmoid for the three gates;
+* c = i * g + f * c_prev;  y = o * tanh_act(c)  (simple=True topology —
+  the output gate reads z, not the memory cell).
+"""
+
+from functools import partial
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.ops import activations
+
+#: gate order in the packed parameter dict
+GATES = ("input_gate", "forget_gate", "memory_maker", "output_gate")
+
+
+def lstm_cell_jax(params, x, h, c):
+    """One cell step.  ``params``: {gate: {"w": (hidden, in+hidden),
+    "b": (hidden,)}} in the All2All layout (y = z @ W.T + b)."""
+    z = jnp.concatenate([x, h], axis=1)
+
+    def gate(name, act):
+        p = params[name]
+        return activations.apply_jax(act, z @ p["w"].T + p["b"])
+
+    i = gate("input_gate", "sigmoid")
+    f = gate("forget_gate", "sigmoid")
+    g = gate("memory_maker", "tanh")
+    o = gate("output_gate", "sigmoid")
+    c_new = i * g + f * c
+    y = o * activations.apply_jax("tanh", c_new)
+    return y, c_new
+
+
+@partial(jax.jit, static_argnames=())
+def lstm_scan_jax(params, xs, h0, c0):
+    """Unroll the cell over ``xs`` (T, B, in) in one compiled scan.
+
+    Returns (ys, h_T, c_T) with ys stacked (T, B, hidden).
+    """
+    def body(carry, x):
+        h, c = carry
+        y, c = lstm_cell_jax(params, x, h, c)
+        return (y, c), y
+
+    (h, c), ys = jax.lax.scan(body, (h0, c0), xs)
+    return ys, h, c
+
+
+def params_from_cell(cell):
+    """Extract the packed parameter pytree from a built
+    :class:`znicz_tpu.units.lstm.LSTM` cell (host numpy)."""
+    out = {}
+    for name in GATES:
+        unit = getattr(cell, name)
+        out[name] = {"w": numpy.array(unit.weights.mem),
+                     "b": numpy.array(unit.bias.mem)}
+    return out

@@ -67,6 +67,20 @@ DEFAULT_HYPER = dict(lr=0.01, wd=0.00005, l1_vs_l2=0.0, moment=0.0,
                      factor_ortho=0.0)
 
 
+def layer_hyper(layer, defaults=None):
+    """(hyper, hyper_bias, flags) for one layer dict — the same parse
+    ``build_specs`` runs: shared top-level keys merged under the "<-"
+    backward kwargs (the reference routes shared kwargs to both sides,
+    standard_workflow_base.py:406-422)."""
+    layer = dict(layer)
+    for k in ("type", "name", "->"):
+        layer.pop(k, None)
+    bwd = dict(layer.pop("<-", {}))
+    merged = dict(layer)
+    merged.update(bwd)
+    return _parse_hyper(merged, dict(DEFAULT_HYPER, **(defaults or {})))
+
+
 def _parse_hyper(bwd, defaults):
     """Extract (hyper, hyper_bias, flags) from a layer's "<-" dict —
     the reference backward-kwargs contract (standard_workflow_base.py:
@@ -180,7 +194,15 @@ class ConvSpec:
 class PoolSpec:
     """max / maxabs / avg pooling (reference pooling.py ceil-mode
     geometry; winner-take-all gradient comes from the VJP of the gather —
-    the same scatter-add the unit path runs, gd_pooling.py:233-247)."""
+    the same scatter-add the unit path runs, gd_pooling.py:233-247).
+
+    ``impl`` selects the max-pool lowering: "reduce_window" (XLA
+    select-and-scatter — the TPU-native path, ~100x the gather
+    formulation on a v5e; tie routing implementation-defined) or
+    "gather" (argmax + gather; gradient scatters to the FIRST maximum —
+    bit-parity with the unit path even on tied windows, e.g. flat image
+    regions; the float64 parity/golden tests use it).  avg always uses
+    reduce_window (no ties to break)."""
     type: str
     in_shape: tuple
     out_shape: tuple
@@ -188,6 +210,7 @@ class PoolSpec:
     kx: int
     ky: int
     sliding: tuple
+    impl: str = "reduce_window"
 
     kind = "pool"
     is_softmax = False
@@ -217,6 +240,44 @@ class ActivationSpec:
     activation: str = "linear"
 
     kind = "activation"
+    is_softmax = False
+
+
+@dataclass
+class DeconvSpec:
+    """Transposed conv SHARING the weights of a tied conv layer
+    (reference deconv.py:55-347 — Deconv always demands external
+    weights).  ``tied`` is the spec index of the conv whose weights it
+    applies; reference parity means the loss gradient reaches those
+    weights ONLY through the deconv application (MnistAE/ImagenetAE
+    train GDDeconv alone, mnist_ae.py:146-153), so the tied conv's own
+    application runs under ``stop_gradient``."""
+    type: str
+    in_shape: tuple      # (ny, nx, K)
+    out_shape: tuple     # (H, W, C) — the tied conv's input shape
+    tied: int
+    n_kernels: int
+    kx: int
+    ky: int
+    padding: tuple
+    sliding: tuple
+    unsafe_padding: bool = False
+
+    kind = "deconv"
+    is_softmax = False
+
+
+@dataclass
+class DepoolSpec:
+    """Depooling — scatters activations to the winner offsets recorded
+    by the tied pooling layer during THIS forward pass (reference
+    depooling.py:48-144; the offsets contract of OffsetPooling)."""
+    type: str
+    in_shape: tuple
+    out_shape: tuple     # the tied pool's input shape
+    tied: int            # spec index of the pooling whose offsets to use
+
+    kind = "depool"
     is_softmax = False
 
 
@@ -255,13 +316,15 @@ def build_specs(layers, input_sample_shape, defaults=None):
     """
     defaults = dict(DEFAULT_HYPER, **(defaults or {}))
     specs = []
+    names = {}  # layer name -> spec index (for tied deconv/depool)
     shape = _normalize_sample_shape(input_sample_shape)
-    for layer in layers:
+    for index, layer in enumerate(layers):
+        orig_layer = layer
         layer = dict(layer)
         tpe = layer.pop("type")
-        layer.pop("name", None)
+        name = layer.pop("name", None) or "%s_%d" % (tpe, index)
         fwd = dict(layer.pop("->", {}))
-        bwd = dict(layer.pop("<-", {}))
+        layer.pop("<-", None)
         fwd.update({k: v for k, v in layer.items()})
         if tpe in FC_TYPES:
             oshape = fwd.get("output_sample_shape",
@@ -269,7 +332,10 @@ def build_specs(layers, input_sample_shape, defaults=None):
             if oshape is None:
                 raise ValueError("layer %r needs output_sample_shape" % tpe)
             n_out = int(numpy.prod(oshape))
-            hyper, hyper_bias, flags = _parse_hyper(bwd, defaults)
+            # ONE merge implementation shared with the GDProxy
+            # surface (units/fused_trainer.py seeds proxies from the
+            # same parse)
+            hyper, hyper_bias, flags = layer_hyper(orig_layer, defaults)
             specs.append(FCSpec(
                 type=tpe, n_in=int(numpy.prod(shape)), n_out=n_out,
                 activation=("linear" if tpe == "softmax"
@@ -292,7 +358,10 @@ def build_specs(layers, input_sample_shape, defaults=None):
             sliding = tuple(fwd.get("sliding", (1, 1)))
             ny, nx = conv_ops.output_spatial(
                 shape[0], shape[1], ky, kx, padding, sliding)
-            hyper, hyper_bias, flags = _parse_hyper(bwd, defaults)
+            # ONE merge implementation shared with the GDProxy
+            # surface (units/fused_trainer.py seeds proxies from the
+            # same parse)
+            hyper, hyper_bias, flags = layer_hyper(orig_layer, defaults)
             specs.append(ConvSpec(
                 type=tpe, in_shape=shape, out_shape=(ny, nx, n_kernels),
                 n_kernels=n_kernels, kx=kx, ky=ky,
@@ -337,9 +406,65 @@ def build_specs(layers, input_sample_shape, defaults=None):
             specs.append(DropoutSpec(
                 type=tpe, in_shape=shape, out_shape=shape,
                 ratio=fwd.get("dropout_ratio", 0.5)))
+        elif tpe == "deconv":
+            tied_name = fwd.get("tied_to")
+            if tied_name is None or tied_name not in names:
+                raise ValueError(
+                    "fused deconv needs tied_to=<conv layer name> "
+                    "(the reference Deconv always shares weights, "
+                    "deconv.py:55)")
+            tied = names[tied_name]
+            conv_spec = specs[tied]
+            if conv_spec.kind != "conv":
+                raise ValueError("tied_to %r is not a conv layer"
+                                 % tied_name)
+            if shape != conv_spec.out_shape:
+                raise ValueError(
+                    "deconv input %r != tied conv output %r"
+                    % (shape, conv_spec.out_shape))
+            out_shape = conv_spec.in_shape
+            # the deconv runs in the tied conv's geometry — padding
+            # included (reference AE stages link_conv_attrs copy the
+            # conv's CONV_ATTRS onto the Deconv, mnist_ae.py:148-151)
+            sl = conv_spec.sliding
+            kx, ky = conv_spec.kx, conv_spec.ky
+            padding = tuple(conv_spec.padding)
+            # reference parity: only the deconv application trains the
+            # shared weights (GDDeconv is the sole gradient unit in the
+            # AE stages) — mark the conv to stop_gradient its own use
+            conv_spec.stop_gradient = True
+            specs.append(DeconvSpec(
+                type=tpe, in_shape=shape, out_shape=out_shape, tied=tied,
+                n_kernels=conv_spec.n_kernels, kx=kx, ky=ky,
+                padding=padding, sliding=sl,
+                unsafe_padding=fwd.get("unsafe_padding", False)))
+            shape = out_shape
+        elif tpe == "depooling":
+            tied_name = fwd.get("tied_to")
+            if tied_name is None or tied_name not in names:
+                raise ValueError(
+                    "fused depooling needs tied_to=<pooling layer name>")
+            tied = names[tied_name]
+            pool_spec = specs[tied]
+            if pool_spec.kind != "pool" or pool_spec.mode == "avg":
+                raise ValueError(
+                    "tied_to %r is not an offset-recording pooling"
+                    % tied_name)
+            if shape != pool_spec.out_shape:
+                raise ValueError(
+                    "depooling input %r != tied pool output %r"
+                    % (shape, pool_spec.out_shape))
+            # the tied pool must run the gather path to yield offsets
+            pool_spec.impl = "gather"
+            pool_spec.record_offsets = True
+            specs.append(DepoolSpec(
+                type=tpe, in_shape=shape, out_shape=pool_spec.in_shape,
+                tied=tied))
+            shape = pool_spec.in_shape
         else:
             raise ValueError("fused path does not support layer type %r"
                              % tpe)
+        names[name] = len(specs) - 1
     return specs
 
 
@@ -426,6 +551,7 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
 
     y = x if cd is None else x.astype(cd)
     deferred_act = None  # activation commuted past a following max-pool
+    offsets = {}         # spec index -> winner offsets (for tied depool)
     for i, (p, spec) in enumerate(zip(params, specs)):
         if deferred_act is not None and spec.kind != "pool":
             raise AssertionError("deferred activation not consumed")
@@ -442,6 +568,12 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
                 y = jax.nn.softmax(y, axis=1)
         elif spec.kind == "conv":
             y = y.reshape((y.shape[0],) + spec.in_shape)
+            w = _p(p["w"])
+            if getattr(spec, "stop_gradient", False):
+                # weights shared with a tied deconv: only the DECONV
+                # application trains them (reference AE stages run
+                # GDDeconv as the sole gradient unit)
+                w = jax.lax.stop_gradient(w)
             act = spec.activation
             # strictly monotonic activations commute with max pooling
             # (max(f(x)) == f(max(x)), bit-exact for the same winner);
@@ -453,25 +585,56 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
                     and specs[i + 1].mode == "max"):
                 deferred_act, act = act, "linear"
             y = conv_ops.forward_jax(
-                y, _p(p["w"]), _p(p.get("b")), spec.ky, spec.kx,
+                y, w, _p(p.get("b")), spec.ky, spec.kx,
                 spec.padding, spec.sliding, activation=act,
                 include_bias="b" in p)
         elif spec.kind == "pool":
             y = y.reshape((y.shape[0],) + spec.in_shape)
-            if spec.mode == "maxabs":
-                # gather path: reduce_window maxabs breaks |tie|s toward
-                # the positive value, the reference toward the first
-                # occurrence — keep exact parity for this rare mode.
-                # NOT max_pooling_jax: that routes to the Pallas kernel,
-                # which has no autodiff rule (this forward is grad'd)
+            if getattr(spec, "record_offsets", False):
+                y, offs = pool_ops.max_pooling_gather_jax(
+                    y, spec.ky, spec.kx, spec.sliding,
+                    use_abs=spec.mode == "maxabs")
+                offsets[i] = offs
+            elif spec.mode != "avg" and spec.impl == "gather":
+                # gather path: gradient scatters to the FIRST maximum —
+                # exact tie parity with the unit path (flat regions tie;
+                # reduce_window's select-and-scatter routes ties
+                # implementation-defined, maxabs even breaks |tie|s
+                # toward the positive value).  NOT max_pooling_jax: that
+                # routes to the Pallas kernel, which has no autodiff rule
+                # (this forward is grad'd).
                 y, _ = pool_ops.max_pooling_gather_jax(
-                    y, spec.ky, spec.kx, spec.sliding, use_abs=True)
+                    y, spec.ky, spec.kx, spec.sliding,
+                    use_abs=spec.mode == "maxabs")
             else:
                 y = pool_ops.pooling_fwd_jax(
                     y, spec.ky, spec.kx, spec.sliding, mode=spec.mode)
             if deferred_act is not None:
                 y = activations.apply_jax(deferred_act, y)
                 deferred_act = None
+        elif spec.kind == "deconv":
+            y = y.reshape((y.shape[0],) + spec.in_shape)
+            w = _p(params[spec.tied]["w"])
+            out_shape = (y.shape[0],) + spec.out_shape
+            y = conv_ops.deconv_forward_jax(
+                y, w, spec.ky, spec.kx, spec.padding, spec.sliding,
+                out_shape)
+            if spec.unsafe_padding:
+                hits = conv_ops.deconv_hits_jax(
+                    (y.shape[0],) + spec.in_shape[:2], spec.ky, spec.kx,
+                    spec.padding, spec.sliding, out_shape)
+                div = y / jnp.maximum(hits, 1).astype(y.dtype)[:, :, :, None]
+                # value = y/hits, gradient = identity: the reference
+                # GDDeconv backpropagates the UNDIVIDED scatter (the
+                # hits normalization is absent from gd_deconv's
+                # gradient, deconv.py/gd_deconv.py) — keep that parity
+                y = y + jax.lax.stop_gradient(div - y)
+        elif spec.kind == "depool":
+            y = y.reshape((y.shape[0],) + spec.in_shape)
+            full = (y.shape[0],) + spec.out_shape
+            y = pool_ops.max_pooling_backward_jax(
+                y, offsets[spec.tied],
+                int(numpy.prod(full)), full)
         elif spec.kind == "lrn":
             y = y.reshape((y.shape[0],) + spec.in_shape)
             y = norm_ops.lrn_forward_jax(
@@ -490,8 +653,8 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
 
 def _loss_and_stats(params, x, labels, specs, key=None, compute_dtype=None):
     """Mean softmax-CE loss (matches evaluator err_output scaling,
-    ops/evaluator.py) + error count.  Loss math is float32 even when the
-    forward GEMMs run in a lower ``compute_dtype``."""
+    ops/evaluator.py) + error count + softmax output/argmax.  Loss math is
+    float32 even when the forward GEMMs run in a lower ``compute_dtype``."""
     y = forward(params, x, specs, return_logits=True, key=key, train=True,
                 compute_dtype=compute_dtype)
     if compute_dtype is not None:
@@ -502,8 +665,56 @@ def _loss_and_stats(params, x, labels, specs, key=None, compute_dtype=None):
     ce = -jnp.take_along_axis(logp, lbl[:, None], axis=1)[:, 0]
     ce = jnp.where(valid, ce, 0.0)
     loss = ce.sum() / jnp.maximum(valid.sum(), 1)
-    n_err = (valid & (jnp.argmax(y, axis=1) != lbl)).sum()
-    return loss, n_err
+    max_idx = jnp.argmax(y, axis=1).astype(jnp.int32)
+    n_err = (valid & (max_idx != lbl)).sum()
+    probs = jnp.exp(logp)
+    return loss, (n_err, probs, max_idx)
+
+
+def _loss_and_stats_mse(params, x, target, batch_size, specs, key=None,
+                        compute_dtype=None):
+    """MSE objective: loss = sum((y-t)^2) / (2*batch) so that
+    d(loss)/dy == (y - t)/batch — exactly the unit evaluator's
+    ``err_output`` scaling (ops/evaluator.py mse, mean=True; reference
+    evaluator.py:334-556).  Rows past ``batch_size`` (padded tail
+    minibatch) are masked out like the evaluator does."""
+    y = forward(params, x, specs, key=key, train=True,
+                compute_dtype=compute_dtype)
+    if compute_dtype is not None:
+        y = y.astype(jnp.float32)
+    B = y.shape[0]
+    o2 = y.reshape(B, -1)
+    t2 = target.reshape(B, -1).astype(o2.dtype)
+    valid = jnp.arange(B) < batch_size
+    diff = jnp.where(valid[:, None], o2 - t2, 0)
+    loss = 0.5 * (diff * diff).sum() / jnp.maximum(batch_size, 1)
+    return loss, y
+
+
+def _train_step_mse(params, state, x, target, batch_size, specs, key=None,
+                    compute_dtype=None, hypers=None):
+    (loss, y), grads = jax.value_and_grad(
+        lambda p: _loss_and_stats_mse(p, x, target, batch_size, specs,
+                                      key, compute_dtype),
+        has_aux=True)(params)
+    new_params, new_state = [], []
+    if hypers is None:
+        hypers = [None] * len(params)
+    for spec, p, st, g, hy in zip(specs, params, state, grads, hypers):
+        np_, nst = {}, {}
+        if "w" in p:
+            np_["w"], nst["w"], _ = gd_math.update(
+                jnp, p["w"], g["w"].astype(p["w"].dtype), st["w"],
+                hy["w"] if hy else spec.hyper, spec.flags)
+        if "b" in p:
+            hyper_b = hy["b"] if hy else spec.hyper_bias
+            flags_b = dict(spec.flags, ortho=False)
+            np_["b"], nst["b"], _ = gd_math.update(
+                jnp, p["b"], g["b"].astype(p["b"].dtype), st["b"],
+                hyper_b, flags_b)
+        new_params.append(np_)
+        new_state.append(nst)
+    return new_params, new_state, {"loss": loss, "output": y}
 
 
 def flops_per_image(specs):
@@ -516,6 +727,9 @@ def flops_per_image(specs):
         elif spec.kind == "conv":
             ny, nx, k = spec.out_shape
             total += 2 * ny * nx * k * spec.kx * spec.ky * spec.n_channels
+        elif spec.kind == "deconv":
+            ny, nx, k = spec.in_shape
+            total += 2 * ny * nx * k * spec.kx * spec.ky * spec.out_shape[2]
     return total
 
 
@@ -525,18 +739,31 @@ class FusedNet:
 
     def __init__(self, layers, input_sample_shape, mesh=None, rand=None,
                  dtype=numpy.float32, defaults=None, dropout_seed=0,
-                 compute_dtype=None):
+                 compute_dtype=None, pool_impl="reduce_window",
+                 objective="softmax"):
         self.specs = build_specs(layers, input_sample_shape, defaults)
+        for spec in self.specs:
+            if spec.kind == "pool" and \
+                    not getattr(spec, "record_offsets", False):
+                spec.impl = pool_impl
         self.compute_dtype = compute_dtype
         self.input_sample_shape = _normalize_sample_shape(input_sample_shape)
-        if not self.specs[-1].is_softmax:
-            raise ValueError(
-                "the fused path trains a softmax-CE objective; the last "
-                "layer must be type 'softmax' (got %r). Use the unit-graph "
-                "path for other heads." % self.specs[-1].type)
-        if any(s.is_softmax for s in self.specs[:-1]):
-            raise ValueError(
-                "softmax is only supported as the head of a fused net")
+        self.objective = objective
+        if objective == "softmax":
+            if not self.specs[-1].is_softmax:
+                raise ValueError(
+                    "the fused softmax objective needs a 'softmax' head "
+                    "(got %r); pass objective='mse' for regression/AE "
+                    "topologies." % self.specs[-1].type)
+            if any(s.is_softmax for s in self.specs[:-1]):
+                raise ValueError(
+                    "softmax is only supported as the head of a fused net")
+        elif objective == "mse":
+            if any(s.is_softmax for s in self.specs):
+                raise ValueError(
+                    "the mse objective does not take a softmax head")
+        else:
+            raise ValueError("unknown objective %r" % objective)
         self.mesh = mesh
         params_host = init_params(self.specs, rand, dtype)
         states_host = init_opt_state(self.specs, params_host)
@@ -553,12 +780,19 @@ class FusedNet:
             self._key = jax.device_put(
                 self._key, NamedSharding(mesh, P()))
         self._has_dropout = any(s.kind == "dropout" for s in self.specs)
+        #: live hyperparameters — mutated by LR schedules / rollback and
+        #: passed to the jitted step as traced scalars (no recompile)
+        self.hypers = default_hypers(self.specs)
         # specs close over the traced functions (they carry dicts, so they
-        # can't be hashable static args); hyperparameters bake in as XLA
-        # constants.
+        # can't be hashable static args); only the FLAGS stay compile-time
+        # constants — hyper values are traced arguments.
         specs = tuple(self.specs)
-        step_fn = lambda p, s, x, l, k: _train_step(  # noqa: E731
-            p, s, x, l, specs, k, compute_dtype)
+        if objective == "mse":
+            step_fn = lambda p, s, x, t, bs, k, hy: _train_step_mse(  # noqa: E731,E501
+                p, s, x, t, bs, specs, k, compute_dtype, hy)
+        else:
+            step_fn = lambda p, s, x, l, k, hy: _train_step(  # noqa: E731
+                p, s, x, l, specs, k, compute_dtype, hy, with_output=True)
         if mesh is not None:
             # Pin output shardings to the input placements: GSPMD would
             # otherwise return spec variants (P('model',) vs
@@ -570,8 +804,16 @@ class FusedNet:
                            for kk in slots.keys()}
                        for k, slots in st.items()}
                       for s, st in zip(self.specs, self.state)]
-            mshard = {"loss": NamedSharding(mesh, P()),
-                      "n_err": NamedSharding(mesh, P())}
+            out_ndim = 1 + len(self.specs[-1].out_shape)
+            oshard = NamedSharding(mesh, P("data", *([None] * (out_ndim - 1))))
+            if objective == "mse":
+                mshard = {"loss": NamedSharding(mesh, P()),
+                          "output": oshard}
+            else:
+                mshard = {"loss": NamedSharding(mesh, P()),
+                          "n_err": NamedSharding(mesh, P()),
+                          "output": oshard,
+                          "max_idx": NamedSharding(mesh, P("data"))}
             self._pshard, self._sshard = pshard, sshard
             self._step = jax.jit(step_fn, donate_argnums=(0, 1),
                                  out_shardings=(pshard, sshard, mshard))
@@ -580,6 +822,12 @@ class FusedNet:
             self._step = jax.jit(step_fn, donate_argnums=(0, 1))
         self._fwd = jax.jit(
             lambda p, x: forward(p, x, specs, compute_dtype=compute_dtype))
+
+        def fwd_idx(p, x):
+            probs = forward(p, x, specs, compute_dtype=compute_dtype)
+            return probs, jnp.argmax(probs, axis=1).astype(jnp.int32)
+
+        self._fwd_idx = jax.jit(fwd_idx)
 
     # -- sharding -----------------------------------------------------------
     def _param_spec(self, spec, name):
@@ -629,15 +877,45 @@ class FusedNet:
         return jax.device_put(x, xs), jax.device_put(labels, ls)
 
     # -- public api ---------------------------------------------------------
-    def step(self, x, labels):
-        """One fused train step.  Returns {"loss": float, "n_err": int}."""
+    def step(self, x, labels, hypers=None):
+        """One fused train step.  Returns {"loss", "n_err", "output",
+        "max_idx"} (output/max_idx device-resident).  ``hypers`` overrides
+        the live hyperparameter pytree for this step (traced — schedules
+        cost no recompile)."""
+        if self.objective != "softmax":
+            raise ValueError("use step_mse for objective %r"
+                             % self.objective)
         x, labels = self._place_batch(x, labels)
         if self._has_dropout:
             self._key, key = jax.random.split(self._key)
         else:
             key = self._key
         self.params, self.state, metrics = self._step(
-            self.params, self.state, x, labels, key)
+            self.params, self.state, x, labels, key,
+            self.hypers if hypers is None else hypers)
+        return metrics
+
+    def step_mse(self, x, target, batch_size=None, hypers=None):
+        """One fused MSE train step.  ``batch_size`` masks the padded
+        tail rows (defaults to the full batch).  Returns {"loss",
+        "output"}."""
+        if self.objective != "mse":
+            raise ValueError("use step for objective %r" % self.objective)
+        if batch_size is None:
+            batch_size = x.shape[0]
+        x, _ = self._place_batch(x, numpy.zeros(x.shape[0], numpy.int32))
+        target = jax.device_put(
+            numpy.asarray(target),
+            None if self.mesh is None else NamedSharding(
+                self.mesh, P("data", *([None] * (target.ndim - 1)))))
+        if self._has_dropout:
+            self._key, key = jax.random.split(self._key)
+        else:
+            key = self._key
+        self.params, self.state, metrics = self._step(
+            self.params, self.state, x, target,
+            numpy.int32(batch_size), key,
+            self.hypers if hypers is None else hypers)
         return metrics
 
     def run_steps(self, xs, labels_s):
@@ -649,22 +927,26 @@ class FusedNet:
         devices) and is the idiomatic TPU epoch loop.  Returns stacked
         per-step metrics.
         """
+        if self.objective != "softmax":
+            raise ValueError("run_steps supports the softmax objective; "
+                             "drive step_mse per minibatch instead")
         if not hasattr(self, "_scan_step"):
             specs = tuple(self.specs)
             cd = self.compute_dtype
 
             def body(carry, batch):
-                p, s, k = carry
+                p, s, k, hy = carry
                 x, l = batch
                 if self._has_dropout:
                     k, sub = jax.random.split(k)
                 else:
                     sub = k
-                p, s, m = _train_step(p, s, x, l, specs, sub, cd)
-                return (p, s, k), m
+                p, s, m = _train_step(p, s, x, l, specs, sub, cd, hy)
+                return (p, s, k, hy), m
 
-            def scan_fn(p, s, k, xs, ls):
-                (p, s, k), ms = jax.lax.scan(body, (p, s, k), (xs, ls))
+            def scan_fn(p, s, k, xs, ls, hy):
+                (p, s, k, hy), ms = jax.lax.scan(body, (p, s, k, hy),
+                                                 (xs, ls))
                 return p, s, k, ms
 
             if self.mesh is not None:
@@ -693,15 +975,46 @@ class FusedNet:
             xs = jax.device_put(xs)
             labels_s = jax.device_put(labels_s)
         self.params, self.state, self._key, metrics = self._scan_step(
-            self.params, self.state, self._key, xs, labels_s)
+            self.params, self.state, self._key, xs, labels_s, self.hypers)
         return metrics
 
     def predict(self, x):
         x, _ = self._place_batch(x, numpy.zeros(x.shape[0], numpy.int32))
         return self._fwd(self.params, x)
 
+    def predict_with_idx(self, x):
+        """Compiled inference: (softmax output, argmax) — what the
+        evaluator unit consumes on VALID/TEST minibatches."""
+        x, _ = self._place_batch(x, numpy.zeros(x.shape[0], numpy.int32))
+        return self._fwd_idx(self.params, x)
+
     def host_params(self):
         return jax.tree.map(lambda a: numpy.asarray(a), self.params)
+
+    # -- checkpoint / resume ------------------------------------------------
+    def state_dict(self):
+        """Full training state as host numpy pytrees: parameters,
+        optimizer slots (vel/acc/solver), the dropout PRNG key, and the
+        live hyperparameters — everything needed for bit-exact resume
+        (the fused twin of the unit path's exports, nn_units.py:316-319)."""
+        return {
+            "params": jax.tree.map(numpy.asarray, self.params),
+            "opt": jax.tree.map(numpy.asarray, self.state),
+            "key": numpy.asarray(self._key),
+            "hypers": jax.tree.map(float, self.hypers),
+        }
+
+    def load_state_dict(self, sd):
+        """Restore :meth:`state_dict` output, re-placing every leaf with
+        its mesh sharding."""
+        self.params = self._place_params(sd["params"])
+        self.state = self._place_state(sd["opt"])
+        key = jnp.asarray(sd["key"])
+        if self.mesh is not None:
+            key = jax.device_put(key, NamedSharding(self.mesh, P()))
+        self._key = key
+        if sd.get("hypers") is not None:
+            self.hypers = jax.tree.map(float, sd["hypers"])
 
 
 class FusedMLP(FusedNet):
@@ -716,24 +1029,49 @@ class FusedMLP(FusedNet):
             layers, int(input_sample_size), **kwargs)
 
 
+def default_hypers(specs):
+    """The live hyperparameter pytree: one ``{"w": {...}, "b": {...}}`` per
+    parameterized spec (``{}`` for param-less layers), seeded from the
+    config values.  Passed to the jitted step as a TRACED argument so LR
+    schedules (lr_adjust.py policies) apply per iteration without a
+    recompile — the reference mutates ``gd.learning_rate`` the same way
+    (lr_adjust.py:61)."""
+    hypers = []
+    for spec in specs:
+        if spec.kind in ("fc", "conv"):
+            h = {"w": dict(spec.hyper)}
+            if spec.include_bias:
+                h["b"] = dict(spec.hyper_bias)
+            hypers.append(h)
+        else:
+            hypers.append({})
+    return hypers
+
+
 def _train_step(params, state, x, labels, specs, key=None,
-                compute_dtype=None):
-    (loss, n_err), grads = jax.value_and_grad(
+                compute_dtype=None, hypers=None, with_output=False):
+    (loss, (n_err, probs, max_idx)), grads = jax.value_and_grad(
         lambda p: _loss_and_stats(p, x, labels, specs, key, compute_dtype),
         has_aux=True)(params)
     new_params, new_state = [], []
-    for spec, p, st, g in zip(specs, params, state, grads):
+    if hypers is None:
+        hypers = [None] * len(params)
+    for spec, p, st, g, hy in zip(specs, params, state, grads, hypers):
         np_, nst = {}, {}
         if "w" in p:
             np_["w"], nst["w"], _ = gd_math.update(
                 jnp, p["w"], g["w"].astype(p["w"].dtype), st["w"],
-                spec.hyper, spec.flags)
+                hy["w"] if hy else spec.hyper, spec.flags)
         if "b" in p:
-            hyper_b = spec.hyper_bias
+            hyper_b = hy["b"] if hy else spec.hyper_bias
             flags_b = dict(spec.flags, ortho=False)
             np_["b"], nst["b"], _ = gd_math.update(
                 jnp, p["b"], g["b"].astype(p["b"].dtype), st["b"],
                 hyper_b, flags_b)
         new_params.append(np_)
         new_state.append(nst)
-    return new_params, new_state, {"loss": loss, "n_err": n_err}
+    metrics = {"loss": loss, "n_err": n_err}
+    if with_output:
+        metrics["output"] = probs
+        metrics["max_idx"] = max_idx
+    return new_params, new_state, metrics

@@ -1,0 +1,102 @@
+"""Population-parallel GA evaluation — vmap the fused trainer.
+
+The reference's genetic optimizer sprayed workflow evaluations across a
+master–slave cluster (SURVEY.md §3.5).  The TPU-native equivalent
+batches them: every individual of a GA generation trains CONCURRENTLY as
+one vmapped XLA computation over the fused train step — the population
+axis becomes a batch axis of the compiled program, so N individuals cost
+roughly one individual's wall-clock on an undersubscribed chip.
+
+All individuals share one weight init (drawn once from the seeded PRNG,
+same draw order as the unit path) and a FIXED minibatch order — the GA
+compares hyperparameters, so the data stream must be identical across
+individuals anyway.
+"""
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core import prng
+from znicz_tpu.parallel import fused
+
+
+def make_population_evaluator(layers, input_sample_shape,
+                              train_x, train_y, val_x, val_y,
+                              values_to_hypers, epochs=6,
+                              minibatch_size=None, rand=None,
+                              dtype=numpy.float32, defaults=None):
+    """Build ``evaluate_population(value_vectors) -> [fitness]`` for
+    :class:`znicz_tpu.core.genetics.GeneticsOptimizer`.
+
+    ``values_to_hypers(values, specs)`` maps one GA value vector onto a
+    fused hyper pytree (see :func:`znicz_tpu.parallel.fused
+    .default_hypers`); fitness is the negative validation error PERCENT
+    after ``epochs`` of training (softmax objective) — the same scale
+    the serial ``--optimize`` fallback reports (-best_n_err_pt).
+    """
+    specs = tuple(fused.build_specs(layers, input_sample_shape, defaults))
+    if not specs[-1].is_softmax:
+        raise ValueError("population evaluator scores a softmax head")
+    params0 = fused.init_params(specs, rand or prng.get(), dtype)
+    state0 = fused.init_opt_state(specs, params0)
+    train_x = numpy.asarray(train_x, dtype)
+    train_y = numpy.asarray(train_y, numpy.int32)
+    n = len(train_x)
+    # one fixed shuffle: datasets often arrive class-ordered (UCI Wine),
+    # and class-homogeneous minibatches cripple SGD; a deterministic
+    # permutation keeps the stream identical across individuals
+    perm = numpy.random.RandomState(0x5EED).permutation(n)
+    train_x, train_y = train_x[perm], train_y[perm]
+    mb = minibatch_size or n
+    steps = max(1, n // mb)
+    xs = jnp.asarray(train_x[:steps * mb].reshape((steps, mb) +
+                                                  train_x.shape[1:]))
+    ys = jnp.asarray(train_y[:steps * mb].reshape(steps, mb))
+    vx = jnp.asarray(numpy.asarray(val_x, dtype))
+    vy = jnp.asarray(numpy.asarray(val_y, numpy.int32))
+    p0 = jax.tree.map(jnp.asarray, params0)
+    s0 = jax.tree.map(jnp.asarray, state0)
+
+    def train_eval(hypers):
+        def epoch(carry, _):
+            def step(carry, batch):
+                p, s = carry
+                x, y = batch
+                p, s, m = fused._train_step(p, s, x, y, specs,
+                                            hypers=hypers)
+                return (p, s), m["loss"]
+            carry, losses = jax.lax.scan(step, carry, (xs, ys))
+            return carry, losses[-1]
+
+        (p, _), _ = jax.lax.scan(epoch, (p0, s0), None, length=epochs)
+        probs = fused.forward(p, vx, specs)
+        n_err = (jnp.argmax(probs, axis=1) != vy).sum()
+        return -100.0 * n_err.astype(jnp.float32) / vy.shape[0]
+
+    fn = jax.jit(jax.vmap(train_eval))
+
+    def evaluate_population(value_vectors):
+        hypers = [values_to_hypers(list(v), specs) for v in value_vectors]
+        stacked = jax.tree.map(lambda *leaves: jnp.stack(
+            [jnp.asarray(l, jnp.float32) for l in leaves]), *hypers)
+        return [float(f) for f in numpy.asarray(fn(stacked))]
+
+    return evaluate_population
+
+
+def uniform_lr_hypers(values, specs):
+    """The common single-site mapping: one GA value = the learning rate
+    of every parameterized layer (weights and bias)."""
+    lr = float(values[0])
+    hypers = []
+    for spec in specs:
+        if spec.kind in ("fc", "conv"):
+            h = {"w": dict(spec.hyper, lr=lr)}
+            if spec.include_bias:
+                h["b"] = dict(spec.hyper_bias, lr=lr)
+            hypers.append(h)
+        else:
+            hypers.append({})
+    return hypers

@@ -1,0 +1,167 @@
+"""One-command real-data accuracy parity runs (BASELINE.md bar).
+
+``python -m znicz_tpu mnist --parity`` provisions the real dataset
+(manifest-style URLs, the role of the reference's per-sample
+``manifest.json`` + Downloader), trains the published config to its
+stopping criterion, and prints the parity-table row against the
+reference baseline (reference snapshot names encode
+``validation_<err>_train_<err>`` — BASELINE.md).
+
+In a zero-egress environment the provisioning step fails FAST with an
+explicit "network required" message (short socket timeout) instead of
+silently training on the synthetic fallback.
+"""
+
+import gzip
+import os
+import shutil
+import tarfile
+import urllib.error
+import urllib.request
+
+from znicz_tpu.core.config import root
+
+#: dataset provisioning manifests: file list the loader needs + the
+#: archives/URLs that produce them (reference samples/MNIST/manifest.json
+#: role).  Mirrors listed in preference order.
+DATASETS = {
+    "mnist": {
+        "subdir": "MNIST",
+        "files": ("train-images.idx3-ubyte", "train-labels.idx1-ubyte",
+                  "t10k-images.idx3-ubyte", "t10k-labels.idx1-ubyte"),
+        "sources": [
+            # (url, member -> target) gz files, one per idx file
+            ("https://ossci-datasets.s3.amazonaws.com/mnist/%s.gz", {
+                "train-images-idx3-ubyte": "train-images.idx3-ubyte",
+                "train-labels-idx1-ubyte": "train-labels.idx1-ubyte",
+                "t10k-images-idx3-ubyte": "t10k-images.idx3-ubyte",
+                "t10k-labels-idx1-ubyte": "t10k-labels.idx1-ubyte"}),
+            ("https://storage.googleapis.com/cvdf-datasets/mnist/%s.gz", {
+                "train-images-idx3-ubyte": "train-images.idx3-ubyte",
+                "train-labels-idx1-ubyte": "train-labels.idx1-ubyte",
+                "t10k-images-idx3-ubyte": "t10k-images.idx3-ubyte",
+                "t10k-labels-idx1-ubyte": "t10k-labels.idx1-ubyte"}),
+        ],
+    },
+    "cifar": {
+        "subdir": "CIFAR10",
+        "files": tuple(["data_batch_%d" % i for i in range(1, 6)] +
+                       ["test_batch"]),
+        "tar": ("https://www.cs.toronto.edu/~kriz/"
+                "cifar-10-python.tar.gz", "cifar-10-batches-py"),
+    },
+}
+
+#: parity rows: sample -> [(label, reference val err %, build kwargs)]
+PARITY_RUNS = {
+    "mnist": [
+        ("MNIST MLP", 1.92, {}),
+        ("MNIST conv", 0.75, {"layers_key": "mnistr_conv"}),
+        ("MNIST caffe", 0.80, {"layers_key": "mnistr_caffe"}),
+    ],
+    "cifar": [
+        ("CIFAR-10 caffe conv", 17.21, {}),
+    ],
+}
+
+TIMEOUT = 30  # seconds per HTTP request — fail fast offline
+
+
+class NetworkRequired(SystemExit):
+    pass
+
+
+def _fetch(url, dest):
+    tmp = dest + ".part"
+    with urllib.request.urlopen(url, timeout=TIMEOUT) as r, \
+            open(tmp, "wb") as f:
+        shutil.copyfileobj(r, f)
+    os.replace(tmp, dest)
+
+
+def ensure_dataset(name, directory=None):
+    """Make the real dataset available; returns its directory.
+
+    Raises :class:`NetworkRequired` (a SystemExit) with an explicit
+    message when files are absent and the network is unreachable.
+    """
+    spec = DATASETS[name]
+    directory = directory or os.path.join(root.common.dirs.datasets,
+                                          spec["subdir"])
+    missing = [f for f in spec["files"]
+               if not os.path.exists(os.path.join(directory, f))]
+    if not missing:
+        return directory
+    os.makedirs(directory, exist_ok=True)
+    errors = []
+    if "tar" in spec:
+        url, member_dir = spec["tar"]
+        dest = os.path.join(directory, os.path.basename(url))
+        try:
+            if not os.path.exists(dest):
+                _fetch(url, dest)
+            with tarfile.open(dest) as tf:
+                tf.extractall(directory)
+            src = os.path.join(directory, member_dir)
+            if os.path.isdir(src):
+                for f in spec["files"]:
+                    p = os.path.join(src, f)
+                    if os.path.exists(p):
+                        shutil.move(p, os.path.join(directory, f))
+            return directory
+        except (urllib.error.URLError, OSError) as e:
+            errors.append("%s: %s" % (url, e))
+    for pattern, members in spec.get("sources", ()):
+        try:
+            for member, target in members.items():
+                tpath = os.path.join(directory, target)
+                if os.path.exists(tpath):
+                    continue
+                gz = os.path.join(directory, member + ".gz")
+                if not os.path.exists(gz):
+                    _fetch(pattern % member, gz)
+                with gzip.open(gz, "rb") as fin, \
+                        open(tpath + ".part", "wb") as fout:
+                    shutil.copyfileobj(fin, fout)
+                os.replace(tpath + ".part", tpath)
+            return directory
+        except (urllib.error.URLError, OSError) as e:
+            errors.append("%s: %s" % (pattern, e))
+    raise NetworkRequired(
+        "network required: the %s parity run needs the real dataset "
+        "(missing %s under %s) and no mirror was reachable:\n  %s\n"
+        "Download the files manually into that directory and re-run."
+        % (name, ", ".join(missing), directory,
+           "\n  ".join(errors) or "no sources configured"))
+
+
+def run_parity(sample, device=None, data_dir=None):
+    """Provision data, train every parity config of ``sample`` to its
+    stopping criterion, print the comparison table.  Returns the rows as
+    (label, reference_err_pt, our_err_pt)."""
+    if sample not in PARITY_RUNS:
+        raise SystemExit(
+            "no parity baseline registered for %r (have: %s)"
+            % (sample, ", ".join(sorted(PARITY_RUNS))))
+    data_dir = ensure_dataset(sample, directory=data_dir)
+    import importlib
+    module = importlib.import_module("znicz_tpu.samples." + sample)
+    rows = []
+    for label, ref_err, opts in PARITY_RUNS[sample]:
+        kwargs = {}
+        layers_key = opts.get("layers_key")
+        if layers_key is not None:
+            kwargs["layers"] = getattr(root, layers_key).layers
+        wf = module.build(
+            loader_config={"synthetic": False, "data_path": data_dir},
+            **kwargs)
+        wf.initialize(device=device)
+        wf.run()
+        ours = wf.decision.best_n_err_pt[1]
+        rows.append((label, ref_err, ours))
+        print("| %-22s | reference %6.2f%% | ours %8s | %s |"
+              % (label, ref_err,
+                 "%.2f%%" % ours if ours is not None else "n/a",
+                 "PASS" if ours is not None and ours <= ref_err + 0.15
+                 else "CHECK"))
+    return rows

@@ -103,19 +103,22 @@ class ApproximatorWorkflow(StandardWorkflow):
     (reference Approximator/approximator.py)."""
 
 
-def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+def build(layers=None, loader_config=None, decision_config=None,
+          snapshotter_config=None, **kwargs):
     cfg = root.approximator
     loader_cfg = cfg.loader.as_dict()
     loader_cfg.update(loader_config or {})
     decision_cfg = cfg.decision.as_dict()
     decision_cfg.update(decision_config or {})
+    snap_cfg = cfg.snapshotter.as_dict()
+    snap_cfg.update(snapshotter_config or {})
     kwargs.setdefault("loss_function", cfg.loss_function)
     return ApproximatorWorkflow(
         layers=layers if layers is not None else cfg.layers,
         loader_name=cfg.loader_name,
         loader_config=loader_cfg,
         decision_config=decision_cfg,
-        snapshotter_config=cfg.snapshotter.as_dict(),
+        snapshotter_config=snap_cfg,
         **kwargs)
 
 

@@ -96,9 +96,14 @@ class CifarWorkflow(StandardWorkflow):
         if adj_cfg.pop("do", False):
             # schedule applies per minibatch before the GD units fire
             self.link_lr_adjuster(self.snapshotter, **adj_cfg)
-            # re-route: gds were linked from snapshotter; insert adjuster
-            self.gds[-1].unlink_from(self.snapshotter)
-            self.gds[-1].link_from(self.lr_adjuster)
+            if self.fused_trainer is not None:
+                # fused loop was snapshotter -> repeater; insert adjuster
+                self.repeater.unlink_from(self.snapshotter)
+                self.repeater.link_from(self.lr_adjuster)
+            else:
+                # re-route: gds were linked from snapshotter
+                self.gds[-1].unlink_from(self.snapshotter)
+                self.gds[-1].link_from(self.lr_adjuster)
 
 
 def build(layers=None, loader_config=None, decision_config=None,

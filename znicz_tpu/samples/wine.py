@@ -135,6 +135,40 @@ def run_sample(device=None, **kwargs):
     return wf
 
 
+def population_evaluator(sites, epochs=None, seed=12):
+    """``--optimize`` fused path: one vmapped XLA computation trains a
+    whole GA generation concurrently (the TPU replacement for the
+    reference's cluster-sprayed evaluations, SURVEY.md §3.5).
+
+    Valid when the single Range site is the learning rate; returns None
+    (serial fallback) otherwise.
+    """
+    if len(sites) != 1 or sites[0][1] != "learning_rate":
+        return None
+    from znicz_tpu.core import prng
+    from znicz_tpu.core.workflow import DummyWorkflow
+    from znicz_tpu.parallel.population import (
+        make_population_evaluator, uniform_lr_hypers)
+    import numpy
+    loader = WineLoader(DummyWorkflow(),
+                        minibatch_size=root.wine.loader.minibatch_size)
+    loader.initialize()
+    x = numpy.array(loader.original_data.mem)
+    y = numpy.array(loader.original_labels, dtype=numpy.int32)
+    n_hidden, n_classes = root.wine.layers
+    layers = [
+        {"type": "all2all_tanh",
+         "->": {"output_sample_shape": int(n_hidden)}},
+        {"type": "softmax", "->": {"output_sample_shape": int(n_classes)}},
+    ]
+    defaults = {"wd": float(root.wine.weights_decay)}
+    return make_population_evaluator(
+        layers, x.shape[1], x, y, x, y, uniform_lr_hypers,
+        epochs=epochs or int(root.wine.decision.max_epochs),
+        minibatch_size=int(root.wine.loader.minibatch_size),
+        rand=prng.RandomGenerator().seed(seed), defaults=defaults)
+
+
 if __name__ == "__main__":
     wf = run_sample()
     print("best validation/train err%:", wf.decision.best_n_err_pt)

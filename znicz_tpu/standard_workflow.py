@@ -47,6 +47,8 @@ class StandardWorkflow(StandardWorkflowBase):
 
     # -- canonical graph (reference 173-208) --------------------------------
     def create_workflow(self):
+        if self.fused_config is not None:
+            return self.create_fused_workflow()
         self.link_repeater(self.start_point)
         self.link_loader(self.repeater)
         self.link_forwards(("input", "minibatch_data"), self.loader)
@@ -56,6 +58,53 @@ class StandardWorkflow(StandardWorkflowBase):
         last_gd = self.link_gds(self.snapshotter)
         self.link_loop(last_gd)
         self.link_end_point(last_gd)
+
+    def create_fused_workflow(self):
+        """The same control-plane graph with the forwards+gds chain
+        collapsed into one compiled SPMD train-step unit (SURVEY.md §7
+        design stance: unit graph = epoch-level control plane around the
+        jitted step)."""
+        self.link_repeater(self.start_point)
+        self.link_loader(self.repeater)
+        self.link_fused_trainer(self.loader)
+        self.link_evaluator(self.fused_trainer)
+        self.link_decision(self.evaluator)
+        self.link_snapshotter(self.decision)
+        self.link_loop(self.snapshotter)
+        self.link_end_point(self.snapshotter)
+
+    def link_fused_trainer(self, *parents):
+        """Create the fused train-step unit from the ``layers`` config
+        (fused twin of link_forwards + link_gds).  ``fused_config`` keys:
+        ``mesh`` (a jax Mesh, or an int device count),
+        ``model_parallel`` (with an int mesh), ``compute_dtype``,
+        ``dtype``, ``dropout_seed``, ``defaults``."""
+        from znicz_tpu.units.fused_trainer import FusedForwardBackward
+        cfg = dict(self.fused_config or {})
+        mesh = cfg.pop("mesh", None)
+        if isinstance(mesh, int):
+            from znicz_tpu.parallel import make_mesh
+            mesh = make_mesh(mesh,
+                             model_parallel=cfg.pop("model_parallel", 1))
+        cfg.setdefault("loss", self.loss_function)
+        self.fused_trainer = FusedForwardBackward(
+            self, name="fused_trainer", layers=self.layers, mesh=mesh,
+            **cfg)
+        self.fused_trainer.link_from(*parents)
+        self.fused_trainer.link_attrs(
+            self.loader, ("input", "minibatch_data"),
+            "minibatch_class", "minibatch_size")
+        if self.loss_function == "mse":
+            self.fused_trainer.link_attrs(
+                self.loader, ("target", "minibatch_targets"))
+        else:
+            self.fused_trainer.link_attrs(
+                self.loader, ("labels", "minibatch_labels"))
+        self.fused_trainer.label_source = self.real_loader
+        # the trainer IS the forward chain for downstream linkers
+        # (link_evaluator/link_image_saver read forwards[-1])
+        self.forwards[:] = [self.fused_trainer]
+        return self.fused_trainer
 
     # -- backward chain (reference 289-374) ---------------------------------
     def link_gds(self, *parents):
@@ -180,13 +229,30 @@ class StandardWorkflow(StandardWorkflowBase):
             or kwargs
         self.lr_adjuster = LearningRateAdjust(
             self, name="lr_adjuster", **cfg)
-        for gd in self.gds:
-            self.lr_adjuster.add_gd_unit(gd)
+        if self.fused_trainer is not None:
+            # fused mode: the proxies carry the hyperparameter surface;
+            # the schedule's new LR reaches the jitted step as a traced
+            # argument (no recompile)
+            for proxy in self.fused_trainer.gd_proxies:
+                proxy.gate_skip = self.decision.gd_skip
+                self.lr_adjuster.add_gd_unit(proxy)
+        else:
+            for gd in self.gds:
+                self.lr_adjuster.add_gd_unit(gd)
         self.lr_adjuster.link_from(*parents)
         return self.lr_adjuster
 
     def link_rollback(self, *parents, **kwargs):
         """Divergence recovery (reference standard_workflow.py:594-600)."""
+        if self.fused_trainer is not None:
+            from znicz_tpu.units.fused_trainer import FusedNNRollback
+            self.rollback = FusedNNRollback(
+                self, name="rollback", trainer=self.fused_trainer,
+                **kwargs)
+            self.rollback.link_from(*parents)
+            self.rollback.link_attrs(self.decision, "improved")
+            self.rollback.gate_skip = ~self.loader.epoch_ended
+            return self.rollback
         from znicz_tpu.units.nn_rollback import NNRollback
         self.rollback = NNRollback(self, name="rollback", **kwargs)
         self.rollback.link_from(*parents)
@@ -507,6 +573,16 @@ class StandardWorkflow(StandardWorkflowBase):
             kwargs["loader_config"] = loader_config
         fwd_wf = StandardWorkflowBase(None, **kwargs)
         fwd_wf.create_workflow()
+        if self.fused_trainer is not None:
+            # fused params map 1:1 onto the layer list — inject through
+            # the same master->slave broadcast entry point
+            params = self.fused_trainer.host_params()
+            for fwd_imp, p in zip(fwd_wf.forwards, params):
+                if p:
+                    fwd_imp.apply_data_from_master(
+                        [p.get("w"), p.get("b")])
+                fwd_imp.forward_mode = True
+            return fwd_wf
         for fwd_exp, fwd_imp in zip(self.forwards, fwd_wf.forwards):
             data = fwd_exp.generate_data_for_slave(None)
             if data is not None:

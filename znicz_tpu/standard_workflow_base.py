@@ -33,6 +33,16 @@ class StandardWorkflowBase(nn_units.NNWorkflow):
         super(StandardWorkflowBase, self).__init__(workflow, **kwargs)
         self.layer_map = nn_units.mapping
         self.preprocessing = kwargs.get("preprocessing", False)
+        # fused execution mode: collapse forwards+gds into one jitted
+        # SPMD train-step unit (True or a config dict; see
+        # StandardWorkflow.link_fused_trainer)
+        fused_cfg = kwargs.get("fused", None)
+        if fused_cfg is True:
+            fused_cfg = {}
+        elif fused_cfg is False:
+            fused_cfg = None
+        self.fused_config = fused_cfg
+        self.fused_trainer = None
         self.mcdnnic_topology = kwargs.get("mcdnnic_topology", None)
         self.mcdnnic_parameters = kwargs.get("mcdnnic_parameters", None)
         self.layers = kwargs.get("layers", [{}])

@@ -1,0 +1,376 @@
+"""Fused-mode training unit — the SPMD hot loop inside the unit graph.
+
+SURVEY.md §7 design stance made literal: the unit graph stays the
+*epoch-level control plane* (loader -> train step -> evaluator stats ->
+decision -> snapshotter -> lr_adjuster/rollback), while the per-minibatch
+forward + backward + update collapses into ONE jitted XLA computation
+(:class:`znicz_tpu.parallel.fused.FusedNet`), optionally sharded over a
+``(data, model)`` device mesh.
+
+:class:`FusedForwardBackward` replaces the whole forwards[0..n] +
+gds[n..0] chain of the reference graph (standard_workflow.py:173-208).
+On TRAIN minibatches it runs the fused train step with the CURRENT
+hyperparameters (traced arguments — LR schedules apply per iteration with
+no recompile, reference lr_adjust.py:61); on VALID/TEST minibatches it
+runs the compiled inference forward.  Either way it exposes ``output`` and
+``max_idx`` exactly like the last forward unit would, so the evaluator,
+decision, snapshotter and plotter units keep their reference roles
+unchanged.
+
+:class:`GDProxy` stands in for one GD unit's hyperparameter surface
+(learning_rate, weights_decay, ... — reference nn_units.py:339-441) so
+``LearningRateAdjust`` and rollback mutate fused-layer hyperparameters
+through the same attribute contract they use on real GD units.
+
+:class:`FusedNNRollback` is the divergence-recovery twin of
+``NNRollback`` (reference nn_rollback.py:44-190) for the fused path:
+whole-net state snapshots instead of per-GD-unit weight histories.
+"""
+
+import numpy
+
+from znicz_tpu.core.units import Unit
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core.config import root
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.parallel import fused
+
+
+class GDProxy(object):
+    """Hyperparameter proxy for one fused layer — the attribute surface
+    of a GD unit (reference nn_units.py:339-441) without the compute."""
+
+    #: scalar attributes persisted in snapshots (so rollback/schedule
+    #: mutations survive resume)
+    STATE_ATTRS = ("learning_rate", "learning_rate_bias",
+                   "weights_decay", "weights_decay_bias",
+                   "l1_vs_l2", "l1_vs_l2_bias",
+                   "gradient_moment", "gradient_moment_bias",
+                   "factor_ortho", "acc_alpha", "acc_beta",
+                   "gd_alpha", "gd_beta")
+
+    def __init__(self, name, hyper, hyper_bias):
+        self.name = name
+        self.gate_skip = Bool(False)
+        self.learning_rate = hyper["lr"]
+        self.learning_rate_bias = hyper_bias["lr"]
+        self.weights_decay = hyper["wd"]
+        self.weights_decay_bias = hyper_bias["wd"]
+        self.l1_vs_l2 = hyper["l1_vs_l2"]
+        self.l1_vs_l2_bias = hyper_bias["l1_vs_l2"]
+        self.gradient_moment = hyper["moment"]
+        self.gradient_moment_bias = hyper_bias["moment"]
+        self.factor_ortho = hyper["factor_ortho"]
+        self.acc_alpha = hyper["acc_alpha"]
+        self.acc_beta = hyper["acc_beta"]
+        self.gd_alpha = hyper["gd_alpha"]
+        self.gd_beta = hyper["gd_beta"]
+
+    def hyper_dicts(self):
+        """(hyper, hyper_bias) in gd_math.update vocabulary — rebuilt from
+        the live attribute values every step."""
+        common = dict(acc_alpha=self.acc_alpha, acc_beta=self.acc_beta,
+                      gd_alpha=self.gd_alpha, gd_beta=self.gd_beta)
+        hyper = dict(common, lr=float(self.learning_rate),
+                     wd=float(self.weights_decay),
+                     l1_vs_l2=float(self.l1_vs_l2),
+                     moment=float(self.gradient_moment),
+                     factor_ortho=float(self.factor_ortho))
+        hyper_bias = dict(common, lr=float(self.learning_rate_bias),
+                          wd=float(self.weights_decay_bias),
+                          l1_vs_l2=float(self.l1_vs_l2_bias),
+                          moment=float(self.gradient_moment_bias),
+                          factor_ortho=0.0)
+        return hyper, hyper_bias
+
+    def state_dict(self):
+        return {a: float(getattr(self, a)) for a in self.STATE_ATTRS}
+
+    def load_state_dict(self, sd):
+        for a, v in sd.items():
+            if a in self.STATE_ATTRS:
+                setattr(self, a, v)
+
+
+class FusedForwardBackward(Unit):
+    """One unit = the whole compiled train/eval step over the layer stack.
+
+    Demands ``input``/``labels``/``minibatch_class``/``minibatch_size``
+    from the loader; provides ``output``/``max_idx`` like the last forward
+    unit of the reference graph, so downstream evaluator/decision/plotters
+    are unchanged.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "WORKER")
+        super(FusedForwardBackward, self).__init__(workflow, **kwargs)
+        import copy
+        self.layers = copy.deepcopy(list(kwargs["layers"]))
+        self.mesh = kwargs.get("mesh")
+        self.dtype = kwargs.get("dtype")
+        self.compute_dtype = kwargs.get("compute_dtype")
+        self.defaults = kwargs.get("defaults")
+        self.dropout_seed = kwargs.get("dropout_seed", 0)
+        #: "reduce_window" (TPU-fast) or "gather" (bit-parity with the
+        #: unit path on tied max-pool windows) — see PoolSpec.impl
+        self.pool_impl = kwargs.get("pool_impl", "reduce_window")
+        self.rand = kwargs.get("rand", prng.get())
+        self.output = Array(name="output")
+        self.max_idx = Array(name="max_idx")
+        #: training objective: "softmax" (CE + argmax stats) or "mse"
+        self.loss = kwargs.get("loss", "softmax")
+        self.net = None
+        self.forward_mode = False
+        #: loader whose label count / target shape sets the head width
+        #: (wired by StandardWorkflow.link_fused_trainer;
+        #: link_forwards parity)
+        self.label_source = None
+        self._pending_state = None
+        self.gd_proxies = []
+        for i, layer in enumerate(self.layers):
+            tpe = layer.get("type")
+            if tpe in fused.FC_TYPES or tpe in fused.CONV_TYPES:
+                hyper, hyper_bias, _ = fused.layer_hyper(
+                    layer, self.defaults)
+                name = "gd_" + layer.get("name", "%s_%d" % (tpe, i))
+                self.gd_proxies.append(GDProxy(name, hyper, hyper_bias))
+        self.demand("input", "minibatch_class", "minibatch_size")
+        if self.loss == "mse":
+            self.demand("target")
+        else:
+            self.demand("labels")
+        #: snapshot payload: params + optimizer state + dropout key +
+        #: live hyperparameters (bit-exact fused resume)
+        self.exports = ["fused_state"]
+
+    # -- head-width parity with link_forwards --------------------------------
+    def _fix_head_width(self):
+        last = self.layers[-1]
+        if self.label_source is None:
+            return
+        if self.loss == "mse":
+            # last FC width from the loader's target sample shape
+            # (reference standard_workflow_base.py:324-334, MSE path)
+            if last.get("type") not in fused.FC_TYPES:
+                return
+            tshape = getattr(self.label_source, "targets_shape", None)
+            if not tshape:
+                return
+            fwd = last.setdefault("->", {})
+            oss = fwd.get("output_sample_shape")
+            if oss is not None and \
+                    int(numpy.prod(oss)) != int(numpy.prod(tshape)):
+                self.warning("Overriding output_sample_shape %s with %s "
+                             "(loader targets)", oss, tshape)
+                fwd["output_sample_shape"] = tuple(tshape)
+            elif oss is None:
+                fwd["output_sample_shape"] = tuple(tshape)
+            return
+        if last.get("type") != "softmax":
+            return
+        try:
+            ulc = int(self.label_source.unique_labels_count)
+        except (AttributeError, TypeError):
+            return
+        if not ulc:
+            return
+        fwd = last.setdefault("->", {})
+        oss = fwd.get("output_sample_shape")
+        if oss is not None and int(numpy.prod(oss)) != ulc:
+            self.warning("Overriding softmax output_sample_shape %s "
+                         "with (%d,)", oss, ulc)
+        fwd["output_sample_shape"] = ulc
+
+    def initialize(self, device=None, **kwargs):
+        super(FusedForwardBackward, self).initialize(device=device, **kwargs)
+        if self.net is not None:
+            return
+        self._fix_head_width()
+        dtype = self.dtype
+        if dtype is None:
+            dtype = root.common.engine.get("precision_dtype")
+        if dtype is None:
+            dtype = numpy.float32
+        sample_shape = tuple(self.input.shape[1:])
+        self.net = fused.FusedNet(
+            self.layers, input_sample_shape=sample_shape, mesh=self.mesh,
+            rand=self.rand, dtype=dtype, defaults=self.defaults,
+            dropout_seed=self.dropout_seed,
+            compute_dtype=self.compute_dtype, objective=self.loss,
+            pool_impl=self.pool_impl)
+        batch = int(self.input.shape[0])
+        out_shape = (batch,) + tuple(self.net.specs[-1].out_shape)
+        self.output.reset(numpy.zeros(out_shape, dtype=dtype))
+        if self.loss != "mse":
+            self.max_idx.reset(numpy.zeros(batch, dtype=numpy.int32))
+        if self._pending_state is not None:
+            self._apply_state(self._pending_state)
+            self._pending_state = None
+
+    def _collect_hypers(self):
+        """Rebuild the traced hyper pytree from the live proxies."""
+        hypers = []
+        it = iter(self.gd_proxies)
+        for spec in self.net.specs:
+            if spec.kind in ("fc", "conv"):
+                proxy = next(it)
+                hyper, hyper_bias = proxy.hyper_dicts()
+                h = {"w": hyper}
+                if spec.include_bias:
+                    h["b"] = hyper_bias
+                hypers.append(h)
+            else:
+                hypers.append({})
+        return hypers
+
+    def run(self):
+        self.input.map_read()
+        x = self.input.mem
+        train = int(self.minibatch_class) == TRAIN and not self.forward_mode
+        idx = None
+        if self.loss == "mse":
+            self.target.map_read()
+            if train:
+                metrics = self.net.step_mse(
+                    x, self.target.mem, int(self.minibatch_size),
+                    hypers=self._collect_hypers())
+                out = metrics["output"]
+            else:
+                out = self.net.predict(x)
+        else:
+            self.labels.map_read()
+            labels = numpy.asarray(self.labels.mem, dtype=numpy.int32)
+            if train:
+                metrics = self.net.step(x, labels,
+                                        hypers=self._collect_hypers())
+                out, idx = metrics["output"], metrics["max_idx"]
+            else:
+                out, idx = self.net.predict_with_idx(x)
+        # host copies: the downstream evaluator mixes these with
+        # single-device loader arrays — a mesh-committed jax.Array would
+        # clash there, and the per-minibatch pull is small
+        self.output.map_invalidate()
+        self.output.mem[...] = numpy.asarray(out, dtype=self.output.dtype)
+        if idx is not None:
+            self.max_idx.map_invalidate()
+            self.max_idx.mem[...] = numpy.asarray(idx)
+
+    # -- snapshot / resume ---------------------------------------------------
+    @property
+    def fused_state(self):
+        if self.net is None:
+            return self._pending_state
+        sd = self.net.state_dict()
+        sd["proxies"] = [p.state_dict() for p in self.gd_proxies]
+        return sd
+
+    @fused_state.setter
+    def fused_state(self, value):
+        if value is None:
+            return
+        if self.net is None:
+            self._pending_state = value
+        else:
+            self._apply_state(value)
+
+    def _apply_state(self, sd):
+        self.net.load_state_dict(sd)
+        for proxy, ps in zip(self.gd_proxies, sd.get("proxies", ())):
+            proxy.load_state_dict(ps)
+
+    # -- inference extraction / broadcast parity ----------------------------
+    def host_params(self):
+        if self.net is not None:
+            return self.net.host_params()
+        if self._pending_state is not None:
+            return self._pending_state["params"]
+        raise RuntimeError("fused trainer not initialized")
+
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass
+
+
+class FusedNNRollback(Unit):
+    """Divergence recovery for the fused path (reference
+    nn_rollback.py:44-190 semantics over whole-net snapshots).
+
+    On improvement: bump every proxy's LR by ``lr_plus`` and push the
+    net's full state onto a bounded history.  After ``minus_steps``
+    consecutive non-improvements (or any NaN in the parameters): decay
+    LRs by ``lr_minus`` and restore the oldest stored state.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(FusedNNRollback, self).__init__(workflow, **kwargs)
+        self.trainer = kwargs["trainer"]
+        self.lr_plus = kwargs.get("lr_plus", 1.04)
+        self.lr_minus = kwargs.get("lr_minus", 0.65)
+        self.plus_steps = kwargs.get("plus_steps", 1)
+        self.minus_steps = kwargs.get("minus_steps", 3)
+        self._plus_steps = self.plus_steps
+        self._minus_steps = self.minus_steps
+        self.history_limit = kwargs.get("history_limit", 2)
+        self.improved = None
+        self.demand("improved")
+        self._history = []
+        self._first_run = True
+
+    def _scale_lrs(self, k):
+        for proxy in self.trainer.gd_proxies:
+            proxy.learning_rate *= k
+            proxy.learning_rate_bias *= k
+
+    def _has_nans(self):
+        params = self.trainer.net.host_params()
+        for p in params:
+            for arr in p.values():
+                if numpy.isnan(arr).any():
+                    return True
+        return False
+
+    def run(self):
+        if self.improved:
+            self._plus_steps += 1
+            if self._plus_steps < self.plus_steps:
+                return
+            self._plus_steps = 0
+            self._minus_steps = 0
+            self._scale_lrs(self.lr_plus)
+            self._history.append(self.trainer.fused_state)
+            while len(self._history) > self.history_limit:
+                self._history.pop(0)
+        elif not self._first_run:
+            if self._has_nans():
+                self.warning("NaNs encountered, rolling back")
+                self._minus_steps = self.minus_steps
+            self._minus_steps += 1
+            if self._minus_steps < self.minus_steps:
+                return
+            self._minus_steps = 0
+            self._plus_steps = 0
+            self._scale_lrs(self.lr_minus)
+            if not self._history:
+                self.warning("No rollback state stored")
+            else:
+                self.info("Rolling back fused net state")
+                sd = self._history[0]
+                del self._history[1:]
+                # LRs keep their decayed values; restore net tensors only
+                saved = [p.state_dict()
+                         for p in self.trainer.gd_proxies]
+                self.trainer.fused_state = sd
+                for proxy, ps in zip(self.trainer.gd_proxies, saved):
+                    proxy.load_state_dict(ps)
+        self._first_run = False
+
+    # IDistributable stubs
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass

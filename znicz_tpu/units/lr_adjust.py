@@ -122,6 +122,8 @@ class LearningRateAdjust(Unit):
         self._base_lr_bias = {}
         self._policies = {}       # (id(gd), kind) -> policy instance
         self._got_base = False
+        #: iteration counter in snapshots: schedules resume exactly
+        self.exports = ["_minibatches_count"]
 
     @property
     def has_policy(self):
