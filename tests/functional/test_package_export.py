@@ -447,3 +447,49 @@ def test_mul_export_refuses_unset_factor(tmp_path):
     wf.forwards = [fwd, mul]
     with _pytest.raises(ValueError, match="factor is unset"):
         export_package(wf, str(tmp_path / "bad.zip"))
+
+
+def test_fused_train_export_cpp_serve(tmp_path):
+    """The fused path closes the deployment loop: train on the compiled
+    SPMD step, extract the forward workflow (params injected through the
+    broadcast protocol), export the package, and serve it from the C++
+    runtime with outputs matching the fused net's own predict."""
+    from znicz_tpu.core.backends import JaxDevice
+    from znicz_tpu.core.config import root
+
+    build = _build_cpp()
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    wf = mnist.build(
+        layers=root.mnistr_conv.layers,
+        loader_config={"synthetic_train": 120, "synthetic_valid": 60,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 1, "fail_iterations": 10},
+        snapshotter_config={"prefix": "fpkg", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)},
+        fused=True)
+    wf.initialize(device=JaxDevice())
+    wf.run()
+
+    fwd_wf = wf.extract_forward_workflow()
+    pkg = str(tmp_path / "fused_conv.zip")
+    export_package(fwd_wf, pkg)
+
+    x = numpy.random.RandomState(3).uniform(
+        -1, 1, (10, 28, 28, 1)).astype(numpy.float32)
+    y_fused = numpy.asarray(wf.fused_trainer.net.predict(x))
+
+    in_npy = str(tmp_path / "fin.npy")
+    out_npy = str(tmp_path / "fout.npy")
+    numpy.save(in_npy, x)  # 4-D keeps the (h, w, c) spatial shape
+    res = subprocess.run(
+        [os.path.join(build, "znicz_infer"), pkg, in_npy, out_npy],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    out = numpy.load(out_npy)
+
+    assert out.shape == (10, 10)
+    assert numpy.abs(out - y_fused).max() < 1e-4
+    assert numpy.argmax(out, 1).tolist() == \
+        numpy.argmax(y_fused, 1).tolist()

@@ -176,3 +176,30 @@ def test_similar_kernels():
     w[1] = w[0] + r.uniform(-1e-3, 1e-3, 27)  # near-duplicate pair
     pairs = get_similar_kernels(w, channels=3)
     assert (0, 1) in pairs
+
+
+def test_lr_adjust_base_captured_at_link_time():
+    """The schedule base is the CONFIG learning rate, captured when the
+    GD unit is added — a restored snapshot carrying an already-scheduled
+    LR (fused proxies persist theirs) must not re-base the policy."""
+    from znicz_tpu.core.workflow import DummyWorkflow
+
+    class FakeGD(object):
+        def __init__(self):
+            from znicz_tpu.core.mutable import Bool
+            self.gate_skip = Bool(False)
+            self.learning_rate = 0.4
+            self.learning_rate_bias = 0.4
+
+    wf = DummyWorkflow()
+    adj = lr_adjust.LearningRateAdjust(
+        wf, lr_policy_name="step_exp",
+        lr_parameters={"gamma": 0.5, "step": 10})
+    gd = FakeGD()
+    adj.add_gd_unit(gd)
+    # simulate resume: the restored proxy carries a scheduled LR
+    gd.learning_rate = 0.1
+    adj._minibatches_count = 25  # restored iteration counter
+    adj.run()
+    # policy(25) = base * 0.5^2 off the 0.4 CONFIG base, not off 0.1
+    assert abs(gd.learning_rate - 0.4 * 0.25) < 1e-12

@@ -106,18 +106,22 @@ def test_fused_ae_matches_unit_graph_float64():
 
 
 def test_fused_ae_output_matches_unit_forward():
+    """The fused AE forward (same init PRNG draws) reproduces the unit
+    graph's reconstruction exactly — the deconv output after one pass
+    (unit weights update AFTER the forward, so dc.output reflects the
+    initial weights both sides)."""
     r = numpy.random.RandomState(7)
     x = r.uniform(-1, 1, (2, 12, 12, 1)).astype(numpy.float64)
-    cv, dc_unit = _ae_unit_graph(x, steps=0)
-    for u in (cv,):
-        pass
-    # run just the forward chain on the unit side
+    cv, dc_unit = _ae_unit_graph(x, steps=1)
+    y_unit = numpy.array(dc_unit.output.mem)
+
     net = FusedNet(AE_LAYERS, (12, 12, 1),
                    rand=prng.RandomGenerator().seed(99),
                    dtype=numpy.float64, objective="mse")
     y = numpy.asarray(fused.forward(net.params, jnp.asarray(x),
                                     tuple(net.specs)))
     assert y.shape == x.shape
+    assert numpy.abs(y - y_unit).max() < 1e-12
 
 
 def test_fused_ae_trains_on_mesh():

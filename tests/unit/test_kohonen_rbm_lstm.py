@@ -178,3 +178,26 @@ def test_lstm_registered():
     from znicz_tpu.units.nn_units import mapping
     assert mapping["LSTM"].has_forward
     assert next(mapping["LSTM"].backwards) is lstm.GDLSTM
+
+
+def test_kohonen_train_step_data_parallel_matches_single_device():
+    """SPMD Kohonen (SURVEY §2.8): the batch-sharded SOM step over the
+    8-device mesh reproduces the single-device step — GSPMD's inserted
+    all-reduce replaces the reference's master-slave aggregation."""
+    from znicz_tpu.ops import kohonen as koh_ops
+    from znicz_tpu.parallel import make_mesh
+
+    r = numpy.random.RandomState(7)
+    x = r.uniform(-1, 1, (32, 6))
+    w = r.uniform(-0.05, 0.05, (9, 6))
+    coords = koh_ops.make_coords(9)
+    new_w, hist, argmins = koh_ops.train_step_jax(
+        x, w, coords, 1.4, 0.05)
+    mesh = make_mesh(8)
+    new_w2, hist2, argmins2 = koh_ops.train_step_sharded(
+        mesh, x, w, coords, 1.4, 0.05)
+    assert numpy.abs(numpy.asarray(new_w) -
+                     numpy.asarray(new_w2)).max() < 1e-12
+    assert numpy.array_equal(numpy.asarray(hist), numpy.asarray(hist2))
+    assert numpy.array_equal(numpy.asarray(argmins),
+                             numpy.asarray(argmins2))

@@ -123,6 +123,12 @@ def main(argv=None):
                              "the dataset (network required), train the "
                              "published config, print the BASELINE.md "
                              "comparison row")
+    parser.add_argument("--fused", nargs="?", const=True, default=None,
+                        metavar="K=V[,K=V...]",
+                        help="fused execution mode: compile the whole "
+                             "per-minibatch train step to one SPMD XLA "
+                             "computation (e.g. --fused "
+                             "mesh=8,model_parallel=2,pool_impl=gather)")
     parser.add_argument("--list", action="store_true",
                         help="list bundled samples and exit")
     args = parser.parse_args(argv)
@@ -146,6 +152,12 @@ def main(argv=None):
     module = resolve_workflow_module(args.workflow)
     for assignment in args.config:
         apply_override(root, assignment)
+    if args.fused is not None and (args.parity or args.optimize):
+        # not silently ignored: the GA/parity drivers run their own
+        # training paths (the GA's fused population evaluator is a
+        # sample-level opt-in, not this flag)
+        parser.error("--fused applies to plain training runs; it cannot "
+                     "combine with --parity/--optimize")
     if args.parity:
         if args.optimize or args.snapshot or args.testing or \
                 args.dry_run or args.dump_graph:
@@ -162,9 +174,21 @@ def main(argv=None):
             parser.error("--optimize cannot be combined with --snapshot/"
                          "--testing/--dry-run/--dump-graph")
         return run_genetics(module, args.optimize)
+    fused = args.fused
+    if isinstance(fused, str):
+        cfg = {}
+        for pair in fused.split(","):
+            key, sep, raw = pair.partition("=")
+            if not sep:
+                parser.error("--fused wants K=V pairs, got %r" % pair)
+            try:
+                cfg[key.strip()] = ast.literal_eval(raw)
+            except (ValueError, SyntaxError):
+                cfg[key.strip()] = raw
+        fused = cfg
     dry_run = args.dry_run or (bool(args.dump_graph) and not args.testing)
     wf = run_workflow(module, snapshot=args.snapshot,
-                      testing=args.testing, dry_run=dry_run)
+                      testing=args.testing, dry_run=dry_run, fused=fused)
     if args.dump_graph:
         wf.dump_graph(args.dump_graph)
     decision = getattr(wf, "decision", None)

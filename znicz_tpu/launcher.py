@@ -39,12 +39,15 @@ class Launcher(Logger):
     """
 
     def __init__(self, testing=False, snapshot=None, device=None,
-                 dry_run=False):
+                 dry_run=False, fused=None):
         super(Launcher, self).__init__(logger_name="Launcher")
         self.testing = testing
         self.snapshot_path = snapshot
         self.device = device
         self.dry_run = dry_run
+        #: fused execution mode forwarded to StandardWorkflow-based
+        #: samples (True or a config dict — see link_fused_trainer)
+        self.fused = fused
         self.workflow = None
         self.interactive = False
         self._state = None
@@ -80,11 +83,18 @@ class Launcher(Logger):
             from znicz_tpu.core.snapshotter import SnapshotterToFile
             self._state = SnapshotterToFile.import_(self.snapshot_path)
             self.info("will restore snapshot %s", self.snapshot_path)
+        if self.fused is not None:
+            kwargs.setdefault("fused", self.fused)
         if isinstance(factory, type):
             wf = factory(self, **kwargs)
         else:
             wf = factory(**kwargs)
         self.workflow = wf
+        if self.fused is not None and \
+                getattr(wf, "fused_trainer", None) is None:
+            self.warning("--fused requested but %s does not build a "
+                         "fused trainer (hand-wired workflow?); running "
+                         "the unit-graph path", type(wf).__name__)
         return wf, self._state is not None
 
     def main(self, **kwargs):
@@ -151,7 +161,7 @@ def list_samples():
 
 
 def run_workflow(spec, snapshot=None, testing=False, dry_run=False,
-                 device=None):
+                 device=None, fused=None):
     """Drive a workflow module's ``run(load, main)``.
 
     ``spec`` is a module object or anything
@@ -162,15 +172,16 @@ def run_workflow(spec, snapshot=None, testing=False, dry_run=False,
     module = spec if hasattr(spec, "__file__") else \
         resolve_workflow_module(spec)
     launcher = Launcher(testing=testing, snapshot=snapshot,
-                        device=device, dry_run=dry_run)
+                        device=device, dry_run=dry_run, fused=fused)
     if hasattr(module, "run"):
         module.run(launcher.load, launcher.main)
         return launcher.workflow
     if hasattr(module, "run_sample"):
-        if snapshot or testing or dry_run:
+        if snapshot or testing or dry_run or fused is not None:
             raise SystemExit(
                 "%s exposes only run_sample(); --snapshot/--testing/"
-                "--dry-run need the run(load, main) contract" % spec)
+                "--dry-run/--fused need the run(load, main) contract"
+                % spec)
         return module.run_sample(device=device)
     raise SystemExit(
         "%s exposes neither run(load, main) nor run_sample()" % spec)

@@ -66,6 +66,23 @@ def train_step_jax(x, w, coords, sigma, gmult):
     return w + gradients, hist, argmins
 
 
+def train_step_sharded(mesh, x, w, coords, sigma, gmult):
+    """Data-parallel SOM step over a device mesh: the batch shards over
+    the ``data`` axis, weights/coords replicate, and GSPMD inserts the
+    gravity-sum all-reduce (the batch-additive ``gravity.T @ x`` term) —
+    the SPMD replacement for aggregating Kohonen updates through the
+    reference's master-slave protocol.  Returns the same
+    (new_w, winner_histogram, argmins) as :func:`train_step_jax`, with
+    argmins sharded over ``data``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    xs = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+    rep = NamedSharding(mesh, P())
+    x = jax.device_put(numpy.asarray(x), xs)
+    w = jax.device_put(numpy.asarray(w), rep)
+    coords = jax.device_put(numpy.asarray(coords), rep)
+    return train_step_jax(x, w, coords, sigma, gmult)
+
+
 def winners_numpy(x, w):
     x2 = x.reshape(x.shape[0], -1)
     out = numpy.empty(x2.shape[0], dtype=numpy.int32)

@@ -433,6 +433,11 @@ def build_specs(layers, input_sample_shape, defaults=None):
             # shared weights (GDDeconv is the sole gradient unit in the
             # AE stages) — mark the conv to stop_gradient its own use
             conv_spec.stop_gradient = True
+            # a "<-" on the deconv governs the SHARED weights' update
+            # (reference: GDDeconv's kwargs), overriding the conv's
+            if orig_layer.get("<-"):
+                (conv_spec.hyper, conv_spec.hyper_bias,
+                 conv_spec.flags) = layer_hyper(orig_layer, defaults)
             specs.append(DeconvSpec(
                 type=tpe, in_shape=shape, out_shape=out_shape, tied=tied,
                 n_kernels=conv_spec.n_kernels, kx=kx, ky=ky,

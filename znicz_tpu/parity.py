@@ -100,14 +100,24 @@ def ensure_dataset(name, directory=None):
         try:
             if not os.path.exists(dest):
                 _fetch(url, dest)
-            with tarfile.open(dest) as tf:
-                tf.extractall(directory)
+            try:
+                with tarfile.open(dest) as tf:
+                    tf.extractall(directory)
+            except tarfile.TarError as e:
+                # truncated/corrupt cache poisons every retry — drop it
+                os.remove(dest)
+                raise OSError("corrupt archive removed, re-run: %s" % e)
             src = os.path.join(directory, member_dir)
             if os.path.isdir(src):
                 for f in spec["files"]:
                     p = os.path.join(src, f)
                     if os.path.exists(p):
                         shutil.move(p, os.path.join(directory, f))
+            still = [f for f in spec["files"]
+                     if not os.path.exists(os.path.join(directory, f))]
+            if still:
+                raise OSError("archive did not contain %s"
+                              % ", ".join(still))
             return directory
         except (urllib.error.URLError, OSError) as e:
             errors.append("%s: %s" % (url, e))

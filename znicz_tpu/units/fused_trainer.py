@@ -129,13 +129,23 @@ class FusedForwardBackward(Unit):
         self.label_source = None
         self._pending_state = None
         self.gd_proxies = []
+        # a tied deconv's "<-" governs the SHARED weights' update — its
+        # hyper seeds the tied conv's proxy (build_specs applies the
+        # same override to the spec)
+        overrides = {}
+        for i, layer in enumerate(self.layers):
+            if layer.get("type") == "deconv" and layer.get("<-"):
+                tied = layer.get("->", {}).get("tied_to")
+                if tied is not None:
+                    overrides[tied] = layer
         for i, layer in enumerate(self.layers):
             tpe = layer.get("type")
             if tpe in fused.FC_TYPES or tpe in fused.CONV_TYPES:
+                name = layer.get("name", "%s_%d" % (tpe, i))
                 hyper, hyper_bias, _ = fused.layer_hyper(
-                    layer, self.defaults)
-                name = "gd_" + layer.get("name", "%s_%d" % (tpe, i))
-                self.gd_proxies.append(GDProxy(name, hyper, hyper_bias))
+                    overrides.get(name, layer), self.defaults)
+                self.gd_proxies.append(GDProxy("gd_" + name, hyper,
+                                               hyper_bias))
         self.demand("input", "minibatch_class", "minibatch_size")
         if self.loss == "mse":
             self.demand("target")

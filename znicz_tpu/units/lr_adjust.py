@@ -121,7 +121,6 @@ class LearningRateAdjust(Unit):
         self._base_lr = {}
         self._base_lr_bias = {}
         self._policies = {}       # (id(gd), kind) -> policy instance
-        self._got_base = False
         #: iteration counter in snapshots: schedules resume exactly
         self.exports = ["_minibatches_count"]
 
@@ -133,6 +132,12 @@ class LearningRateAdjust(Unit):
     def add_gd_unit(self, gd_unit):
         self.gate_skip = gd_unit.gate_skip
         self._gd_units.append(gd_unit)
+        # capture the schedule BASE at link time, when learning_rate is
+        # still the config value — a first-run capture would re-base off
+        # an already-scheduled LR after snapshot resume (the fused
+        # proxies persist their live LR for rollback exactness)
+        self._base_lr[gd_unit] = gd_unit.learning_rate
+        self._base_lr_bias[gd_unit] = gd_unit.learning_rate_bias
 
     def _adjusted(self, gd, kind, base, policy_name, params):
         if policy_name is None:
@@ -147,11 +152,6 @@ class LearningRateAdjust(Unit):
     def run(self):
         if self.is_slave:
             return
-        if not self._got_base:
-            for gd in self._gd_units:
-                self._base_lr[gd] = gd.learning_rate
-                self._base_lr_bias[gd] = gd.learning_rate_bias
-            self._got_base = True
         for gd in self._gd_units:
             lr = self._adjusted(gd, "w", self._base_lr[gd],
                                 self.lr_policy_name, self.lr_parameters)
