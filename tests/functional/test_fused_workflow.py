@@ -154,6 +154,17 @@ def test_fused_cifar_caffe_on_mesh_matches_unit_graph(tmp_path,
     and the whole trajectory matches the unit-graph mode exactly."""
     from znicz_tpu.samples import cifar
 
+    # LR schedule with a boundary INSIDE the run (10 train steps,
+    # 10x drop after step 3): the fused adjuster must apply policy(k)
+    # to update k exactly like the unit graph — an off-by-one shows up
+    # as trajectory divergence from step 4 on
+    schedule = {"do": True, "lr_policy_name": "arbitrary_step",
+                "bias_lr_policy_name": "arbitrary_step",
+                "lr_parameters": {
+                    "lrs_with_lengths": [(1, 3), (0.1, 100000)]},
+                "bias_lr_parameters": {
+                    "lrs_with_lengths": [(1, 3), (0.1, 100000)]}}
+
     def run(fused_cfg):
         _seed()
         kwargs = {"fused": fused_cfg} if fused_cfg is not None else {}
@@ -162,6 +173,7 @@ def test_fused_cifar_caffe_on_mesh_matches_unit_graph(tmp_path,
             decision_config={"max_epochs": 2, "fail_iterations": 100},
             snapshotter_config={"directory": str(tmp_path),
                                 "compression": ""},
+            lr_adjuster_config=dict(schedule),
             **kwargs)
         wf.initialize(device=JaxDevice())
         wf.run()
@@ -269,3 +281,83 @@ def test_fused_rollback_restores_state(tmp_path, float64_engine):
     for p_s, p_r in zip(stored, restored):
         for k in p_s:
             assert numpy.array_equal(p_s[k], p_r[k])
+
+
+def test_fused_zero_filter_matches_unit_graph(tmp_path, float64_engine):
+    """Grouped-conv masking (zero_filter) in fused mode: the AlexNet
+    grouping pattern trains identically to the unit graph — the mask
+    re-zeroes before every update, so weight decay/ortho see masked
+    weights on both paths."""
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    import znicz_tpu.loader.loader_mnist  # noqa: F401
+
+    layers = [
+        {"name": "c1", "type": "conv_tanh",
+         "->": {"n_kernels": 4, "kx": 3, "ky": 3},
+         "<-": {"learning_rate": 0.1, "weights_decay": 0.001,
+                "gradient_moment": 0.9}},
+        {"name": "mp", "type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"name": "zf", "type": "zero_filter", "grouping": 2},
+        {"name": "c2", "type": "conv_tanh",
+         "->": {"n_kernels": 6, "kx": 3, "ky": 3},
+         "<-": {"learning_rate": 0.1, "weights_decay": 0.001,
+                "gradient_moment": 0.9}},
+        {"name": "sm", "type": "softmax",
+         "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.1}},
+    ]
+
+    def run(fused):
+        _seed()
+        kwargs = {"fused": {"pool_impl": "gather"}} if fused else {}
+        wf = StandardWorkflow(
+            None, layers=layers, loader_name="mnist_loader",
+            loader_config={"synthetic_train": 60, "synthetic_valid": 30,
+                           "minibatch_size": 30},
+            decision_config={"max_epochs": 2, "fail_iterations": 20},
+            snapshotter_config={"directory": str(tmp_path),
+                                "interval": 100, "time_interval": 1e9},
+            **kwargs)
+        wf.initialize(device=JaxDevice())
+        wf.run()
+        return wf
+
+    wf_f = run(True)
+    wf_u = run(False)
+    assert list(wf_f.decision.epoch_n_err) == list(wf_u.decision.epoch_n_err)
+
+    # the grouped conv's USED weights agree; compare them MASKED (the
+    # unit path lets masked positions drift between passes, the fused
+    # path keeps them at zero — both use zero)
+    spec_params = wf_f.fused_trainer.host_params()
+    c2_spec = wf_f.fused_trainer.net.specs[3]
+    mask = c2_spec.weight_mask
+    w_f = spec_params[3]["w"] * mask
+    c2_unit = wf_u.forwards[3]
+    w_u = numpy.array(c2_unit.weights.mem) * mask
+    assert numpy.abs(w_f - w_u).max() < 1e-12
+    # fused stored masked positions are exactly zero
+    assert numpy.abs(spec_params[3]["w"] * (1 - mask)).max() == 0.0
+
+
+def test_fused_alexnet_builds_and_trains(tmp_path):
+    """The 21-layer AlexNet topology (grouped convs, LRN, dropout)
+    trains on the fused path over the 8-device mesh."""
+    from znicz_tpu.samples.research import alexnet
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    wf = alexnet.build(
+        loader_config={"n_train": 16, "n_valid": 8, "minibatch_size": 8},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"interval": 1000, "time_interval": 1e9,
+                            "directory": str(tmp_path)},
+        fused={"mesh": 8, "model_parallel": 2})
+    wf.initialize(device=JaxDevice())
+    wf.run()
+    assert wf.fused_trainer is not None
+    assert wf.loader.epoch_number == 1
+    assert wf.decision.epoch_n_err[VALID] is not None
+    # the grouped layers carry masks in their specs
+    masked = [s for s in wf.fused_trainer.net.specs
+              if getattr(s, "weight_mask", None) is not None]
+    assert len(masked) == 4

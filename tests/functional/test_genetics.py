@@ -140,7 +140,9 @@ def test_population_ga_parallel_evaluation_speedup():
         # same individuals trained serially (compile amortizes across
         # generations/sessions; at real scale it is noise)
         gen = [[0.002 + 0.01 * i] for i in range(6)]
-        pop_eval([[0.5]])  # warm-up / compile
+        # warm the SIZE-6 compiled variant (vmap specializes on the
+        # population axis length)
+        pop_eval([[0.5 + 0.01 * i] for i in range(6)])
         t0 = time.time()
         pop_eval(gen)
         batch_time = time.time() - t0

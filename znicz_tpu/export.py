@@ -40,9 +40,28 @@ def export_package(workflow, path):
     forwards = list(workflow.forwards)
     layers = []
     files = {}
+    pending_mask = None
     for i, fwd in enumerate(forwards):
-        entry = {"type": _layer_type(fwd), "name": fwd.name, "arrays": {}}
+        tpe = _layer_type(fwd)
+        if tpe == "zero_filter":
+            # fold the grouping mask into the NEXT layer's exported
+            # weights (the runtime chains pure Execute calls; a
+            # weight-mutating unit has no place there) — the masked
+            # weights ARE what the training forward used.  The mask
+            # comes from the ZeroFiller itself (single source of the
+            # grouping formula).
+            fwd._ensure_mask()
+            pending_mask = numpy.array(fwd.mask.mem)
+            continue
+        entry = {"type": tpe, "name": fwd.name, "arrays": {}}
         data = fwd.package_export()
+        if pending_mask is not None:
+            w = data.get("weights")
+            if w is not None:
+                data = dict(data, weights=(
+                    w.reshape(pending_mask.shape) *
+                    pending_mask.astype(w.dtype)).reshape(w.shape))
+            pending_mask = None
         for attr, value in data.items():
             if isinstance(value, numpy.ndarray):
                 fname = "layer%d_%s.npy" % (i, attr)

@@ -16,6 +16,7 @@ All derivatives are functions of the OUTPUT y, matching the reference's
 """
 
 import numpy
+import jax
 import jax.numpy as jnp
 
 TANH_A = 1.7159
@@ -30,18 +31,54 @@ TANHLOG_B = 305.459953195
 
 
 # -- jax twins --------------------------------------------------------------
+#
+# The output-space activations carry a custom VJP built from the SAME
+# f'(y) formulas (derivative_jax) the backward units run — not jax's
+# autodiff of the forward.  The formulas are the executable spec down to
+# the reference's rounded constants (TANH_DB prints -0.388484177 where
+# -(B/A) is ...77399...), so autodiff-vs-unit gradients would differ at
+# ~1e-9 relative per tanh layer and the fused path's float64 parity with
+# the unit graph would erode; with the custom VJP both paths apply the
+# identical backward expression.
+
+def _with_output_vjp(name, fwd):
+    f = jax.custom_vjp(fwd)
+
+    def fwd_rule(x):
+        y = fwd(x)
+        return y, y
+
+    def bwd_rule(y, ct):
+        return (ct * derivative_jax(name, y),)
+
+    f.defvjp(fwd_rule, bwd_rule)
+    return f
+
+
+_tanh_scaled = _with_output_vjp(
+    "tanh", lambda x: TANH_A * jnp.tanh(TANH_B * x))
+_softplus = _with_output_vjp(
+    "relu", lambda x: jnp.where(
+        x > 15, x, jnp.log1p(jnp.exp(jnp.minimum(x, 15.0)))))
+_sigmoid = _with_output_vjp(
+    "sigmoid", lambda x: 1.0 / (1.0 + jnp.exp(-x)))
+# strict relu: the unit derivative is [y > 0]; autodiff of maximum
+# routes the x == 0 tie as 0.5 — pin the unit formula
+_strict_relu = _with_output_vjp(
+    "strict_relu", lambda x: jnp.maximum(x, 0))
+
 
 def apply_jax(name, x):
     if name == "linear":
         return x
     if name == "tanh":
-        return TANH_A * jnp.tanh(TANH_B * x)
+        return _tanh_scaled(x)
     if name == "relu":
-        return jnp.where(x > 15, x, jnp.log1p(jnp.exp(jnp.minimum(x, 15.0))))
+        return _softplus(x)
     if name == "strict_relu":
-        return jnp.maximum(x, 0)
+        return _strict_relu(x)
     if name == "sigmoid":
-        return 1.0 / (1.0 + jnp.exp(-x))
+        return _sigmoid(x)
     raise ValueError("unknown activation %r" % name)
 
 

@@ -282,6 +282,22 @@ class DepoolSpec:
 
 
 @dataclass
+class ZeroFillSpec:
+    """Placeholder for a ``zero_filter`` layer (reference
+    weights_zerofilling.py:46-137): identity in the forward chain; its
+    grouping mask attaches to the NEXT parameterized spec (the unit
+    graph links the next forward's weights into the ZeroFiller).  Kept
+    as a spec so the spec list stays 1:1 with the layer list."""
+    type: str
+    in_shape: tuple
+    out_shape: tuple
+    grouping: int
+
+    kind = "zerofill"
+    is_softmax = False
+
+
+@dataclass
 class DropoutSpec:
     """Inverted dropout: keep-mask / (1 - ratio) in train mode
     (reference dropout.py:147-153; the fused path draws the mask from a
@@ -317,6 +333,7 @@ def build_specs(layers, input_sample_shape, defaults=None):
     defaults = dict(DEFAULT_HYPER, **(defaults or {}))
     specs = []
     names = {}  # layer name -> spec index (for tied deconv/depool)
+    pending_grouping = None  # zero_filter masks the NEXT layer's weights
     shape = _normalize_sample_shape(input_sample_shape)
     for index, layer in enumerate(layers):
         orig_layer = layer
@@ -406,6 +423,14 @@ def build_specs(layers, input_sample_shape, defaults=None):
             specs.append(DropoutSpec(
                 type=tpe, in_shape=shape, out_shape=shape,
                 ratio=fwd.get("dropout_ratio", 0.5)))
+        elif tpe == "zero_filter":
+            pending_grouping = int(fwd.get("grouping", 2))
+            if pending_grouping < 2:
+                raise ValueError("grouping value %d is invalid"
+                                 % pending_grouping)
+            specs.append(ZeroFillSpec(
+                type=tpe, in_shape=shape, out_shape=shape,
+                grouping=pending_grouping))
         elif tpe == "deconv":
             tied_name = fwd.get("tied_to")
             if tied_name is None or tied_name not in names:
@@ -470,6 +495,24 @@ def build_specs(layers, input_sample_shape, defaults=None):
             raise ValueError("fused path does not support layer type %r"
                              % tpe)
         names[name] = len(specs) - 1
+        spec = specs[-1]
+        if pending_grouping is not None and spec.kind in ("fc", "conv"):
+            # the zero_filter grouping mask for this layer's weights
+            # (reference mask: (k % G != c % G), zerofilling.py)
+            if spec.kind == "fc":
+                kernels, chans = spec.n_out, spec.n_in
+            else:
+                kernels = spec.n_kernels
+                chans = spec.kx * spec.ky * spec.n_channels
+            g = pending_grouping
+            if chans % g:
+                raise ValueError(
+                    "Non-multiple of grouping weights shape: (%d, %d), "
+                    "grouping=%d" % (kernels, chans, g))
+            krow = numpy.arange(kernels)[:, None] % g
+            ccol = numpy.arange(chans)[None, :] % g
+            spec.weight_mask = (krow != ccol).astype(numpy.float64)
+            pending_grouping = None
     return specs
 
 
@@ -562,7 +605,11 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
             raise AssertionError("deferred activation not consumed")
         if spec.kind == "fc":
             y = y.reshape(y.shape[0], -1)
-            y = y @ _p(p["w"]).T
+            w = _p(p["w"])
+            mask = getattr(spec, "weight_mask", None)
+            if mask is not None:
+                w = w * jnp.asarray(mask, w.dtype)
+            y = y @ w.T
             if "b" in p:
                 y = y + _p(p["b"])
             if not spec.is_softmax:
@@ -574,6 +621,9 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
         elif spec.kind == "conv":
             y = y.reshape((y.shape[0],) + spec.in_shape)
             w = _p(p["w"])
+            mask = getattr(spec, "weight_mask", None)
+            if mask is not None:
+                w = w * jnp.asarray(mask, w.dtype)
             if getattr(spec, "stop_gradient", False):
                 # weights shared with a tied deconv: only the DECONV
                 # application trains them (reference AE stages run
@@ -651,6 +701,8 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
                 key, sub = jax.random.split(key)
                 keep = jax.random.uniform(sub, y.shape) >= spec.ratio
                 y = y * keep.astype(y.dtype) / (1.0 - spec.ratio)
+        elif spec.kind == "zerofill":
+            pass  # identity: its mask is applied at the target layer
         else:  # pragma: no cover - build_specs rejects unknown kinds
             raise AssertionError(spec.kind)
     return y
@@ -698,6 +750,7 @@ def _loss_and_stats_mse(params, x, target, batch_size, specs, key=None,
 
 def _train_step_mse(params, state, x, target, batch_size, specs, key=None,
                     compute_dtype=None, hypers=None):
+    params = _apply_weight_masks(params, specs)
     (loss, y), grads = jax.value_and_grad(
         lambda p: _loss_and_stats_mse(p, x, target, batch_size, specs,
                                       key, compute_dtype),
@@ -1053,8 +1106,23 @@ def default_hypers(specs):
     return hypers
 
 
+def _apply_weight_masks(params, specs):
+    """The zero_filter pass: re-zero grouped weight positions before the
+    step (the unit graph's ZeroFiller masks the shared Array in place
+    each forward pass, BEFORE the GD update — so weight decay/ortho see
+    masked weights; parity requires the same order here)."""
+    out = []
+    for spec, p in zip(specs, params):
+        mask = getattr(spec, "weight_mask", None)
+        if mask is not None and "w" in p:
+            p = dict(p, w=p["w"] * jnp.asarray(mask, p["w"].dtype))
+        out.append(p)
+    return out
+
+
 def _train_step(params, state, x, labels, specs, key=None,
                 compute_dtype=None, hypers=None, with_output=False):
+    params = _apply_weight_masks(params, specs)
     (loss, (n_err, probs, max_idx)), grads = jax.value_and_grad(
         lambda p: _loss_and_stats(p, x, labels, specs, key, compute_dtype),
         has_aux=True)(params)

@@ -102,7 +102,12 @@ def ensure_dataset(name, directory=None):
                 _fetch(url, dest)
             try:
                 with tarfile.open(dest) as tf:
-                    tf.extractall(directory)
+                    try:
+                        # confine members to the target directory (a
+                        # compromised mirror must not traverse paths)
+                        tf.extractall(directory, filter="data")
+                    except TypeError:  # Python < 3.12
+                        tf.extractall(directory)
             except tarfile.TarError as e:
                 # truncated/corrupt cache poisons every retry — drop it
                 os.remove(dest)

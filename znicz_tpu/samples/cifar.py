@@ -96,12 +96,10 @@ class CifarWorkflow(StandardWorkflow):
         if adj_cfg.pop("do", False):
             # schedule applies per minibatch before the GD units fire
             self.link_lr_adjuster(self.snapshotter, **adj_cfg)
-            if self.fused_trainer is not None:
-                # fused loop was snapshotter -> repeater; insert adjuster
-                self.repeater.unlink_from(self.snapshotter)
-                self.repeater.link_from(self.lr_adjuster)
-            else:
-                # re-route: gds were linked from snapshotter
+            if self.fused_trainer is None:
+                # re-route: gds were linked from snapshotter (the fused
+                # branch of link_lr_adjuster inserts itself between the
+                # loader and the train step — no surgery here)
                 self.gds[-1].unlink_from(self.snapshotter)
                 self.gds[-1].link_from(self.lr_adjuster)
 

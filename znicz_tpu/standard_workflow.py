@@ -232,13 +232,20 @@ class StandardWorkflow(StandardWorkflowBase):
         if self.fused_trainer is not None:
             # fused mode: the proxies carry the hyperparameter surface;
             # the schedule's new LR reaches the jitted step as a traced
-            # argument (no recompile)
+            # argument (no recompile).  The adjuster fires between the
+            # loader and the train step — the unit graph runs it before
+            # the GD updates of the SAME minibatch (snapshotter ->
+            # adjuster -> gds), so update k must use policy(k), not
+            # policy(k-1); ``parents`` are ignored for this insertion.
             for proxy in self.fused_trainer.gd_proxies:
-                proxy.gate_skip = self.decision.gd_skip
                 self.lr_adjuster.add_gd_unit(proxy)
-        else:
-            for gd in self.gds:
-                self.lr_adjuster.add_gd_unit(gd)
+            self.lr_adjuster.train_gate_loader = self.loader
+            self.fused_trainer.unlink_from(self.loader)
+            self.lr_adjuster.link_from(self.loader)
+            self.fused_trainer.link_from(self.lr_adjuster)
+            return self.lr_adjuster
+        for gd in self.gds:
+            self.lr_adjuster.add_gd_unit(gd)
         self.lr_adjuster.link_from(*parents)
         return self.lr_adjuster
 

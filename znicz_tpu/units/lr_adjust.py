@@ -114,6 +114,10 @@ class LearningRateAdjust(Unit):
         super(LearningRateAdjust, self).__init__(workflow, **kwargs)
         self._gd_units = []
         self._minibatches_count = 0
+        #: fused mode: the adjuster fires between loader and train step,
+        #: so the gd_skip gate (set by the decision AFTER the step) is
+        #: stale — gate on the loader's CURRENT minibatch class instead
+        self.train_gate_loader = None
         self.lr_policy_name = kwargs.get("lr_policy_name", None)
         self.bias_lr_policy_name = kwargs.get("bias_lr_policy_name", None)
         self.lr_parameters = kwargs.get("lr_parameters", {})
@@ -152,6 +156,10 @@ class LearningRateAdjust(Unit):
     def run(self):
         if self.is_slave:
             return
+        if self.train_gate_loader is not None:
+            from znicz_tpu.loader.base import TRAIN
+            if int(self.train_gate_loader.minibatch_class) != TRAIN:
+                return
         for gd in self._gd_units:
             lr = self._adjusted(gd, "w", self._base_lr[gd],
                                 self.lr_policy_name, self.lr_parameters)
