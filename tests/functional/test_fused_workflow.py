@@ -361,3 +361,29 @@ def test_fused_alexnet_builds_and_trains(tmp_path):
     masked = [s for s in wf.fused_trainer.net.specs
               if getattr(s, "weight_mask", None) is not None]
     assert len(masked) == 4
+
+
+def test_fused_weights_plotters_render(tmp_path, float64_engine):
+    """The plotter tier keeps its role in fused mode: Weights2D and
+    MultiHistogram read the trainer's device-backed weight views."""
+    _seed()
+    # 2 epochs: epoch-1's end fires the plotters while training is
+    # still incomplete (the final iteration stops at the end point)
+    wf = _mnist_conv(tmp_path, 2, fused={"pool_impl": "gather"})
+    last = wf.link_weights_plotter(wf.snapshotter)
+    last = wf.link_multi_hist_plotter(last)
+    wf.repeater.unlink_from(wf.snapshotter)
+    wf.repeater.link_from(last)
+    wf.run()
+
+    assert len(wf.weights_plotter) == 4   # conv, conv, fc, softmax
+    for p in wf.weights_plotter:
+        assert p.input is not None and p.input
+    # the views track the TRAINED params
+    for i, view in wf.fused_trainer.weight_views:
+        trained = wf.fused_trainer.host_params()[i]["w"]
+        numpy.testing.assert_array_equal(
+            numpy.asarray(view.mem), trained)
+    assert len(wf.multi_hist_plotter) == 4
+    for p in wf.multi_hist_plotter:
+        assert p.histograms, "histogram plotter never fired"

@@ -302,20 +302,30 @@ class StandardWorkflow(StandardWorkflowBase):
             prev = (p,)
         return self.error_plotter[-1]
 
+    def _plottable_weight_sources(self):
+        """[(index, weights Array)] across both execution modes — the
+        unit graph's forward units or the fused trainer's device-backed
+        weight views (created at construction, populated at
+        initialize; Weights2D.fill skips empty Arrays at run time)."""
+        if self.fused_trainer is not None:
+            return list(self.fused_trainer.weight_views)
+        out = []
+        for i, fwd in enumerate(self.forwards):
+            if getattr(fwd, "weights", None) is not None:
+                out.append((i, fwd.weights))
+        return out
+
     def link_weights_plotter(self, *parents, **kwargs):
         """Weight-image grids per layer
-        (reference standard_workflow.py:853-891)."""
+        (reference standard_workflow.py:853-891); works in fused mode
+        through the trainer's weight views."""
         from znicz_tpu.units.nn_plotting_units import Weights2D
         limit = kwargs.get("limit", 64)
         self.weights_plotter = []
         prev = parents
-        for i, fwd in enumerate(self.forwards):
-            # weight Arrays are still empty at link time; Weights2D.fill
-            # skips empty arrays at run time (weightless units stay empty)
-            if getattr(fwd, "weights", None) is None:
-                continue
+        for i, weights in self._plottable_weight_sources():
             p = Weights2D(self, name="weights_%d" % i, limit=limit)
-            p.input = fwd.weights
+            p.input = weights
             p.link_from(*prev)
             p.gate_skip = ~self.decision.epoch_ended
             self.weights_plotter.append(p)
@@ -365,13 +375,17 @@ class StandardWorkflow(StandardWorkflowBase):
         weights_input = kwargs.get("weights_input", "weights")
         self.multi_hist_plotter = []
         prev = parents
-        for i, fwd in enumerate(self.forwards):
-            if getattr(fwd, weights_input, None) is None:
-                continue
+        if weights_input == "weights":
+            sources = self._plottable_weight_sources()
+        else:
+            sources = [(i, getattr(fwd, weights_input))
+                       for i, fwd in enumerate(self.forwards)
+                       if getattr(fwd, weights_input, None) is not None]
+        for i, arr in sources:
             p = MultiHistogram(self, name="hist_%d" % i,
                                hist_number=kwargs.get("hist_number", 16),
                                n_bars=kwargs.get("n_bars", 25))
-            p.input = getattr(fwd, weights_input)
+            p.input = arr
             p.link_from(*prev)
             p.gate_skip = ~self.decision.epoch_ended
             self.multi_hist_plotter.append(p)

@@ -138,6 +138,11 @@ class FusedForwardBackward(Unit):
                 tied = layer.get("->", {}).get("tied_to")
                 if tied is not None:
                     overrides[tied] = layer
+        #: device-backed per-layer weight views for the plotter tier
+        #: (Weights2D & friends read ``weights`` Arrays); empty until
+        #: initialize, re-pointed at the current params after every
+        #: train step and state restore
+        self.weight_views = []
         for i, layer in enumerate(self.layers):
             tpe = layer.get("type")
             if tpe in fused.FC_TYPES or tpe in fused.CONV_TYPES:
@@ -146,6 +151,8 @@ class FusedForwardBackward(Unit):
                     overrides.get(name, layer), self.defaults)
                 self.gd_proxies.append(GDProxy("gd_" + name, hyper,
                                                hyper_bias))
+                self.weight_views.append(
+                    (i, Array(name=name + "_weights")))
         self.demand("input", "minibatch_class", "minibatch_size")
         if self.loss == "mse":
             self.demand("target")
@@ -210,6 +217,7 @@ class FusedForwardBackward(Unit):
             dropout_seed=self.dropout_seed,
             compute_dtype=self.compute_dtype, objective=self.loss,
             pool_impl=self.pool_impl)
+        self._refresh_weight_views()
         batch = int(self.input.shape[0])
         out_shape = (batch,) + tuple(self.net.specs[-1].out_shape)
         self.output.reset(numpy.zeros(out_shape, dtype=dtype))
@@ -266,6 +274,10 @@ class FusedForwardBackward(Unit):
         if idx is not None:
             self.max_idx.map_invalidate()
             self.max_idx.mem[...] = numpy.asarray(idx)
+        if train:
+            # re-point the plotter views at the post-update params
+            # (zero-copy; plotters pull to host only when they fire)
+            self._refresh_weight_views()
 
     # -- snapshot / resume ---------------------------------------------------
     @property
@@ -285,10 +297,17 @@ class FusedForwardBackward(Unit):
         else:
             self._apply_state(value)
 
+    def _refresh_weight_views(self):
+        for i, view in self.weight_views:
+            view.set_dev(self.net.params[i]["w"])
+
     def _apply_state(self, sd):
         self.net.load_state_dict(sd)
         for proxy, ps in zip(self.gd_proxies, sd.get("proxies", ())):
             proxy.load_state_dict(ps)
+        # load_state_dict REPLACES the params pytree — re-point the
+        # plotter views or they keep showing the pre-restore weights
+        self._refresh_weight_views()
 
     # -- inference extraction / broadcast parity ----------------------------
     def host_params(self):
