@@ -30,6 +30,14 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: float64 dual-trajectory / mesh / multi-epoch tests — the "
+        "full lane (tools/ci.sh full); the fast lane (tools/ci.sh) "
+        "deselects them with -m 'not slow'")
+
+
 @pytest.fixture(autouse=True)
 def _snapshots_to_tmp(tmp_path, monkeypatch):
     """Keep generated snapshot pickles out of the repo tree."""

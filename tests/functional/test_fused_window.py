@@ -1,0 +1,177 @@
+"""Scan windows in the control plane (VERDICT r3 next #1).
+
+The fused trainer batches K TRAIN minibatches per compiled dispatch
+(FusedNet.run_window — one ``lax.scan`` call), while the unit graph keeps
+its epoch-level roles.  These tests pin the window path against the
+per-minibatch path (the executable spec):
+
+* window=8 trajectory EQUALS window=1 in float64 — params, per-epoch
+  error integers, and the max_err_output_sum float the decision tracks;
+* an LR-schedule boundary INSIDE a window applies policy(k) to step k
+  (the adjuster ticks per collected minibatch, and the per-step hyper
+  pytree rides the scan);
+* segment tails (window stops at last_minibatch; padded tail minibatch
+  masked in-scan exactly like the evaluator would);
+* the device-resident dataset path (indices-only host->device traffic)
+  equals the host-stacked path;
+* CIFAR-caffe on the 8-device mesh: window=8 == window=1 (the r3 "done"
+  criterion).
+"""
+
+import numpy
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import prng
+from znicz_tpu.core.backends import JaxDevice
+
+
+@pytest.fixture()
+def float64_engine():
+    prev_type = root.common.engine.precision_type
+    root.common.engine.precision_type = "double"
+    root.common.engine.precision_dtype = numpy.float64
+    yield
+    root.common.engine.precision_type = prev_type
+    root.common.engine.__dict__.pop("precision_dtype", None)
+
+
+def _seed():
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+
+
+def _params(wf):
+    return {i: p for i, p in enumerate(wf.fused_trainer.host_params())
+            if p}
+
+
+def _assert_same_trajectory(wf_a, wf_b, tol=1e-12):
+    assert list(wf_a.decision.epoch_n_err) == list(wf_b.decision.epoch_n_err)
+    for ca, cb in zip(wf_a.decision.confusion_matrixes,
+                      wf_b.decision.confusion_matrixes):
+        if ca is None or cb is None:
+            assert ca is None and cb is None
+            continue
+        numpy.testing.assert_array_equal(ca, cb)
+    for a, b in zip(wf_a.decision.max_err_y_sums,
+                    wf_b.decision.max_err_y_sums):
+        assert abs(a - b) < 1e-12, (wf_a.decision.max_err_y_sums,
+                                    wf_b.decision.max_err_y_sums)
+    pa, pb = _params(wf_a), _params(wf_b)
+    assert set(pa) == set(pb)
+    for i in pa:
+        for k in pa[i]:
+            diff = numpy.abs(pa[i][k] - pb[i][k]).max()
+            assert diff < tol, "layer %d %s diff %g" % (i, k, diff)
+
+
+def _mnist(tmp_path, fused_cfg, max_epochs=2, train=130, valid=60, mb=40):
+    """Train sizes chosen so a segment is NOT a multiple of the window
+    (130/40 -> 4 minibatches incl. a 10-sample padded tail): windows hit
+    both the segment-boundary stop and the tail mask."""
+    from znicz_tpu.samples import mnist
+    _seed()
+    wf = mnist.build(
+        layers=root.mnistr_conv.layers,
+        loader_config={"synthetic_train": train, "synthetic_valid": valid,
+                       "minibatch_size": mb},
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 50},
+        snapshotter_config={"prefix": "fw", "interval": 100,
+                            "time_interval": 1e9, "compression": "",
+                            "directory": str(tmp_path)},
+        fused=dict(fused_cfg))
+    wf.initialize(device=JaxDevice())
+    wf.run()
+    return wf
+
+
+def test_window8_equals_window1(tmp_path, float64_engine):
+    wf_w = _mnist(tmp_path, {"pool_impl": "gather", "window": 8})
+    wf_1 = _mnist(tmp_path, {"pool_impl": "gather", "window": 1})
+    assert wf_w.fused_trainer.window == 8
+    assert wf_w.fused_trainer._use_device_data
+    _assert_same_trajectory(wf_w, wf_1)
+
+
+def test_window_host_path_equals_device_path(tmp_path, float64_engine):
+    wf_d = _mnist(tmp_path, {"pool_impl": "gather", "window": 4})
+    wf_h = _mnist(tmp_path, {"pool_impl": "gather", "window": 4,
+                             "device_data": False})
+    assert wf_d.fused_trainer._use_device_data
+    assert not wf_h.fused_trainer._use_device_data
+    _assert_same_trajectory(wf_d, wf_h)
+
+
+def test_window_lr_schedule_boundary_mid_window(tmp_path, float64_engine):
+    """arbitrary_step boundary at train step 3 with window=8: the drop
+    lands INSIDE the first window.  Equality with the per-minibatch run
+    proves policy(k) reaches exactly step k."""
+    from znicz_tpu.samples import cifar
+
+    schedule = {"do": True, "lr_policy_name": "arbitrary_step",
+                "bias_lr_policy_name": "arbitrary_step",
+                "lr_parameters": {
+                    "lrs_with_lengths": [(1, 3), (0.1, 100000)]},
+                "bias_lr_parameters": {
+                    "lrs_with_lengths": [(1, 3), (0.1, 100000)]}}
+
+    def run(window):
+        _seed()
+        wf = cifar.build(
+            loader_config={"synthetic_train": 200, "synthetic_valid": 80,
+                           "minibatch_size": 40},
+            decision_config={"max_epochs": 2, "fail_iterations": 100},
+            snapshotter_config={"directory": str(tmp_path),
+                                "compression": ""},
+            lr_adjuster_config=dict(schedule),
+            fused={"pool_impl": "gather", "window": window})
+        wf.initialize(device=JaxDevice())
+        wf.run()
+        return wf
+
+    wf_w = run(8)
+    wf_1 = run(1)
+    # schedule ticked once per MINIBATCH, not per window
+    assert wf_w.lr_adjuster._minibatches_count == \
+        wf_1.lr_adjuster._minibatches_count
+    _assert_same_trajectory(wf_w, wf_1)
+
+
+def test_cifar_caffe_mesh_window8_equals_window1(tmp_path, float64_engine):
+    """The r3 'done' bar: fused CIFAR-caffe with window=8 on the
+    8-device (data x model) mesh, trajectory equal to window=1."""
+    from znicz_tpu.samples import cifar
+
+    def run(window):
+        _seed()
+        wf = cifar.build(
+            loader_config={"synthetic_train": 200, "synthetic_valid": 80,
+                           "minibatch_size": 40},
+            decision_config={"max_epochs": 2, "fail_iterations": 100},
+            snapshotter_config={"directory": str(tmp_path),
+                                "compression": ""},
+            fused={"mesh": 8, "model_parallel": 2,
+                   "pool_impl": "gather", "window": window})
+        wf.initialize(device=JaxDevice())
+        wf.run()
+        return wf
+
+    _assert_same_trajectory(run(8), run(1))
+
+
+def test_window_stats_replace_evaluator_compute(tmp_path, float64_engine):
+    """The evaluator consumes the trainer's in-scan window stats on TRAIN
+    windows (output holds only the last minibatch) and still computes
+    VALID stats itself from the compiled forward's output."""
+    wf = _mnist(tmp_path, {"pool_impl": "gather", "window": 8},
+                max_epochs=1)
+    ev = wf.evaluator
+    assert ev.stats_source is wf.fused_trainer
+    # after the run the trainer's last dispatch was a VALID minibatch ->
+    # window_stats cleared; the decision still recorded TRAIN epoch stats
+    assert wf.fused_trainer.window_stats is None
+    assert wf.decision.epoch_n_err[2] is not None  # TRAIN
+    assert wf.decision.epoch_n_err[1] is not None  # VALID

@@ -26,6 +26,8 @@ import os
 import numpy
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from znicz_tpu.core.config import root
 from znicz_tpu.core import prng
 from znicz_tpu.core.backends import JaxDevice

@@ -3,6 +3,7 @@ driver evolving a Wine MLP hyperparameter across generations
 (reference SURVEY.md §3.5, samples/MNIST/mnist_config.py:62)."""
 
 import numpy
+import pytest
 
 from znicz_tpu.core.config import Config
 from znicz_tpu.core.genetics import (
@@ -90,6 +91,7 @@ def test_ga_improves_wine_fitness():
     assert cfg.learning_rate == best_values[0]
 
 
+@pytest.mark.slow
 def test_population_ga_parallel_evaluation_speedup():
     """VERDICT r2 missing #5: the GA population evaluates CONCURRENTLY
     (one vmapped XLA computation per generation on the fused path) with
@@ -163,7 +165,115 @@ def test_population_ga_parallel_evaluation_speedup():
 
 
 def test_population_evaluator_rejects_unknown_sites():
+    """Sites that are not fused hyper slots fall back to the serial GA
+    path (e.g. a loader knob)."""
     from znicz_tpu.samples import wine
     assert wine.population_evaluator(
-        [(None, "weights_decay", None), (None, "learning_rate", None)]) \
+        [(None, "minibatch_size", None), (None, "learning_rate", None)]) \
         is None
+
+
+@pytest.mark.slow
+def test_population_ga_tunes_two_sites_concurrently():
+    """VERDICT r3 next #6: the generic mapping tunes >= 2 DISTINCT Range
+    sites (learning rate AND weights decay) in one vmapped generation,
+    with wall-clock below serial evaluation at equal-or-better fitness."""
+    import time
+    from znicz_tpu.samples import wine
+    from znicz_tpu.samples.wine import WineWorkflow
+    from znicz_tpu.core.config import root
+
+    epochs = 6
+    prev_lr = root.wine.learning_rate
+    prev_wd = root.wine.weights_decay
+
+    def make_cfg():
+        cfg = Config("ga2")
+        cfg.update({"learning_rate": Range(0.002, 0.001, 0.8),
+                    "weights_decay": Range(0.0, 0.0, 0.01)})
+        return cfg
+
+    def serial_evaluate(c):
+        prng.get(1).seed(12)
+        prng.get(2).seed(13)
+        root.wine.learning_rate = float(c.learning_rate)
+        root.wine.weights_decay = float(c.weights_decay)
+        wf = WineWorkflow()
+        wf.decision.max_epochs = epochs
+        wf.initialize(device=NumpyDevice())
+        wf.run()
+        return -wf.decision.epoch_n_err_pt[2]
+
+    try:
+        pop_eval = wine.population_evaluator(
+            [(None, "learning_rate", None), (None, "weights_decay", None)],
+            epochs=epochs)
+        assert pop_eval is not None
+        batch = GeneticsOptimizer(
+            lambda c: (_ for _ in ()).throw(AssertionError(
+                "serial evaluate must not be called")),
+            make_cfg(), population_size=6, generations=3,
+            rand=numpy.random.RandomState(5),
+            evaluate_population=pop_eval)
+        best_values, batch_best = batch.run()
+        assert len(best_values) == 2
+
+        serial = GeneticsOptimizer(
+            serial_evaluate, make_cfg(), population_size=6, generations=3,
+            rand=numpy.random.RandomState(5))
+        _, serial_best = serial.run()
+
+        gen = [[0.002 + 0.01 * i, 0.001 * i] for i in range(6)]
+        pop_eval([[0.5 + 0.01 * i, 0.001] for i in range(6)])  # warm
+        t0 = time.time()
+        pop_eval(gen)
+        batch_time = time.time() - t0
+        t0 = time.time()
+        for v in gen:
+            cfg = make_cfg()
+            cfg.learning_rate, cfg.weights_decay = v
+            serial_evaluate(cfg)
+        serial_time = time.time() - t0
+    finally:
+        root.wine.learning_rate = prev_lr
+        root.wine.weights_decay = prev_wd
+
+    assert batch_best >= serial_best - 2, (batch_best, serial_best)
+    assert batch_time < serial_time, (batch_time, serial_time)
+
+
+def test_config_values_to_hypers_per_layer_and_global():
+    """Per-layer sites hit only their layer; global sites hit every
+    parameterized layer; explicit *_bias keys decouple the bias slot."""
+    from znicz_tpu.parallel import fused
+    from znicz_tpu.parallel.population import config_values_to_hypers
+
+    layers = [
+        {"type": "all2all_tanh",
+         "->": {"output_sample_shape": 6},
+         "<-": {"learning_rate": 0.1, "learning_rate_bias": 0.2}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": 0.3}},
+    ]
+    specs = tuple(fused.build_specs(layers, 4, None))
+    sites = [
+        (layers[0]["<-"], "learning_rate", None),   # layer 0 only
+        (None, "weights_decay", None),              # global
+    ]
+    mapper = config_values_to_hypers(sites, layers, specs)
+    assert mapper is not None
+    hypers = mapper([0.7, 0.005], specs)
+    assert hypers[0]["w"]["lr"] == 0.7
+    # explicit learning_rate_bias on layer 0 -> bias lr NOT coupled
+    assert hypers[0]["b"]["lr"] == 0.2
+    # layer 1 untouched by the per-layer site
+    assert hypers[1]["w"]["lr"] == 0.3
+    # global wd hits every layer's WEIGHTS slot; bias wd stays at its
+    # parser default of 0 (fused._parse_hyper: weights_decay_bias
+    # defaults to 0.0, not the weights value)
+    assert hypers[0]["w"]["wd"] == 0.005
+    assert hypers[1]["w"]["wd"] == 0.005
+    assert hypers[1]["b"]["wd"] == 0.0
+    # unmappable site -> None
+    assert config_values_to_hypers(
+        [(None, "minibatch_size", None)], layers, specs) is None

@@ -66,7 +66,11 @@ def test_parity_run_trains_on_provisioned_files(tmp_path, monkeypatch):
     saved = root.mnistr.decision.max_epochs
     root.mnistr.decision.max_epochs = 1
     try:
-        rows = parity.run_parity("mnist", data_dir=d)
+        # fused f32 (bf16 is the real-TPU default; on the CPU test host
+        # it is emulated and pointlessly slow) + a short unit-path
+        # cross-check — the WIRING is what this test pins
+        rows = parity.run_parity("mnist", data_dir=d, fused={},
+                                 cross_check=4)
     finally:
         root.mnistr.decision.max_epochs = saved
     (label, ref_err, ours), = rows
@@ -79,8 +83,9 @@ def test_cli_parity_flag_is_wired():
     from znicz_tpu import __main__ as cli
     called = {}
 
-    def fake(sample, device=None):
+    def fake(sample, device=None, fused="auto", **kwargs):
         called["sample"] = sample
+        called["fused"] = fused
         return []
 
     orig = parity.run_parity

@@ -1,10 +1,22 @@
 #!/bin/sh
-# CI entry: lint + build the C++ runtime + full test suite.
+# CI entry: lint + build the C++ runtime + tests.
+#
+# Lanes (VERDICT r3 next #9):
+#   tools/ci.sh        fast lane — lint, C++ build+tests, and the suite
+#                      minus the @slow tier (float64 dual-trajectory /
+#                      mesh / multi-epoch tests); catches import,
+#                      registry, and contract breakage in a few minutes.
+#   tools/ci.sh full   everything, including the slow tier.
 set -e
 cd "$(dirname "$0")/.."
 echo "== lint"
 python tools/lint.py
 echo "== cpp"
 make -C cpp -s
-echo "== tests"
-python -m pytest tests/ -q
+if [ "$1" = "full" ]; then
+    echo "== tests (full lane)"
+    python -m pytest tests/ -q
+else
+    echo "== tests (fast lane; run 'tools/ci.sh full' for the slow tier)"
+    python -m pytest tests/ -q -m "not slow"
+fi

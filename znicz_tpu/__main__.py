@@ -105,6 +105,11 @@ def main(argv=None):
                         help="config-root override, e.g. "
                              "wine.decision.max_epochs=5")
     parser.add_argument("--snapshot", help="snapshot file to resume from")
+    parser.add_argument("--auto-resume", action="store_true",
+                        help="elastic recovery: restore the newest "
+                             "matching snapshot (if any) and continue "
+                             "training — safe to use as the default "
+                             "launch mode of a supervised job")
     parser.add_argument("--testing", action="store_true",
                         help="forward-only run (reference --test)")
     parser.add_argument("--dry-run", action="store_true",
@@ -152,28 +157,11 @@ def main(argv=None):
     module = resolve_workflow_module(args.workflow)
     for assignment in args.config:
         apply_override(root, assignment)
-    if args.fused is not None and (args.parity or args.optimize):
-        # not silently ignored: the GA/parity drivers run their own
-        # training paths (the GA's fused population evaluator is a
-        # sample-level opt-in, not this flag)
-        parser.error("--fused applies to plain training runs; it cannot "
-                     "combine with --parity/--optimize")
-    if args.parity:
-        if args.optimize or args.snapshot or args.testing or \
-                args.dry_run or args.dump_graph:
-            parser.error("--parity runs the published training config "
-                         "standalone")
-        from znicz_tpu import parity
-        # the module is already resolved — accept any spelling the CLI
-        # accepts ('mnist', 'znicz_tpu.samples.mnist', 'samples/mnist.py')
-        parity.run_parity(module.__name__.rsplit(".", 1)[-1])
-        return 0
-    if args.optimize:
-        if args.snapshot or args.testing or args.dry_run or \
-                args.dump_graph:
-            parser.error("--optimize cannot be combined with --snapshot/"
-                         "--testing/--dry-run/--dump-graph")
-        return run_genetics(module, args.optimize)
+    if args.fused is not None and args.optimize:
+        # not silently ignored: the GA driver runs its own training path
+        # (its fused population evaluator is a sample-level opt-in)
+        parser.error("--fused applies to plain training and --parity "
+                     "runs; it cannot combine with --optimize")
     fused = args.fused
     if isinstance(fused, str):
         cfg = {}
@@ -186,9 +174,29 @@ def main(argv=None):
             except (ValueError, SyntaxError):
                 cfg[key.strip()] = raw
         fused = cfg
+    if args.parity:
+        if args.optimize or args.snapshot or args.testing or \
+                args.dry_run or args.dump_graph:
+            parser.error("--parity runs the published training config "
+                         "standalone")
+        from znicz_tpu import parity
+        # the module is already resolved — accept any spelling the CLI
+        # accepts ('mnist', 'znicz_tpu.samples.mnist', 'samples/mnist.py').
+        # Parity trains on the fused path by default; --fused K=V
+        # overrides its config (e.g. --fused window=1).
+        parity.run_parity(module.__name__.rsplit(".", 1)[-1],
+                          fused=fused if fused is not None else "auto")
+        return 0
+    if args.optimize:
+        if args.snapshot or args.testing or args.dry_run or \
+                args.dump_graph:
+            parser.error("--optimize cannot be combined with --snapshot/"
+                         "--testing/--dry-run/--dump-graph")
+        return run_genetics(module, args.optimize)
     dry_run = args.dry_run or (bool(args.dump_graph) and not args.testing)
     wf = run_workflow(module, snapshot=args.snapshot,
-                      testing=args.testing, dry_run=dry_run, fused=fused)
+                      testing=args.testing, dry_run=dry_run, fused=fused,
+                      auto_resume=args.auto_resume)
     if args.dump_graph:
         wf.dump_graph(args.dump_graph)
     decision = getattr(wf, "decision", None)

@@ -126,8 +126,13 @@ class SnapshotterToFile(SnapshotterBase):
             self.prefix, self.suffix or "current", os.getpid(), ext)
         self.destination = os.path.join(self.directory, name)
         opener = _WRITERS[self.compression or ""]
-        with opener(self.destination, "wb") as f:
+        # atomic publish: a crash/SIGKILL mid-write must never leave a
+        # truncated file where auto-resume (launcher --auto-resume) will
+        # look for the newest snapshot
+        tmp = self.destination + ".part"
+        with opener(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=4)
+        os.replace(tmp, self.destination)
         self.info("snapshot -> %s", self.destination)
 
     @staticmethod

@@ -39,7 +39,7 @@ class Launcher(Logger):
     """
 
     def __init__(self, testing=False, snapshot=None, device=None,
-                 dry_run=False, fused=None):
+                 dry_run=False, fused=None, auto_resume=False):
         super(Launcher, self).__init__(logger_name="Launcher")
         self.testing = testing
         self.snapshot_path = snapshot
@@ -48,6 +48,13 @@ class Launcher(Logger):
         #: fused execution mode forwarded to StandardWorkflow-based
         #: samples (True or a config dict — see link_fused_trainer)
         self.fused = fused
+        #: job-level elastic recovery (reference slave-loss semantics
+        #: re-provided at the job level, SURVEY.md §2.8 / nn_rollback.py
+        #: 87-97): on start, restore the NEWEST matching snapshot the
+        #: workflow's snapshotter would have written, fast-forward (the
+        #: snapshot carries loader position + PRNG streams + optimizer
+        #: state) and continue training
+        self.auto_resume = auto_resume
         self.workflow = None
         self.interactive = False
         self._state = None
@@ -97,12 +104,129 @@ class Launcher(Logger):
                          "the unit-graph path", type(wf).__name__)
         return wf, self._state is not None
 
+    def _snapshot_incompatible(self, state, wf):
+        """Reason the snapshot cannot be applied to ``wf`` (None = OK):
+        a different workflow class, or any exported Array whose shape
+        differs from the live one (e.g. the same snapshot prefix used by
+        two topologies) — applying blindly would corrupt state or crash
+        deep inside the first train step."""
+        import numpy
+        from znicz_tpu.core.memory import Array
+        snap_wf = state.get("workflow")
+        if snap_wf not in (None, type(wf).__name__):
+            return "workflow class %r != %r" % (snap_wf,
+                                                type(wf).__name__)
+        units = {u.name: u for u in wf.units}
+        for uname, ustate in state.get("units", {}).items():
+            u = units.get(uname)
+            if u is None:
+                continue
+            for attr, value in ustate.items():
+                if value is None:
+                    continue
+                cur = getattr(u, attr, None)
+                if isinstance(cur, Array) and cur and \
+                        tuple(cur.shape) != tuple(numpy.shape(value)):
+                    return "unit %s.%s shape %s != %s" % (
+                        uname, attr, numpy.shape(value), tuple(cur.shape))
+                if attr == "fused_state" and isinstance(value, dict) and \
+                        getattr(u, "net", None) is not None:
+                    cur_sd = u.fused_state
+                    for p_cur, p_new in zip(cur_sd["params"],
+                                            value.get("params", ())):
+                        for k in p_cur:
+                            if k in p_new and numpy.shape(p_cur[k]) != \
+                                    numpy.shape(p_new[k]):
+                                return ("fused param shape %s != %s"
+                                        % (numpy.shape(p_new[k]),
+                                           numpy.shape(p_cur[k])))
+        # shape agreement is not enough: a DIFFERENT topology under the
+        # same snapshot prefix has disjoint unit names, every check
+        # above passes vacuously, and "resume" would restore epoch
+        # bookkeeping with freshly random weights.  Require the snapshot
+        # to actually cover the workflow's trainable state (directly or
+        # via the cross-mode fused<->unit-graph mapping).
+        forwards = [f for f in getattr(wf, "forwards", ())]
+        has_fused_state = any(
+            isinstance(us.get("fused_state"), dict)
+            for us in state.get("units", {}).values())
+        has_unit_weights = any(
+            us.get("weights") is not None
+            for us in state.get("units", {}).values())
+        trainable = [f for f in forwards
+                     if getattr(f, "weights", None) is not None
+                     and f.weights] or \
+                    ([wf.fused_trainer]
+                     if getattr(wf, "fused_trainer", None) is not None
+                     else [])
+        if trainable and not (has_fused_state or has_unit_weights):
+            return "snapshot carries no trainable weights"
+        if trainable and has_unit_weights and not has_fused_state:
+            trainer = getattr(wf, "fused_trainer", None)
+            if trainer is None:
+                covered = sum(
+                    1 for f in forwards
+                    if state.get("units", {}).get(f.name, {})
+                    .get("weights") is not None)
+            else:
+                # fused target: the cross-mode map looks the layers up
+                # by their unit-graph forward names
+                covered = 0
+                for i, layer in enumerate(trainer.layers):
+                    name = (layer["name"] + "_forward") \
+                        if "name" in layer \
+                        else "%s_%d_forward" % (layer.get("type"), i)
+                    if state.get("units", {}).get(name, {}) \
+                            .get("weights") is not None:
+                        covered += 1
+            if not covered:
+                return ("snapshot's unit names cover none of this "
+                        "workflow's layers (different topology under "
+                        "the same prefix?)")
+        return None
+
+    def _find_resume_state(self, wf):
+        """Newest importable AND compatible snapshot matching the
+        workflow's snapshotter prefix/directory; corrupt files (a crash
+        can interrupt even an atomic-rename write of the PREVIOUS run's
+        file on some systems) and incompatible topologies are skipped
+        newest-first."""
+        from znicz_tpu.core.snapshotter import SnapshotterToFile
+        snap = getattr(wf, "snapshotter", None)
+        if snap is None:
+            self.warning("--auto-resume: workflow has no snapshotter")
+            return None
+        directory = snap.directory
+        if not os.path.isdir(directory):
+            return None
+        cands = [os.path.join(directory, f) for f in os.listdir(directory)
+                 if f.startswith(snap.prefix + "_")
+                 and ".pickle" in f and not f.endswith(".part")]
+        cands.sort(key=os.path.getmtime, reverse=True)
+        for path in cands:
+            try:
+                state = SnapshotterToFile.import_(path)
+            except Exception as e:  # noqa: BLE001 - corrupt snapshot
+                self.warning("auto-resume: skipping unreadable snapshot "
+                             "%s (%s)", path, e)
+                continue
+            reason = self._snapshot_incompatible(state, wf)
+            if reason:
+                self.warning("auto-resume: skipping incompatible "
+                             "snapshot %s (%s)", path, reason)
+                continue
+            self.info("auto-resume: restoring %s", path)
+            return state
+        return None
+
     def main(self, **kwargs):
         """Initialize (+restore), then run unless dry_run."""
         wf = self.workflow
         if wf is None:
             raise RuntimeError("main() before load()")
         wf.initialize(device=self.device, **kwargs)
+        if self._state is None and self.auto_resume:
+            self._state = self._find_resume_state(wf)
         if self._state is not None:
             from znicz_tpu.units.nn_units import load_snapshot_into_workflow
             load_snapshot_into_workflow(self._state, wf)
@@ -161,7 +285,7 @@ def list_samples():
 
 
 def run_workflow(spec, snapshot=None, testing=False, dry_run=False,
-                 device=None, fused=None):
+                 device=None, fused=None, auto_resume=False):
     """Drive a workflow module's ``run(load, main)``.
 
     ``spec`` is a module object or anything
@@ -172,16 +296,18 @@ def run_workflow(spec, snapshot=None, testing=False, dry_run=False,
     module = spec if hasattr(spec, "__file__") else \
         resolve_workflow_module(spec)
     launcher = Launcher(testing=testing, snapshot=snapshot,
-                        device=device, dry_run=dry_run, fused=fused)
+                        device=device, dry_run=dry_run, fused=fused,
+                        auto_resume=auto_resume)
     if hasattr(module, "run"):
         module.run(launcher.load, launcher.main)
         return launcher.workflow
     if hasattr(module, "run_sample"):
-        if snapshot or testing or dry_run or fused is not None:
+        if snapshot or testing or dry_run or fused is not None \
+                or auto_resume:
             raise SystemExit(
                 "%s exposes only run_sample(); --snapshot/--testing/"
-                "--dry-run/--fused need the run(load, main) contract"
-                % spec)
+                "--dry-run/--fused/--auto-resume need the "
+                "run(load, main) contract" % spec)
         return module.run_sample(device=device)
     raise SystemExit(
         "%s exposes neither run(load, main) nor run_sample()" % spec)

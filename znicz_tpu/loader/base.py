@@ -98,6 +98,11 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.epoch_number = 0
         self.complete = Bool(False)
         self.train_ended = Bool(False)
+        #: windowed fused mode: the trainer consumes TRAIN minibatches as
+        #: device gathers over the on-device dataset, so the host fill is
+        #: skipped for them (minibatch_indices/labels flags still serve;
+        #: VALID/TEST minibatches always fill)
+        self.skip_fill = False
         self._indices = {}       # class -> index array into the dataset
         self._segment = 0        # position in the serving order
         self._offset_in_class = 0
@@ -231,14 +236,15 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         idx = self.minibatch_indices.mem
         idx[:n] = sel
         idx[n:] = -1
-        self.fill_minibatch()
-        if n < self.max_minibatch_size:
-            self.minibatch_labels.map_write()
-            self.minibatch_labels.mem[n:] = -1
-            targets = getattr(self, "minibatch_targets", None)
-            if targets:
-                targets.map_write()
-                targets.mem[n:] = 0
+        if not (self.skip_fill and clazz == TRAIN):
+            self.fill_minibatch()
+            if n < self.max_minibatch_size:
+                self.minibatch_labels.map_write()
+                self.minibatch_labels.mem[n:] = -1
+                targets = getattr(self, "minibatch_targets", None)
+                if targets:
+                    targets.map_write()
+                    targets.mem[n:] = 0
 
         seg_done = off + n >= length
         epoch_done = seg_done and self._segment == len(order) - 1
@@ -274,6 +280,7 @@ class FullBatchLoader(Loader):
         super(FullBatchLoader, self).__init__(workflow, **kwargs)
         self.original_data = Array(name="original_data")
         self._original_labels = []
+        self._labels_array = None  # cached numpy view of the label list
         self.force_numpy = kwargs.get("force_numpy", False)
 
     @property
@@ -327,11 +334,16 @@ class FullBatchLoader(Loader):
         self.minibatch_data.map_invalidate()
         self.minibatch_labels.map_write()
         data = self.original_data.mem
-        for i in range(n):
-            self.minibatch_data.mem[i] = data[idx[i]]
+        sel = idx[:n]
+        # one fancy-index copy, not a per-sample python loop (the hot
+        # host-side path of every epoch)
+        self.minibatch_data.mem[:n] = data[sel]
         if self._original_labels:
-            for i in range(n):
-                self.minibatch_labels.mem[i] = self._original_labels[idx[i]]
+            labels = self._labels_array
+            if labels is None or len(labels) != len(self._original_labels):
+                labels = self._labels_array = numpy.asarray(
+                    self._original_labels)
+            self.minibatch_labels.mem[:n] = labels[sel]
 
 
 class LoaderMSEMixin(object):

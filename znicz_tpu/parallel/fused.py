@@ -45,7 +45,19 @@ from znicz_tpu.ops import normalization as norm_ops
 FC_TYPES = ("all2all", "all2all_tanh", "all2all_relu", "all2all_str",
             "all2all_sigmoid", "softmax")
 CONV_TYPES = ("conv", "conv_tanh", "conv_sigmoid", "conv_relu", "conv_str")
-POOL_TYPES = ("max_pooling", "maxabs_pooling", "avg_pooling")
+#: stochastic variants sample winners from a jax PRNG key on the fused
+#: path — same distribution as the unit path's host uint16 stream
+#: (reference pooling.py:368-508), exact host-stream parity explicitly
+#: waived like dropout's (docs/distributed.md)
+POOL_TYPES = ("max_pooling", "maxabs_pooling", "avg_pooling",
+              "stochastic_pooling", "stochastic_abs_pooling",
+              "stochastic_pool_depool", "stochastic_abs_pool_depool")
+_POOL_MODES = {"max_pooling": "max", "maxabs_pooling": "maxabs",
+               "avg_pooling": "avg",
+               "stochastic_pooling": "stochastic",
+               "stochastic_abs_pooling": "stochasticabs",
+               "stochastic_pool_depool": "stochastic_depool",
+               "stochastic_abs_pool_depool": "stochasticabs_depool"}
 ACTIVATION_TYPES = ("activation_tanh", "activation_sigmoid",
                     "activation_relu", "activation_str", "activation_log",
                     "activation_tanhlog", "activation_sincos")
@@ -399,14 +411,19 @@ def build_specs(layers, input_sample_shape, defaults=None):
                     % (tpe, shape))
             kx, ky = int(fwd["kx"]), int(fwd["ky"])
             sliding = tuple(fwd.get("sliding") or (kx, ky))
-            ny, nx = pool_ops.output_spatial(
-                shape[0], shape[1], ky, kx, sliding)
-            mode = {"max_pooling": "max", "maxabs_pooling": "maxabs",
-                    "avg_pooling": "avg"}[tpe]
+            mode = _POOL_MODES[tpe]
+            if mode.endswith("_depool"):
+                # pool+depool runs in place: output keeps the input
+                # shape (reference stochastic_pooling_depooling kernel)
+                out_shape = shape
+            else:
+                ny, nx = pool_ops.output_spatial(
+                    shape[0], shape[1], ky, kx, sliding)
+                out_shape = (ny, nx, shape[2])
             specs.append(PoolSpec(
-                type=tpe, in_shape=shape, out_shape=(ny, nx, shape[2]),
+                type=tpe, in_shape=shape, out_shape=out_shape,
                 mode=mode, kx=kx, ky=ky, sliding=sliding))
-            shape = (ny, nx, shape[2])
+            shape = out_shape
         elif tpe == "norm":
             if len(shape) != 3:
                 raise ValueError(
@@ -476,7 +493,8 @@ def build_specs(layers, input_sample_shape, defaults=None):
                     "fused depooling needs tied_to=<pooling layer name>")
             tied = names[tied_name]
             pool_spec = specs[tied]
-            if pool_spec.kind != "pool" or pool_spec.mode == "avg":
+            if pool_spec.kind != "pool" or pool_spec.mode not in (
+                    "max", "maxabs", "stochastic", "stochasticabs"):
                 raise ValueError(
                     "tied_to %r is not an offset-recording pooling"
                     % tied_name)
@@ -484,7 +502,8 @@ def build_specs(layers, input_sample_shape, defaults=None):
                 raise ValueError(
                     "depooling input %r != tied pool output %r"
                     % (shape, pool_spec.out_shape))
-            # the tied pool must run the gather path to yield offsets
+            # the tied max pool must run the gather path to yield
+            # offsets (stochastic pools always record winners)
             pool_spec.impl = "gather"
             pool_spec.record_offsets = True
             specs.append(DepoolSpec(
@@ -645,7 +664,39 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
                 include_bias="b" in p)
         elif spec.kind == "pool":
             y = y.reshape((y.shape[0],) + spec.in_shape)
-            if getattr(spec, "record_offsets", False):
+            if spec.mode.startswith("stochastic"):
+                # winners sampled from the jax PRNG key (distribution
+                # parity with the unit path's host uint16 stream,
+                # reference pooling.py:434-480; exact stream parity
+                # waived like dropout's) — the SAME op as the unit jax
+                # path, fed device-drawn u16s
+                if key is None:
+                    raise ValueError(
+                        "stochastic pooling needs a PRNG key (fused nets "
+                        "with stochastic specs thread one through "
+                        "predict too)")
+                key, sub = jax.random.split(key)
+                b = y.shape[0]
+                if spec.mode.endswith("_depool"):
+                    ny, nx = pool_ops.output_spatial(
+                        spec.in_shape[0], spec.in_shape[1], spec.ky,
+                        spec.kx, (spec.kx, spec.ky))
+                else:
+                    ny, nx, _ = spec.out_shape
+                n = b * ny * nx * spec.in_shape[2]
+                u16 = jax.random.randint(
+                    sub, (n,), 0, 65536, dtype=jnp.int32).astype(
+                        jnp.uint16)
+                use_abs = "abs" in spec.mode
+                if spec.mode.endswith("_depool"):
+                    y, offs = pool_ops.stochastic_pool_depool_jax(
+                        y, u16, spec.ky, spec.kx, use_abs=use_abs)
+                else:
+                    y, offs = pool_ops.stochastic_pooling_jax(
+                        y, u16, spec.ky, spec.kx, spec.sliding,
+                        use_abs=use_abs)
+                offsets[i] = offs
+            elif getattr(spec, "record_offsets", False):
                 y, offs = pool_ops.max_pooling_gather_jax(
                     y, spec.ky, spec.kx, spec.sliding,
                     use_abs=spec.mode == "maxabs")
@@ -748,6 +799,36 @@ def _loss_and_stats_mse(params, x, target, batch_size, specs, key=None,
     return loss, y
 
 
+def _eval_stats(probs, max_idx, labels, batch_size, n_classes, mean):
+    """Evaluator-identical per-minibatch stats computed INSIDE the
+    compiled window (ops/evaluator.softmax_ce_jax semantics, reference
+    evaluator.py:271-312): n_err_delta[2], confusion_delta[C,C],
+    max_err_output_sum.  Same masking (in-batch AND label >= 0) and the
+    same ``err = (probs - onehot) * mult`` row math, so the windowed
+    control plane accumulates the exact integers/floats the per-minibatch
+    evaluator would."""
+    B = probs.shape[0]
+    idx = jnp.arange(B)
+    in_batch = idx < batch_size
+    valid = in_batch & (labels >= 0)
+    hits = valid & (max_idx == labels)
+    n_total = valid.sum()
+    n_ok = hits.sum()
+    n_err2 = jnp.stack([n_total - n_ok, n_total]).astype(jnp.int32)
+    onehot = jax.nn.one_hot(jnp.maximum(labels, 0), n_classes,
+                            dtype=probs.dtype)
+    # confusion[pred, label] += valid — as a one-hot GEMM, not a
+    # scatter-add (TPU scatters with duplicate indices serialize; the
+    # f32 accumulation is exact for counts < 2^24)
+    pred_onehot = jax.nn.one_hot(max_idx, n_classes, dtype=jnp.float32)
+    conf = ((pred_onehot * valid[:, None].astype(jnp.float32)).T
+            @ onehot.astype(jnp.float32)).astype(jnp.int32)
+    mult = jnp.where(mean, 1.0 / jnp.maximum(batch_size, 1), 1.0)
+    err = (probs - onehot) * mult.astype(probs.dtype)
+    mx = jnp.where(valid, jnp.abs(err).sum(axis=1), 0).max()
+    return n_err2, conf, mx
+
+
 def _train_step_mse(params, state, x, target, batch_size, specs, key=None,
                     compute_dtype=None, hypers=None):
     params = _apply_weight_masks(params, specs)
@@ -807,6 +888,17 @@ class FusedNet:
         self.compute_dtype = compute_dtype
         self.input_sample_shape = _normalize_sample_shape(input_sample_shape)
         self.objective = objective
+        #: master-parameter dtype (the forward's output dtype when no
+        #: compute_dtype is forced)
+        self.dtype = dtype
+        #: evaluator ``mean`` flag mirrored into the in-scan stats
+        #: (window mode) — the trainer unit copies it from the linked
+        #: evaluator before initialize
+        self.stats_mean = True
+        #: compiled window functions keyed by (n_steps, indexed)
+        self._window_fns = {}
+        self._data_d = None
+        self._labels_d = None
         if objective == "softmax":
             if not self.specs[-1].is_softmax:
                 raise ValueError(
@@ -838,6 +930,12 @@ class FusedNet:
             self._key = jax.device_put(
                 self._key, NamedSharding(mesh, P()))
         self._has_dropout = any(s.kind == "dropout" for s in self.specs)
+        self._has_stochastic = any(
+            s.kind == "pool" and s.mode.startswith("stochastic")
+            for s in self.specs)
+        #: specs that consume PRNG draws per step (dropout masks,
+        #: stochastic-pool winners) advance the key chain
+        self._needs_key = self._has_dropout or self._has_stochastic
         #: live hyperparameters — mutated by LR schedules / rollback and
         #: passed to the jitted step as traced scalars (no recompile)
         self.hypers = default_hypers(self.specs)
@@ -878,11 +976,16 @@ class FusedNet:
         else:
             self._pshard = self._sshard = None
             self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+        # stochastic-pool nets sample winners at inference too (reference
+        # StochasticPooling draws on every run, pooling.py:368-460) — the
+        # compiled forward takes a key; others keep the keyless signature
         self._fwd = jax.jit(
-            lambda p, x: forward(p, x, specs, compute_dtype=compute_dtype))
+            lambda p, x, k=None: forward(p, x, specs, key=k,
+                                         compute_dtype=compute_dtype))
 
-        def fwd_idx(p, x):
-            probs = forward(p, x, specs, compute_dtype=compute_dtype)
+        def fwd_idx(p, x, k=None):
+            probs = forward(p, x, specs, key=k,
+                            compute_dtype=compute_dtype)
             return probs, jnp.argmax(probs, axis=1).astype(jnp.int32)
 
         self._fwd_idx = jax.jit(fwd_idx)
@@ -944,7 +1047,7 @@ class FusedNet:
             raise ValueError("use step_mse for objective %r"
                              % self.objective)
         x, labels = self._place_batch(x, labels)
-        if self._has_dropout:
+        if self._needs_key:
             self._key, key = jax.random.split(self._key)
         else:
             key = self._key
@@ -966,7 +1069,7 @@ class FusedNet:
             numpy.asarray(target),
             None if self.mesh is None else NamedSharding(
                 self.mesh, P("data", *([None] * (target.ndim - 1)))))
-        if self._has_dropout:
+        if self._needs_key:
             self._key, key = jax.random.split(self._key)
         else:
             key = self._key
@@ -995,7 +1098,7 @@ class FusedNet:
             def body(carry, batch):
                 p, s, k, hy = carry
                 x, l = batch
-                if self._has_dropout:
+                if self._needs_key:
                     k, sub = jax.random.split(k)
                 else:
                     sub = k
@@ -1036,15 +1139,196 @@ class FusedNet:
             self.params, self.state, self._key, xs, labels_s, self.hypers)
         return metrics
 
+    # -- windowed training (the control plane's hot loop) -------------------
+    def set_dataset(self, data, labels):
+        """Place the WHOLE training dataset on device once (replicated
+        over the mesh).  Windowed train steps then gather their
+        minibatches on device from ``(window, batch)`` index arrays — the
+        TPU-native data path: per window only the indices cross the
+        host/device boundary (SURVEY.md §7; the reference's equivalent is
+        the loader's host-side fancy-index fill, loader/base observed
+        contract).
+
+        Under a bf16 ``compute_dtype`` the dataset is STORED in bf16:
+        the forward casts x to bf16 anyway, gather commutes with the
+        cast (bit-identical), and the row gather is the one HBM-
+        bandwidth-bound op of the window (XLA's TPU gather runs far
+        below stream bandwidth, so bytes matter — see BENCH_NOTES.md)."""
+        data = numpy.ascontiguousarray(data)
+        if self.compute_dtype is not None:
+            data = jnp.asarray(data).astype(self.compute_dtype)
+        rep = None if self.mesh is None else NamedSharding(self.mesh, P())
+        self._data_d = jax.device_put(data, rep)
+        self._labels_d = jax.device_put(
+            numpy.asarray(labels, dtype=numpy.int32), rep)
+
+    @property
+    def has_dataset(self):
+        return self._data_d is not None
+
+    def _get_window_fn(self, n_steps, indexed):
+        """Build (and cache) the compiled K-step window: one ``lax.scan``
+        over ``_train_step`` with per-step traced hypers + in-scan
+        evaluator stats.  Aggregates (n_err, confusion, max_err_sum) ride
+        the carry so only the per-step losses stack; the LAST step's
+        output/max_idx come back for the downstream units
+        (evaluator/decision/plotters keep their reference roles)."""
+        key_ = (int(n_steps), bool(indexed))
+        fn = self._window_fns.get(key_)
+        if fn is not None:
+            return fn
+        specs = tuple(self.specs)
+        cd = self.compute_dtype
+        needs_key = self._needs_key
+        n_classes = int(self.specs[-1].n_out)
+        mean = bool(self.stats_mean)
+        out_dtype = jnp.float32 if cd is not None else self.dtype
+
+        def body(carry, step):
+            p, s, k, _, _, nerr, conf, mx = carry
+            if indexed:
+                data, lbl_all, idx, bs, hy = step
+                safe = jnp.maximum(idx, 0)
+                x = jnp.take(data, safe, axis=0)
+                lbl = jnp.where(idx < 0, jnp.int32(-1),
+                                jnp.take(lbl_all, safe, axis=0))
+            else:
+                x, lbl, bs, hy = step
+            if needs_key:
+                k, sub = jax.random.split(k)
+            else:
+                sub = k
+            p, s, m = _train_step(p, s, x, lbl, specs, sub, cd, hy,
+                                  with_output=True)
+            d_nerr, d_conf, d_mx = _eval_stats(
+                m["output"], m["max_idx"], lbl, bs, n_classes, mean)
+            carry = (p, s, k, m["output"], m["max_idx"],
+                     nerr + d_nerr, conf + d_conf, jnp.maximum(mx, d_mx))
+            return carry, m["loss"]
+
+        def window_fn(p, s, k, data, lbl_all, xs, ls, bs_s, hy_s):
+            batch = xs.shape[1]
+            out0 = jnp.zeros((batch, n_classes), dtype=out_dtype)
+            idx0 = jnp.zeros((batch,), dtype=jnp.int32)
+            nerr0 = jnp.zeros((2,), dtype=jnp.int32)
+            conf0 = jnp.zeros((n_classes, n_classes), dtype=jnp.int32)
+            mx0 = jnp.zeros((), dtype=out_dtype)
+            if indexed:
+                # the dataset enters once as a plain argument (closing
+                # over it would bake a huge constant into the program;
+                # scanning it would copy it per step)
+                def scan_body(carry, step):
+                    idx, bs, hy = step
+                    return body(carry, (data, lbl_all, idx, bs, hy))
+                xs_scan = (xs, bs_s, hy_s)
+            else:
+                xs_scan = (xs, ls, bs_s, hy_s)
+                scan_body = body
+            carry0 = (p, s, k, out0, idx0, nerr0, conf0, mx0)
+            (p, s, k, out, midx, nerr, conf, mx), losses = jax.lax.scan(
+                scan_body, carry0, xs_scan)
+            stats = {"loss": losses, "n_err": nerr, "confusion": conf,
+                     "max_err_sum": mx, "output": out, "max_idx": midx}
+            return p, s, k, stats
+
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            oshard = NamedSharding(self.mesh, P("data", None))
+            mshard = {"loss": rep, "n_err": rep, "confusion": rep,
+                      "max_err_sum": rep,
+                      "output": oshard,
+                      "max_idx": NamedSharding(self.mesh, P("data"))}
+            fn = jax.jit(window_fn, donate_argnums=(0, 1),
+                         out_shardings=(self._pshard, self._sshard, rep,
+                                        mshard))
+        else:
+            fn = jax.jit(window_fn, donate_argnums=(0, 1))
+        self._window_fns[key_] = fn
+        return fn
+
+    def _place_window(self, arr, tail_dims):
+        """Device-put a (K, batch, ...) stacked window input: scan dim
+        unsharded, batch dim over ``data``."""
+        if self.mesh is None:
+            return jax.device_put(arr)
+        return jax.device_put(arr, NamedSharding(
+            self.mesh, P(None, "data", *([None] * tail_dims))))
+
+    def _check_window_batch(self, batch):
+        if self.mesh is not None and batch % self.mesh.shape["data"]:
+            raise ValueError("batch %d not divisible by data-parallel %d"
+                             % (batch, self.mesh.shape["data"]))
+
+    def run_window(self, xs, labels_s, batch_sizes, hypers_s):
+        """K train steps in ONE compiled dispatch over host-stacked
+        minibatches ``xs (K, B, *sample)`` / ``labels_s (K, B)``.
+        ``batch_sizes (K,)`` masks padded tail minibatches exactly like
+        the per-minibatch evaluator; ``hypers_s`` is the hyper pytree
+        with a leading K axis (policy(k) applies to step k — LR-schedule
+        step accuracy inside the window).  Returns the aggregated window
+        stats (see _get_window_fn)."""
+        if self.objective != "softmax":
+            raise ValueError("run_window supports the softmax objective")
+        self._check_window_batch(xs.shape[1])
+        n_steps = xs.shape[0]
+        fn = self._get_window_fn(n_steps, indexed=False)
+        xs = self._place_window(
+            numpy.ascontiguousarray(xs), xs.ndim - 2)
+        labels_s = self._place_window(
+            numpy.asarray(labels_s, dtype=numpy.int32), 0)
+        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        self.params, self.state, self._key, stats = fn(
+            self.params, self.state, self._key, 0, 0, xs, labels_s, bs,
+            hypers_s)
+        return stats
+
+    def run_window_indexed(self, idx_s, batch_sizes, hypers_s):
+        """Windowed training over the device-resident dataset
+        (:meth:`set_dataset`): ``idx_s (K, B)`` dataset row indices
+        (-1 = padded tail slot).  Only the indices cross the host/device
+        boundary; the gather runs inside the compiled window."""
+        if not self.has_dataset:
+            raise RuntimeError("set_dataset() before run_window_indexed")
+        self._check_window_batch(idx_s.shape[1])
+        n_steps = idx_s.shape[0]
+        fn = self._get_window_fn(n_steps, indexed=True)
+        idx_s = self._place_window(
+            numpy.asarray(idx_s, dtype=numpy.int32), 0)
+        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        self.params, self.state, self._key, stats = fn(
+            self.params, self.state, self._key, self._data_d,
+            self._labels_d, idx_s, None, bs, hypers_s)
+        return stats
+
+    def params_finite(self):
+        """Device-side all-finite reduction over every parameter — the
+        rollback's NaN probe without a full host pull (reference
+        nn_rollback.py:105-111 counts NaNs on host; at AlexNet scale
+        that is a whole-model D2H per epoch)."""
+        if not hasattr(self, "_finite_fn"):
+            self._finite_fn = jax.jit(lambda ps: jnp.all(jnp.stack(
+                [jnp.isfinite(leaf).all()
+                 for leaf in jax.tree.leaves(ps)])))
+        return bool(self._finite_fn(self.params))
+
+    def _predict_key(self):
+        """Stochastic-pool nets consume PRNG draws at inference too
+        (advancing the same key chain the train steps use — resume
+        stays exact because the key is snapshot state)."""
+        if not self._has_stochastic:
+            return None
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
     def predict(self, x):
         x, _ = self._place_batch(x, numpy.zeros(x.shape[0], numpy.int32))
-        return self._fwd(self.params, x)
+        return self._fwd(self.params, x, self._predict_key())
 
     def predict_with_idx(self, x):
         """Compiled inference: (softmax output, argmax) — what the
         evaluator unit consumes on VALID/TEST minibatches."""
         x, _ = self._place_batch(x, numpy.zeros(x.shape[0], numpy.int32))
-        return self._fwd_idx(self.params, x)
+        return self._fwd_idx(self.params, x, self._predict_key())
 
     def host_params(self):
         return jax.tree.map(lambda a: numpy.asarray(a), self.params)

@@ -141,6 +141,15 @@ def run(load, main):
     main()
 
 
+def population_evaluator(sites, epochs=None, seed=12):
+    """``--optimize`` fused path: whole GA generations train as ONE
+    vmapped XLA computation over any hyper-key Range sites (generic
+    mapping, parallel/population.workflow_population_evaluator)."""
+    from znicz_tpu.parallel.population import workflow_population_evaluator
+    return workflow_population_evaluator(root.cifar, sites,
+                                         epochs=epochs, seed=seed)
+
+
 #: CIFAR-10 MLP (reference cifar_config.py: all2all 486 -> sincos x2 ->
 #: softmax; baseline 45.80% val err)
 root.cifar_mlp.update({

@@ -161,3 +161,12 @@ def run(load, main):
     """Launcher contract (reference samples/MNIST/mnist.py:128-137)."""
     load(build)
     main()
+
+
+def population_evaluator(sites, epochs=None, seed=12):
+    """``--optimize`` fused path: whole GA generations train as ONE
+    vmapped XLA computation over any hyper-key Range sites (generic
+    mapping, parallel/population.workflow_population_evaluator)."""
+    from znicz_tpu.parallel.population import workflow_population_evaluator
+    return workflow_population_evaluator(root.mnistr, sites,
+                                         epochs=epochs, seed=seed)

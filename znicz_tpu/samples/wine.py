@@ -140,30 +140,36 @@ def population_evaluator(sites, epochs=None, seed=12):
     whole GA generation concurrently (the TPU replacement for the
     reference's cluster-sprayed evaluations, SURVEY.md §3.5).
 
-    Valid when the single Range site is the learning rate; returns None
-    (serial fallback) otherwise.
+    Handles ANY combination of hyper-key Range sites (learning_rate,
+    weights_decay, gradient_moment, ... — the generic mapping,
+    parallel/population.config_values_to_hypers); returns None (serial
+    fallback) for sites that are not fused hyper slots.
     """
-    if len(sites) != 1 or sites[0][1] != "learning_rate":
-        return None
     from znicz_tpu.core import prng
     from znicz_tpu.core.workflow import DummyWorkflow
+    from znicz_tpu.parallel import fused
     from znicz_tpu.parallel.population import (
-        make_population_evaluator, uniform_lr_hypers)
+        make_population_evaluator, config_values_to_hypers)
     import numpy
-    loader = WineLoader(DummyWorkflow(),
-                        minibatch_size=root.wine.loader.minibatch_size)
-    loader.initialize()
-    x = numpy.array(loader.original_data.mem)
-    y = numpy.array(loader.original_labels, dtype=numpy.int32)
     n_hidden, n_classes = root.wine.layers
     layers = [
         {"type": "all2all_tanh",
          "->": {"output_sample_shape": int(n_hidden)}},
         {"type": "softmax", "->": {"output_sample_shape": int(n_classes)}},
     ]
-    defaults = {"wd": float(root.wine.weights_decay)}
+    defaults = {"wd": float(root.wine.weights_decay),
+                "lr": float(root.wine.learning_rate)}
+    loader = WineLoader(DummyWorkflow(),
+                        minibatch_size=root.wine.loader.minibatch_size)
+    loader.initialize()
+    x = numpy.array(loader.original_data.mem)
+    y = numpy.array(loader.original_labels, dtype=numpy.int32)
+    specs = tuple(fused.build_specs(layers, x.shape[1], defaults))
+    mapper = config_values_to_hypers(sites, layers, specs)
+    if mapper is None:
+        return None
     return make_population_evaluator(
-        layers, x.shape[1], x, y, x, y, uniform_lr_hypers,
+        layers, x.shape[1], x, y, x, y, mapper,
         epochs=epochs or int(root.wine.decision.max_epochs),
         minibatch_size=int(root.wine.loader.minibatch_size),
         rand=prng.RandomGenerator().seed(seed), defaults=defaults)

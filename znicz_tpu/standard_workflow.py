@@ -101,6 +101,9 @@ class StandardWorkflow(StandardWorkflowBase):
             self.fused_trainer.link_attrs(
                 self.loader, ("labels", "minibatch_labels"))
         self.fused_trainer.label_source = self.real_loader
+        # window collection drives the loader directly (scan windows —
+        # the compiled hot loop batches K TRAIN minibatches per dispatch)
+        self.fused_trainer.loader_unit = self.loader
         # the trainer IS the forward chain for downstream linkers
         # (link_evaluator/link_image_saver read forwards[-1])
         self.forwards[:] = [self.fused_trainer]
@@ -127,6 +130,11 @@ class StandardWorkflow(StandardWorkflowBase):
                 continue
             unit = backward_cls(self, **kwargs)
             self.gds[i] = unit
+            if hasattr(unit, "bind_forward"):
+                # pairs sharing structured parameters (e.g. the scan
+                # LSTM's gate pytree) take the forward directly instead
+                # of linking singular weights/bias Arrays
+                unit.bind_forward(self.forwards[i])
 
             if first_gd is not None:
                 unit.link_from(first_gd) \
@@ -169,6 +177,12 @@ class StandardWorkflow(StandardWorkflowBase):
                         ("offset", "minibatch_offset"))
         if self.loss_function == "softmax":
             self.evaluator.link_attrs(self.forwards[-1], "max_idx")
+            if self.fused_trainer is not None:
+                # windowed TRAIN dispatches hand the evaluator their
+                # in-scan aggregated stats (the output buffer holds only
+                # the window's LAST minibatch)
+                self.evaluator.stats_source = self.fused_trainer
+                self.fused_trainer.stats_mean = self.evaluator.mean
         elif self.loss_function == "mse":
             self.evaluator.link_attrs(
                 self.loader, ("target", "minibatch_targets"))
@@ -243,6 +257,9 @@ class StandardWorkflow(StandardWorkflowBase):
             self.fused_trainer.unlink_from(self.loader)
             self.lr_adjuster.link_from(self.loader)
             self.fused_trainer.link_from(self.lr_adjuster)
+            # window collection ticks the schedule per collected
+            # minibatch, so policy(k) reaches step k INSIDE the window
+            self.fused_trainer.hyper_tick = self.lr_adjuster.run
             return self.lr_adjuster
         for gd in self.gds:
             self.lr_adjuster.add_gd_unit(gd)

@@ -32,3 +32,4 @@ from znicz_tpu.units import accumulator  # noqa: F401
 from znicz_tpu.units import kohonen  # noqa: F401
 from znicz_tpu.units import rbm_units  # noqa: F401
 from znicz_tpu.units import lstm  # noqa: F401
+from znicz_tpu.units import lstm_scan  # noqa: F401

@@ -97,6 +97,12 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err = Array(name="n_err")
         self.max_err_output_sum = Array(name="max_err_output_sum")
         self.class_keys = None
+        #: a unit exposing ``window_stats`` (the fused trainer in scan-
+        #: window mode): when it carries stats for the just-run dispatch,
+        #: accumulate those — the output buffer holds only the window's
+        #: last minibatch, and the stats were computed evaluator-
+        #: identically inside the compiled window (fused._eval_stats)
+        self.stats_source = None
         self.demand("labels", "max_idx")
 
     def initialize(self, device=None, **kwargs):
@@ -126,7 +132,20 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.max_err_output_sum.mem[0] = max(
             float(self.max_err_output_sum.mem[0]), float(max_err_sum))
 
+    def _consume_window_stats(self):
+        ws = getattr(self.stats_source, "window_stats", None) \
+            if self.stats_source is not None else None
+        if ws is None:
+            return False
+        self._accumulate_stats(ws["n_err"], ws["confusion"],
+                               ws["max_err_sum"])
+        if self.testing:
+            self.merge_output()
+        return True
+
     def numpy_run(self):
+        if self._consume_window_stats():
+            return
         self.output.map_read()
         self.max_idx.map_read()
         self.labels.map_read()
@@ -141,6 +160,8 @@ class EvaluatorSoftmax(EvaluatorBase):
             self.merge_output()
 
     def jax_run(self):
+        if self._consume_window_stats():
+            return
         out = self.output.dev
         out2 = out.reshape(out.shape[0], -1)
         err, n_err_delta, conf, mx = ev_ops.softmax_ce_jax(

@@ -29,6 +29,8 @@ whole-net state snapshots instead of per-GD-unit weight histories.
 
 import numpy
 
+import jax
+
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
@@ -121,6 +123,40 @@ class FusedForwardBackward(Unit):
         self.max_idx = Array(name="max_idx")
         #: training objective: "softmax" (CE + argmax stats) or "mse"
         self.loss = kwargs.get("loss", "softmax")
+        #: TRAIN minibatches batched per compiled dispatch: the unit
+        #: collects up to ``window`` minibatches from the loader and runs
+        #: them as ONE ``lax.scan`` window (FusedNet.run_window) — no
+        #: per-minibatch dispatch or host readback inside the window.
+        #: window=1 keeps the per-minibatch step (the executable spec the
+        #: window path is pinned against).  The DEFAULT is adaptive:
+        #: windows engage (8) when the loader qualifies for the device-
+        #: resident dataset path, else stay per-minibatch — an explicit
+        #: ``window=K`` forces K either way.  MSE topologies always run
+        #: per minibatch (the window path is softmax-objective only).
+        self.window = kwargs.get("window")
+        if self.window is not None:
+            self.window = int(self.window)
+        if self.loss == "mse":
+            self.window = 1
+        #: "auto" places a qualifying FullBatchLoader's dataset on device
+        #: once and gathers minibatches INSIDE the compiled window (only
+        #: the index arrays cross the host boundary); False forces the
+        #: host-stacked path; True fails loudly if the loader does not
+        #: qualify
+        self.device_data = kwargs.get("device_data", "auto")
+        #: the loader unit driven directly during window collection
+        #: (wired by StandardWorkflow.link_fused_trainer)
+        self.loader_unit = None
+        #: optional callable fired after each collected minibatch —
+        #: link_lr_adjuster points it at the adjuster's run so LR
+        #: policies advance per MINIBATCH, not per window
+        self.hyper_tick = None
+        #: aggregated stats of the last dispatched window (n_err[2],
+        #: confusion, max_err_sum) — the evaluator accumulates these
+        #: instead of recomputing from the (last-step-only) output
+        self.window_stats = None
+        #: evaluator ``mean`` flag mirror (link_evaluator sets it)
+        self.stats_mean = True
         self.net = None
         self.forward_mode = False
         #: loader whose label count / target shape sets the head width
@@ -217,6 +253,8 @@ class FusedForwardBackward(Unit):
             dropout_seed=self.dropout_seed,
             compute_dtype=self.compute_dtype, objective=self.loss,
             pool_impl=self.pool_impl)
+        self.net.stats_mean = self.stats_mean
+        self._setup_device_data()
         self._refresh_weight_views()
         batch = int(self.input.shape[0])
         out_shape = (batch,) + tuple(self.net.specs[-1].out_shape)
@@ -226,6 +264,111 @@ class FusedForwardBackward(Unit):
         if self._pending_state is not None:
             self._apply_state(self._pending_state)
             self._pending_state = None
+
+    # -- device-resident dataset (windowed TPU-first data path) -------------
+    def _loader_qualifies_for_device_data(self):
+        """The loader's fill is the stock FullBatchLoader fancy-index copy
+        (no per-sample transform override) — a device gather from the
+        normalized dataset produces identical rows."""
+        from znicz_tpu.loader.base import FullBatchLoader
+        lu = self.loader_unit
+        return (isinstance(lu, FullBatchLoader)
+                and type(lu).fill_minibatch is FullBatchLoader.fill_minibatch
+                and lu.original_data
+                and len(lu.original_labels) > 0)
+
+    def _setup_device_data(self):
+        self._use_device_data = False
+        qualifies = (self.device_data in ("auto", True)
+                     and self.loss == "softmax"
+                     and self.loader_unit is not None
+                     and not self.forward_mode
+                     and self._loader_qualifies_for_device_data())
+        if self.window is None:
+            # adaptive default: scan windows over the device-resident
+            # dataset where the loader qualifies; per-minibatch
+            # otherwise (a host-stacked window helps only when dispatch
+            # latency dominates — force with window=K)
+            self.window = 8 if qualifies else 1
+        if qualifies and self.window > 1:
+            self._use_device_data = True
+            # TRAIN minibatches are consumed as device gathers; the
+            # loader skips its host fill for them (VALID/TEST still
+            # fill — they run per-minibatch through predict)
+            self.loader_unit.skip_fill = True
+        elif self.device_data is True and not qualifies:
+            raise ValueError(
+                "fused device_data=True needs a stock FullBatchLoader "
+                "(no fill_minibatch override) with labels")
+
+    def _run_train_window(self):
+        """Collect up to ``window`` TRAIN minibatches (driving the loader
+        directly; the LR adjuster ticks per minibatch via hyper_tick) and
+        dispatch them as ONE compiled scan window.  The window never
+        crosses a segment boundary — collection stops at the loader's
+        last_minibatch, so epoch/segment bookkeeping, snapshotter gating
+        and decision semantics are untouched (reference decision.py only
+        consumes segment aggregates + end-of-segment output)."""
+        loader = self.loader_unit
+        idx_steps, x_steps, lbl_steps = [], [], []
+        sizes, hyper_steps = [], []
+        while True:
+            if self._use_device_data:
+                idx_steps.append(
+                    numpy.array(loader.minibatch_indices.mem,
+                                dtype=numpy.int32))
+            else:
+                self.input.map_read()
+                self.labels.map_read()
+                # numpy.array COPIES (asarray would alias the loader's
+                # live buffer, which the next loader.run() overwrites)
+                x_steps.append(numpy.array(self.input.mem))
+                lbl_steps.append(numpy.array(self.labels.mem,
+                                             dtype=numpy.int32))
+            sizes.append(int(self.minibatch_size))
+            hyper_steps.append(self._collect_hypers())
+            n = len(sizes)
+            if n >= self.window or bool(loader.last_minibatch):
+                break
+            loader.run()
+            if self.hyper_tick is not None:
+                self.hyper_tick()
+        # stack per-step hypers along a leading K axis; cast to the
+        # master param dtype (a float64 leaf would promote the f32
+        # optimizer state inside the scan — the per-minibatch path's
+        # python-float hypers are weakly typed and never promote)
+        hypers_s = jax.tree.map(
+            lambda *leaves: numpy.asarray(leaves, dtype=self.net.dtype),
+            *hyper_steps)
+        if self._use_device_data:
+            if not self.net.has_dataset:
+                lu = loader
+                data = numpy.asarray(lu.original_data.mem,
+                                     dtype=self.input.dtype)
+                self.net.set_dataset(data, lu.original_labels)
+            stats = self.net.run_window_indexed(
+                numpy.stack(idx_steps), sizes, hypers_s)
+        else:
+            stats = self.net.run_window(
+                numpy.stack(x_steps), numpy.stack(lbl_steps), sizes,
+                hypers_s)
+        # ONE pipelined host readback per window (device_get issues all
+        # async copies before waiting — per-leaf numpy.asarray would pay
+        # one full round trip EACH, which dominates on tunneled devices)
+        host = jax.device_get({k: stats[k] for k in
+                               ("n_err", "confusion", "max_err_sum",
+                                "output", "max_idx")})
+        self.window_stats = {
+            "n_err": host["n_err"],
+            "confusion": host["confusion"],
+            "max_err_sum": float(host["max_err_sum"]),
+        }
+        self.output.map_invalidate()
+        self.output.mem[...] = numpy.asarray(host["output"],
+                                             dtype=self.output.dtype)
+        self.max_idx.map_invalidate()
+        self.max_idx.mem[...] = host["max_idx"]
+        self._refresh_weight_views()
 
     def _collect_hypers(self):
         """Rebuild the traced hyper pytree from the live proxies."""
@@ -244,9 +387,14 @@ class FusedForwardBackward(Unit):
         return hypers
 
     def run(self):
+        train = int(self.minibatch_class) == TRAIN and not self.forward_mode
+        self.window_stats = None
+        if (train and self.loss == "softmax" and self.window > 1
+                and self.loader_unit is not None):
+            self._run_train_window()
+            return
         self.input.map_read()
         x = self.input.mem
-        train = int(self.minibatch_class) == TRAIN and not self.forward_mode
         idx = None
         if self.loss == "mse":
             self.target.map_read()
@@ -268,7 +416,9 @@ class FusedForwardBackward(Unit):
                 out, idx = self.net.predict_with_idx(x)
         # host copies: the downstream evaluator mixes these with
         # single-device loader arrays — a mesh-committed jax.Array would
-        # clash there, and the per-minibatch pull is small
+        # clash there, and the per-minibatch pull is small.  device_get
+        # pipelines the transfers (one round trip, not one per array).
+        out, idx = jax.device_get((out, idx))
         self.output.map_invalidate()
         self.output.mem[...] = numpy.asarray(out, dtype=self.output.dtype)
         if idx is not None:
@@ -355,12 +505,9 @@ class FusedNNRollback(Unit):
             proxy.learning_rate_bias *= k
 
     def _has_nans(self):
-        params = self.trainer.net.host_params()
-        for p in params:
-            for arr in p.values():
-                if numpy.isnan(arr).any():
-                    return True
-        return False
+        # one jitted isfinite reduction on device — no whole-model host
+        # pull on the failure path (VERDICT r3 weak #7)
+        return not self.trainer.net.params_finite()
 
     def run(self):
         if self.improved:

@@ -1,0 +1,294 @@
+"""Trainable scan-LSTM — the TPU-first sequence story at the unit tier.
+
+The reference's only recurrent structure is the per-timestep LSTM cell
+sub-workflow (reference lstm.py:52-144), unrolled EXTERNALLY one cell
+per timestep with truncated gradients.  ``LSTMScan`` lifts that into the
+workflow tier the TPU way: the whole T-step unroll is ONE compiled
+``lax.scan`` (:func:`znicz_tpu.ops.recurrent.lstm_scan_jax`) and the
+gradient is full BPTT via ``jax.vjp`` through the scan — one XLA
+program per minibatch instead of T graph passes.
+
+Parity story:
+* cell math equals the unit-graph cell to 1e-12
+  (tests/unit/test_lstm_scan.py);
+* for T=1 the scan IS the cell, and two epochs of training match the
+  cell + GDLSTM unit pair exactly (tests/unit/test_lstm_scan_unit.py) —
+  the update algebra is literally :func:`znicz_tpu.ops.gd_math.update`;
+* for T>1 the gradient is checked by numeric differentiation (the
+  reference's own oracle for every GD unit, gd_numdiff.py) — exact
+  trajectory parity against the unit graph is undefined there because
+  the reference never backpropagates through time across cells.
+
+Config usage (StandardWorkflow layers entry)::
+
+    {"type": "lstm_scan", "->": {"output_sample_shape": HIDDEN},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}}
+
+The loader serves (batch, T, features) minibatches; the unit outputs the
+LAST timestep's hidden state (batch, HIDDEN), so a softmax/MSE head
+chains exactly like after an All2All.
+"""
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.accelerated_units import AcceleratedUnit
+from znicz_tpu.core.distributable import IDistributable
+from znicz_tpu.units.nn_units import (
+    Forward, FullyConnectedOutput, MatchingObject)
+from znicz_tpu.ops import recurrent, gd_math
+from znicz_tpu.ops.recurrent import GATES
+
+
+class LSTMScan(FullyConnectedOutput, Forward):
+    """Forward: (B, T, F) -> last hidden state (B, H) through one
+    compiled scan.  Gate parameters live in All2All layout
+    ({gate: {"w": (H, F+H), "b": (H,)}}, reference all2all.py weights
+    contract) and draw from the PRNG in GATES order, weights then bias
+    per gate."""
+
+    MAPPING = {"lstm_scan"}
+
+    def __init__(self, workflow, **kwargs):
+        super(LSTMScan, self).__init__(workflow, **kwargs)
+        self.gate_arrays = {}
+        #: constant added to the forget gate's bias at init — starts the
+        #: gate open (sigmoid(1) ~ 0.73) so gradients survive long
+        #: distractor spans; the standard LSTM training device.  Set 0
+        #: for exact init parity with the cell sub-workflow.
+        self.forget_bias = kwargs.get("forget_bias", 1.0)
+        self.demand("input", "output_sample_shape")
+        self.exports.append("gate_state")
+
+    @property
+    def hidden(self):
+        return int(numpy.prod(self.output_sample_shape))
+
+    def initialize(self, device=None, **kwargs):
+        super(LSTMScan, self).initialize(device=device, **kwargs)
+        if len(self.input.shape) != 3:
+            raise ValueError(
+                "lstm_scan wants (batch, T, features) minibatches, got %s"
+                % (self.input.shape,))
+        batch, t, feats = self.input.shape
+        h = self.hidden
+        stddev = self.weights_stddev if self.weights_stddev is not None \
+            else 0.1
+        bias_stddev = self.bias_stddev if self.bias_stddev is not None \
+            else stddev
+        if not self.gate_arrays:
+            for name in GATES:
+                w = numpy.zeros((h, feats + h), dtype=self.input.dtype)
+                self.fill_array(self.weights_filling, w, stddev)
+                b = numpy.zeros(h, dtype=self.input.dtype)
+                self.fill_array(self.bias_filling, b, bias_stddev)
+                if name == "forget_gate":
+                    b += self.forget_bias
+                self.gate_arrays[name] = {
+                    "w": Array(w, name=name + "_w"),
+                    "b": Array(b, name=name + "_b")}
+        if not self.output or self.output.shape[0] != batch:
+            self.output.reset(numpy.zeros((batch, h),
+                                          dtype=self.input.dtype))
+
+    # -- snapshot state ------------------------------------------------------
+    @property
+    def gate_state(self):
+        if not self.gate_arrays:
+            return getattr(self, "_pending_gate_state", None)
+        out = {}
+        for name, p in self.gate_arrays.items():
+            p["w"].map_read()
+            p["b"].map_read()
+            out[name] = {"w": numpy.array(p["w"].mem),
+                         "b": numpy.array(p["b"].mem)}
+        return out
+
+    @gate_state.setter
+    def gate_state(self, value):
+        if value is None:
+            return
+        if not self.gate_arrays:
+            self._pending_gate_state = value
+            return
+        for name, p in value.items():
+            self.gate_arrays[name]["w"].map_invalidate()
+            self.gate_arrays[name]["w"].mem[...] = p["w"]
+            self.gate_arrays[name]["b"].map_invalidate()
+            self.gate_arrays[name]["b"].mem[...] = p["b"]
+
+    def _params_dev(self):
+        return {name: {"w": p["w"].dev, "b": p["b"].dev}
+                for name, p in self.gate_arrays.items()}
+
+    def jax_run(self):
+        xs = self.input.dev
+        xs = jnp.swapaxes(xs, 0, 1)          # (T, B, F)
+        batch = xs.shape[1]
+        h0 = jnp.zeros((batch, self.hidden), dtype=xs.dtype)
+        ys, h, c = recurrent.lstm_scan_jax(self._params_dev(), xs, h0, h0)
+        self.output.set_dev(h)
+
+    # the scan driver is inherently the compiled path; the numpy twin of
+    # this computation is the per-timestep cell sub-workflow
+    # (units/lstm.py) — jax-on-CPU serves the NumpyDevice contract here
+    numpy_run = jax_run
+
+    # -- broadcast protocol (weights parity with Forward) --------------------
+    def generate_data_for_slave(self, slave=None):
+        return self.gate_state
+
+    def apply_data_from_master(self, data):
+        if data is not None:
+            self.gate_state = data
+
+
+class GDLSTMScan(AcceleratedUnit, IDistributable,
+                 metaclass=MatchingObject):
+    """Backward: full BPTT through the compiled scan via ``jax.vjp``,
+    followed by the SHARED update algebra (ops/gd_math.update — the same
+    function every GD unit and the fused path run) on each gate's
+    weights and bias."""
+
+    MAPPING = {"lstm_scan"}
+    _registry_role = "backward"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "TRAINER")
+        super(GDLSTMScan, self).__init__(workflow, **kwargs)
+        from znicz_tpu.core.mutable import Bool
+        self.gate_skip = Bool(False)
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get("learning_rate_bias",
+                                             self.learning_rate)
+        self.weights_decay = kwargs.get("weights_decay", 0.00005)
+        self.weights_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        self.l1_vs_l2 = kwargs.get("l1_vs_l2", 0.0)
+        self.l1_vs_l2_bias = kwargs.get("l1_vs_l2_bias", self.l1_vs_l2)
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        self.gradient_moment_bias = kwargs.get("gradient_moment_bias",
+                                               self.gradient_moment)
+        self.need_err_input = kwargs.get("need_err_input", True)
+        self.err_input = Array(name="err_input")
+        self.forward_unit = None
+        self._opt_state = None
+        self._bwd = None
+        self.demand("input", "err_output")
+        self.exports = ["scan_opt_state"]
+
+    def bind_forward(self, forward):
+        """Wired by StandardWorkflow.link_gds (the scan pair shares the
+        parameter Arrays rather than linking singular weights/bias)."""
+        self.forward_unit = forward
+
+    # -- snapshot state ------------------------------------------------------
+    @property
+    def scan_opt_state(self):
+        if self._opt_state is None:
+            return getattr(self, "_pending_opt_state", None)
+        return jax.tree.map(numpy.asarray, self._opt_state)
+
+    @scan_opt_state.setter
+    def scan_opt_state(self, value):
+        if value is None:
+            return
+        if self._opt_state is None:
+            self._pending_opt_state = value
+        else:
+            self._opt_state = jax.tree.map(jnp.asarray, value)
+
+    def initialize(self, device=None, **kwargs):
+        super(GDLSTMScan, self).initialize(device=device, **kwargs)
+        if self.forward_unit is None:
+            raise ValueError("GDLSTMScan needs bind_forward(lstm_scan)")
+        if self.need_err_input and (
+                not self.err_input or
+                self.err_input.shape != self.input.shape):
+            self.err_input.reset(numpy.zeros(self.input.shape,
+                                             dtype=self.input.dtype))
+        if self._opt_state is None:
+            flags = self._flags()
+            self._opt_state = {
+                name: {"w": gd_math.init_state(p["w"].mem, flags, jnp),
+                       "b": gd_math.init_state(p["b"].mem, flags, jnp)}
+                for name, p in self.forward_unit.gate_arrays.items()}
+            pending = getattr(self, "_pending_opt_state", None)
+            if pending is not None:
+                self._opt_state = jax.tree.map(jnp.asarray, pending)
+                self._pending_opt_state = None
+
+    def _hyper(self, bias=False):
+        return dict(
+            lr=float(self.learning_rate_bias if bias
+                     else self.learning_rate),
+            wd=float(self.weights_decay_bias if bias
+                     else self.weights_decay),
+            l1_vs_l2=float(self.l1_vs_l2_bias if bias else self.l1_vs_l2),
+            moment=float(self.gradient_moment_bias if bias
+                         else self.gradient_moment),
+            acc_alpha=0.0, acc_beta=0.0, gd_alpha=0.0, gd_beta=1.0,
+            factor_ortho=0.0)
+
+    def _flags(self):
+        return dict(accumulate=False, apply=True, solvers=frozenset(),
+                    ortho=False, variant_moment=True, need_vel=True)
+
+    def _build_bwd(self):
+        flags = self._flags()
+
+        def bwd(params, opt, xs, err_h, hyper_w, hyper_b):
+            def f(p, x):
+                batch = x.shape[1]
+                h0 = jnp.zeros((batch, err_h.shape[1]), dtype=x.dtype)
+                _, h, _ = recurrent.lstm_scan_jax(p, x, h0, h0)
+                return h
+
+            _, vjp = jax.vjp(f, params, xs)
+            grads, err_xs = vjp(err_h)
+            new_params, new_opt = {}, {}
+            for name in params:
+                pw, sw, _ = gd_math.update(
+                    jnp, params[name]["w"], grads[name]["w"],
+                    opt[name]["w"], hyper_w, flags)
+                pb, sb, _ = gd_math.update(
+                    jnp, params[name]["b"], grads[name]["b"],
+                    opt[name]["b"], hyper_b, flags)
+                new_params[name] = {"w": pw, "b": pb}
+                new_opt[name] = {"w": sw, "b": sb}
+            return new_params, new_opt, err_xs
+
+        self._bwd = jax.jit(bwd)
+
+    def jax_run(self):
+        fwd = self.forward_unit
+        xs = jnp.swapaxes(self.input.dev, 0, 1)       # (T, B, F)
+        err_h = self.err_output.dev.reshape(
+            self.err_output.shape[0], -1)
+        if self._bwd is None:
+            self._build_bwd()
+        params = fwd._params_dev()
+        new_params, self._opt_state, err_xs = self._bwd(
+            params, self._opt_state, xs, err_h,
+            self._hyper(False), self._hyper(True))
+        for name, p in new_params.items():
+            fwd.gate_arrays[name]["w"].set_dev(p["w"])
+            fwd.gate_arrays[name]["b"].set_dev(p["b"])
+        if self.need_err_input:
+            self.err_input.set_dev(jnp.swapaxes(err_xs, 0, 1))
+
+    numpy_run = jax_run
+
+    def run(self):
+        if self.gate_skip:
+            return
+        super(GDLSTMScan, self).run()
+
+    # -- master-slave protocol stubs ----------------------------------------
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass

@@ -569,3 +569,58 @@ def load_snapshot_into_workflow(state, workflow):
                     setattr(u, attr, value)
                 except AttributeError:
                     pass
+    _map_cross_mode_state(state, workflow)
+
+
+def _map_cross_mode_state(state, workflow):
+    """Snapshots restore across EXECUTION MODES: fused params map 1:1
+    onto the layer list, so a fused-mode snapshot restored into a
+    unit-graph workflow injects its weights into the forwards (via the
+    broadcast protocol, like extract_forward_workflow) and vice versa.
+    Optimizer state does not transfer between representations — warn,
+    because momentum restarts cold."""
+    snap_units = state.get("units", {})
+    fused_state = snap_units.get("fused_trainer", {}).get("fused_state")
+    trainer = getattr(workflow, "fused_trainer", None)
+    forwards = [f for f in getattr(workflow, "forwards", ())]
+    if fused_state is not None and trainer is None and forwards:
+        workflow.warning(
+            "snapshot was written in FUSED mode; mapping its params onto "
+            "the unit graph (optimizer momentum restarts cold — pass "
+            "--fused to resume bit-exactly)")
+        for fwd, p in zip(forwards, fused_state.get("params", ())):
+            if p and hasattr(fwd, "apply_data_from_master"):
+                fwd.apply_data_from_master([p.get("w"), p.get("b")])
+        return
+    if fused_state is None and trainer is not None and \
+            "fused_trainer" not in snap_units:
+        # unit-graph snapshot into a fused run: collect per-forward
+        # weights saved under their unit names (the builder names them
+        # "<layer name>_forward" / "<type>_<i>_forward",
+        # standard_workflow_base._get_layer_type_kwargs)
+        params = []
+        ok = False
+        for i, layer in enumerate(trainer.layers):
+            tpe = layer.get("type")
+            name = (layer["name"] + "_forward") if "name" in layer \
+                else "%s_%d_forward" % (tpe, i)
+            ustate = snap_units.get(name, {})
+            p = {}
+            if ustate.get("weights") is not None:
+                p["w"] = numpy.array(ustate["weights"])
+                ok = True
+                if ustate.get("bias") is not None:
+                    p["b"] = numpy.array(ustate["bias"])
+            params.append(p)
+        if ok:
+            workflow.warning(
+                "snapshot was written in UNIT-GRAPH mode; mapping its "
+                "weights onto the fused trainer (optimizer momentum "
+                "restarts cold — drop --fused to resume bit-exactly)")
+            sd = trainer.fused_state
+            if sd is not None:
+                for tgt, src in zip(sd["params"], params):
+                    for k, v in src.items():
+                        if k in tgt and tgt[k].shape == v.shape:
+                            tgt[k] = v.astype(tgt[k].dtype)
+                trainer.fused_state = sd
