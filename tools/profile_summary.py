@@ -77,15 +77,32 @@ def summarize(trace_dir, top_n=25):
                 continue
             device_planes += 1
             emeta = plane.event_metadata
-            for line in plane.lines:
-                lname = line.name.lower()
-                if "step" in lname or "annotation" in lname \
-                        or lname == "python":
-                    continue  # step/trace-me lines duplicate op time
-                if is_cpu_xla and "xla-cpu-codegen" not in lname:
-                    continue  # CPU: count only the codegen'd kernels
+            # avoid double counting the op hierarchy: TPU planes carry
+            # "Steps" / "XLA Modules" (parents) AND "XLA Ops" (leaves) —
+            # sum leaves only.  "Async XLA Ops" (DMA copies) run on a
+            # separate engine overlapping the compute line; count them
+            # separately so overlap is visible, not added to the total.
+            lines = {l.name: l for l in plane.lines}
+            if "XLA Ops" in lines:
+                chosen = [lines["XLA Ops"]]
+            elif is_cpu_xla:
+                chosen = [l for n, l in lines.items()
+                          if "xla-cpu-codegen" in n.lower()]
+            else:
+                chosen = [l for n, l in lines.items()
+                          if "step" not in n.lower()
+                          and "module" not in n.lower()
+                          and n.lower() != "python"]
+            for line in chosen:
                 for ev in line.events:
                     op = emeta[ev.metadata_id].name
+                    # control-flow wrappers span their whole body — the
+                    # body's ops are separate events on the same line,
+                    # so counting the wrapper double-counts everything
+                    # inside it
+                    if op.startswith(("%while", "%conditional",
+                                      "%call", "jit_")):
+                        continue
                     by_op[op] += ev.duration_ps
                     by_cat[_categorize(op)] += ev.duration_ps
                     total_ps += ev.duration_ps
