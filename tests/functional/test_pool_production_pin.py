@@ -79,30 +79,36 @@ def _train(tmp_path, fused_cfg):
     return wf
 
 
-def test_reduce_window_trajectory_pinned(tmp_path, float64_engine):
-    wf_rw = _train(tmp_path, {})              # default: reduce_window
+def test_production_pool_trajectory_pinned(tmp_path, float64_engine):
+    """ALL THREE max-pool lowerings must agree exactly on untied data —
+    the default reduce_window select-and-scatter VJP, the "offsets"
+    custom-VJP path, and the gather/scatter-add path — and the absolute
+    integers are pinned (catches a numerics change that shifts every
+    lowering together)."""
+    wf_def = _train(tmp_path, {})             # default: reduce_window
+    wf_off = _train(tmp_path, {"pool_impl": "offsets"})
     wf_g = _train(tmp_path, {"pool_impl": "gather"})
 
-    for spec in wf_rw.fused_trainer.net.specs:
+    for spec in wf_def.fused_trainer.net.specs:
         if spec.kind == "pool":
             assert spec.impl == "reduce_window"
+    for spec in wf_off.fused_trainer.net.specs:
+        if spec.kind == "pool":
+            assert spec.impl == "offsets"
 
-    # untied data: the select-and-scatter VJP must route exactly like
-    # the first-maximum gather scatter
-    assert list(wf_rw.decision.epoch_n_err) == \
-        list(wf_g.decision.epoch_n_err)
-    p_rw = wf_rw.fused_trainer.host_params()
-    p_g = wf_g.fused_trainer.host_params()
-    for a, b in zip(p_rw, p_g):
-        for k in a:
-            diff = numpy.abs(a[k] - b[k]).max()
-            assert diff < 1e-12, diff
+    for other in (wf_off, wf_g):
+        assert list(wf_def.decision.epoch_n_err) == \
+            list(other.decision.epoch_n_err)
+        p_a = wf_def.fused_trainer.host_params()
+        p_b = other.fused_trainer.host_params()
+        for a, b in zip(p_a, p_b):
+            for k in a:
+                diff = numpy.abs(a[k] - b[k]).max()
+                assert diff < 1e-12, diff
 
-    # and the absolute integers are pinned (catches a change that
-    # shifts BOTH lowerings)
-    print("reduce_window n_err:", wf_rw.decision.epoch_n_err)
-    assert wf_rw.decision.epoch_n_err[VALID] == GOLDEN_N_ERR[VALID]
-    assert wf_rw.decision.epoch_n_err[TRAIN] == GOLDEN_N_ERR[TRAIN]
+    print("production pool n_err:", wf_def.decision.epoch_n_err)
+    assert wf_def.decision.epoch_n_err[VALID] == GOLDEN_N_ERR[VALID]
+    assert wf_def.decision.epoch_n_err[TRAIN] == GOLDEN_N_ERR[TRAIN]
 
 
 #: AlexNet 1-epoch pins on the default pooling path (tiny synthetic

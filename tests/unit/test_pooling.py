@@ -240,3 +240,66 @@ def test_pallas_pooling_review_regressions():
         jnp.zeros(2, jnp.int32), tuple(specs))[0])(params)
     assert all(numpy.isfinite(numpy.asarray(v)).all()
                for d in grads for v in d.values())
+
+
+def test_max_pooling_train_custom_vjp_matches_gather():
+    """The production "offsets" pooling (custom VJP: recorded winners +
+    dense shifted-accumulation backward) equals the gather formulation
+    exactly — values, offsets, and input gradients — across
+    non-overlapping, overlapping, ceil-mode and maxabs configs."""
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.ops import pooling as pool_ops
+
+    r = numpy.random.RandomState(7)
+    for (ky, kx, sl, ua) in ((2, 2, (2, 2), False),
+                             (3, 3, (2, 2), False),
+                             (3, 2, (2, 1), True),
+                             (2, 2, (2, 2), True)):
+        x = jnp.asarray(r.uniform(-1, 1, (3, 9, 8, 5)))
+        y1, o1 = pool_ops.max_pooling_train_jax(x, ky, kx, sl, ua, False)
+        y2, o2 = pool_ops.max_pooling_gather_jax(x, ky, kx, sl, ua)
+        numpy.testing.assert_array_equal(numpy.asarray(y1),
+                                         numpy.asarray(y2))
+        numpy.testing.assert_array_equal(numpy.asarray(o1),
+                                         numpy.asarray(o2))
+        w = jnp.asarray(r.uniform(-1, 1, y1.shape))
+        g1 = jax.grad(lambda a: (pool_ops.max_pooling_train_jax(
+            a, ky, kx, sl, ua, False)[0] * w).sum())(x)
+        g2 = jax.grad(lambda a: (pool_ops.max_pooling_gather_jax(
+            a, ky, kx, sl, ua)[0] * w).sum())(x)
+        diff = numpy.abs(numpy.asarray(g1) - numpy.asarray(g2)).max()
+        assert diff < 1e-12, (ky, kx, sl, ua, diff)
+
+
+def test_pallas_kernel_review_regressions_r4():
+    """Round-4 review findings, pinned: (a) the kernel computes in f32,
+    so float64 must NOT route through it (values would round and
+    winners could flip); (b) a real -inf cell inside a ceil-mode
+    overhang window must beat the padding sentinel (the winner offset
+    must stay in-bounds)."""
+    import jax.numpy as jnp
+    from znicz_tpu.ops import pallas_pooling, pooling as pool_ops
+
+    # (a) f64 rejected by the gate; max_pooling_jax still exact via the
+    # window-view path
+    x64 = numpy.zeros((1, 2, 2, 1))
+    x64[0, 0, 0, 0] = 1.0
+    x64[0, 1, 1, 0] = 1.0 + 1e-12
+    assert not pallas_pooling.supported(jnp.asarray(x64), 2, 2, (2, 2),
+                                        False)
+    val, off = pool_ops.max_pooling_jax(jnp.asarray(x64), 2, 2, (2, 2))
+    ref_val, ref_off = pool_ops.max_pooling_numpy(x64, 2, 2, (2, 2))
+    assert float(val.ravel()[0]) == float(ref_val.ravel()[0])
+    assert int(off.ravel()[0]) == int(ref_off.ravel()[0])
+
+    # (b) -inf in the overhang window: winner = the real -inf cell, not
+    # the sentinel padding (offset must be in-bounds)
+    x = numpy.zeros((1, 3, 3, 1), numpy.float32)
+    x[0, 2, 2, 0] = -numpy.inf
+    x[0, :2, :2, 0] = 5.0  # window (0,0) is benign
+    val, off = pool_ops.max_pooling_jax(jnp.asarray(x), 2, 2, (2, 2))
+    ref_val, ref_off = pool_ops.max_pooling_numpy(x, 2, 2, (2, 2))
+    numpy.testing.assert_array_equal(numpy.asarray(val), ref_val)
+    numpy.testing.assert_array_equal(numpy.asarray(off), ref_off)
+    assert int(numpy.asarray(off).max()) < x.size

@@ -105,6 +105,98 @@ def max_pooling_gather_jax(x, ky, kx, sliding, use_abs=False):
     return val, offs.astype(jnp.int32)
 
 
+def _winner_qyx(offs, x_shape, ny, nx, sliding):
+    """Decode winner flat offsets back into within-window (qy, qx)."""
+    b, h, w, c = x_shape
+    wy = (offs // (w * c)) % h
+    wx = (offs // c) % w
+    qy = wy - jnp.arange(ny).reshape(1, ny, 1, 1) * sliding[1]
+    qx = wx - jnp.arange(nx).reshape(1, 1, nx, 1) * sliding[0]
+    return qy, qx
+
+
+def _maxpool_bwd_dense(err, offs, x_shape, ky, kx, sliding):
+    """Max-pool input gradient WITHOUT a scatter: route each window's
+    cotangent to its recorded winner by dense shifted accumulation.
+
+    TPU scatters serialize (select-and-scatter was ~16% of the flagship
+    window's device time, profiles/r4_summary.md); this formulation is
+    ky*kx masked dense adds — and ONE fused expansion when windows do
+    not overlap (sliding == kernel), the common case."""
+    b, h, w, c = x_shape
+    ny, nx = err.shape[1], err.shape[2]
+    sy, sx = sliding[1], sliding[0]
+    qy, qx = _winner_qyx(offs, x_shape, ny, nx, sliding)
+    if (sy, sx) == (ky, kx):
+        # disjoint windows: expand (B, ny, nx, C) -> (B, ny, ky, nx, kx,
+        # C) with the winner one-hot, collapse to the input grid — one
+        # fused elementwise, no accumulation
+        oh_y = (qy[:, :, None, :, :] ==
+                jnp.arange(ky).reshape(1, 1, ky, 1, 1))
+        oh_x = (qx[:, :, :, None, :] ==
+                jnp.arange(kx).reshape(1, 1, 1, kx, 1))
+        exp = (err[:, :, None, :, None, :] *
+               (oh_y[:, :, :, :, None, :] &
+                oh_x[:, :, None, :, :, :]).astype(err.dtype))
+        full = exp.reshape(b, ny * ky, nx * kx, c)
+        return full[:, :h, :w, :]
+    hp = max(h, (ny - 1) * sy + ky)
+    wp = max(w, (nx - 1) * sx + kx)
+    acc = jnp.zeros((b, hp, wp, c), err.dtype)
+    for dy in range(ky):
+        for dx in range(kx):
+            contrib = jnp.where((qy == dy) & (qx == dx), err, 0)
+            acc = acc + lax.pad(
+                contrib, jnp.asarray(0, err.dtype),
+                ((0, 0, 0),
+                 (dy, hp - (ny - 1) * sy - 1 - dy, sy - 1),
+                 (dx, wp - (nx - 1) * sx - 1 - dx, sx - 1),
+                 (0, 0, 0)))
+    return acc[:, :h, :w, :]
+
+
+def _offsets_forward(x, ky, kx, sliding, use_abs, prefer_pallas):
+    """(values, offsets) with first-winner ties: the Pallas one-pass
+    kernel on a real single-device TPU, the window-view argmax
+    elsewhere (identical semantics; interpret-mode Pallas off-TPU and
+    GSPMD-partitioned custom calls are both avoided)."""
+    from znicz_tpu.ops import pallas_pooling
+    if (prefer_pallas and jax.default_backend() == "tpu"
+            and pallas_pooling.supported(x, ky, kx, sliding, use_abs)):
+        return pallas_pooling.max_pooling_offsets_pallas(
+            x, ky, kx, tuple(sliding), use_abs=use_abs)
+    return max_pooling_gather_jax(x, ky, kx, tuple(sliding), use_abs)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def max_pooling_train_jax(x, ky, kx, sliding, use_abs=False,
+                          prefer_pallas=True):
+    """Differentiable max/maxabs pooling returning (values, winner
+    offsets) with the unit path's FIRST-winner tie rule.
+
+    Backward: dense shifted accumulation to the recorded winners
+    (``_maxpool_bwd_dense``) — neither the gather formulation's
+    scatter-add nor reduce_window's select-and-scatter appears in the
+    compiled program.  This is the fused path's production pooling
+    ("offsets" impl)."""
+    return _offsets_forward(x, ky, kx, sliding, use_abs, prefer_pallas)
+
+
+def _mpt_fwd(x, ky, kx, sliding, use_abs, prefer_pallas):
+    y, offs = _offsets_forward(x, ky, kx, sliding, use_abs, prefer_pallas)
+    return (y, offs), (offs, x.shape)
+
+
+def _mpt_bwd(ky, kx, sliding, use_abs, prefer_pallas, res, cts):
+    offs, x_shape = res
+    err, _ = cts  # the integer offsets output takes no cotangent
+    return (_maxpool_bwd_dense(err, offs, x_shape, ky, kx,
+                               tuple(sliding)),)
+
+
+max_pooling_train_jax.defvjp(_mpt_fwd, _mpt_bwd)
+
+
 @partial(jax.jit, static_argnames=("ky", "kx", "sliding", "mode"))
 def pooling_fwd_jax(x, ky, kx, sliding, mode="max"):
     """Offset-free pooling via ``lax.reduce_window`` — the TPU-native

@@ -208,17 +208,29 @@ class PoolSpec:
     geometry; winner-take-all gradient comes from the VJP of the gather —
     the same scatter-add the unit path runs, gd_pooling.py:233-247).
 
-    ``impl`` selects the max-pool lowering: "reduce_window" (XLA
-    select-and-scatter — the TPU-native path, ~100x the gather
-    formulation on a v5e; tie routing implementation-defined) or
-    "gather" (argmax + gather; gradient scatters to the FIRST maximum —
-    bit-parity with the unit path even on tied windows, e.g. flat image
-    regions; the float64 parity/golden tests use it).  avg always uses
-    reduce_window (no ties to break)."""
+    ``impl`` selects the max-pool lowering:
+
+    * "reduce_window" (DEFAULT): XLA select-and-scatter VJP; tie
+      routing implementation-defined.  Measured fastest at bench batch
+      sizes despite select-and-scatter's ~16% share of the window
+      (profiles/r4_summary.md) — see BENCH_NOTES.md for the ablation.
+    * "offsets": the custom-VJP op ``ops/pooling.max_pooling_train_jax``
+      — Pallas one-pass forward on a single-device TPU (window-view
+      argmax elsewhere) and a dense shifted-accumulation backward to
+      the recorded winners.  First-winner tie rule = the unit path's;
+      no select-and-scatter and no scatter-add in the compiled
+      program, but the per-row Pallas grid and the expansion traffic
+      lose to select-and-scatter at large batch (kept selectable; the
+      production pin proves all three lowerings agree on untied data).
+    * "gather": argmax + gather with a scatter-add VJP — the float64
+      parity/golden tests use it (its backward's summation ORDER
+      matches the unit path's scatter on overlapping windows).
+
+    avg always uses reduce_window (no ties to break)."""
     type: str
     in_shape: tuple
     out_shape: tuple
-    mode: str            # "max" | "maxabs" | "avg"
+    mode: str            # "max" | "maxabs" | "avg" | stochastic modes
     kx: int
     ky: int
     sliding: tuple
@@ -701,6 +713,15 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
                     y, spec.ky, spec.kx, spec.sliding,
                     use_abs=spec.mode == "maxabs")
                 offsets[i] = offs
+            elif spec.mode != "avg" and spec.impl == "offsets":
+                # production path: custom-VJP op — Pallas/window-view
+                # forward with recorded winners, dense accumulation
+                # backward (no select-and-scatter, no scatter-add)
+                y, offs = pool_ops.max_pooling_train_jax(
+                    y, spec.ky, spec.kx, spec.sliding,
+                    spec.mode == "maxabs",
+                    getattr(spec, "prefer_pallas", True))
+                offsets[i] = offs
             elif spec.mode != "avg" and spec.impl == "gather":
                 # gather path: gradient scatters to the FIRST maximum —
                 # exact tie parity with the unit path (flat regions tie;
@@ -885,6 +906,11 @@ class FusedNet:
             if spec.kind == "pool" and \
                     not getattr(spec, "record_offsets", False):
                 spec.impl = pool_impl
+            if spec.kind == "pool":
+                # the Pallas forward is single-device; under a mesh the
+                # offsets impl keeps the window-view forward (GSPMD
+                # partitions it like any XLA op)
+                spec.prefer_pallas = mesh is None
         self.compute_dtype = compute_dtype
         self.input_sample_shape = _normalize_sample_shape(input_sample_shape)
         self.objective = objective

@@ -115,8 +115,10 @@ class FusedForwardBackward(Unit):
         self.compute_dtype = kwargs.get("compute_dtype")
         self.defaults = kwargs.get("defaults")
         self.dropout_seed = kwargs.get("dropout_seed", 0)
-        #: "reduce_window" (TPU-fast) or "gather" (bit-parity with the
-        #: unit path on tied max-pool windows) — see PoolSpec.impl
+        #: max-pool lowering: "reduce_window" (select-and-scatter VJP —
+        #: fastest measured at bench batch sizes), "offsets" (custom
+        #: VJP, first-winner ties) or "gather" (unit-path summation-
+        #: order parity) — see fused.PoolSpec.impl
         self.pool_impl = kwargs.get("pool_impl", "reduce_window")
         self.rand = kwargs.get("rand", prng.get())
         self.output = Array(name="output")
