@@ -13,9 +13,8 @@
 """
 
 import numpy
-import pytest
 
-from znicz_tpu.core.backends import JaxDevice, NumpyDevice
+from znicz_tpu.core.backends import JaxDevice
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core.workflow import DummyWorkflow
 from znicz_tpu.units import lstm, lstm_scan

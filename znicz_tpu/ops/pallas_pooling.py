@@ -16,8 +16,6 @@ twins remain the executable spec everywhere (guide:
 
 import functools
 
-import numpy
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl

@@ -356,20 +356,29 @@ class FusedForwardBackward(Unit):
                 hypers_s)
         # ONE pipelined host readback per window (device_get issues all
         # async copies before waiting — per-leaf numpy.asarray would pay
-        # one full round trip EACH, which dominates on tunneled devices)
-        host = jax.device_get({k: stats[k] for k in
-                               ("n_err", "confusion", "max_err_sum",
-                                "output", "max_idx")})
+        # one full round trip EACH, which dominates on tunneled devices).
+        # The (batch, classes) output/argmax buffers are pulled only for
+        # SEGMENT-FINAL windows: in windowed mode every reference
+        # consumer of ``output`` (evaluator merge, image saver,
+        # plotters, decision end-of-segment bookkeeping) fires at
+        # segment/epoch boundaries, and mid-epoch windows' outputs are
+        # unread — skipping them saves the large transfer per window.
+        keys = ["n_err", "confusion", "max_err_sum"]
+        pull_output = bool(loader.last_minibatch)
+        if pull_output:
+            keys += ["output", "max_idx"]
+        host = jax.device_get({k: stats[k] for k in keys})
         self.window_stats = {
             "n_err": host["n_err"],
             "confusion": host["confusion"],
             "max_err_sum": float(host["max_err_sum"]),
         }
-        self.output.map_invalidate()
-        self.output.mem[...] = numpy.asarray(host["output"],
-                                             dtype=self.output.dtype)
-        self.max_idx.map_invalidate()
-        self.max_idx.mem[...] = host["max_idx"]
+        if pull_output:
+            self.output.map_invalidate()
+            self.output.mem[...] = numpy.asarray(host["output"],
+                                                 dtype=self.output.dtype)
+            self.max_idx.map_invalidate()
+            self.max_idx.mem[...] = host["max_idx"]
         self._refresh_weight_views()
 
     def _collect_hypers(self):
