@@ -30,6 +30,9 @@ MANIFESTS = {
     "approximator": {"workflow": "ApproximatorWorkflow",
                      "config": "root.approximator",
                      "baseline": "MSE 12.81"},
+    "sequence": {"workflow": "SequenceWorkflow",
+                 "config": "root.sequence",
+                 "baseline": None},  # beyond reference scope (scan LSTM)
     "research.mnist_simple": {"workflow": "MnistSimpleWorkflow",
                               "config": "root.mnist_simple",
                               "baseline": "1.48% val"},
