@@ -74,8 +74,8 @@ def _peak_flops(device_kind):
     return None
 
 
-def _measure(layers, loader_name, batch, compute_dtype, n_steps=20,
-             n_epochs=7, profile_dir=None):
+def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
+             n_epochs=5, profile_dir=None):
     """Steady-state throughput of the SHIPPED fused training loop.
 
     Builds a StandardWorkflow (synthetic full-batch dataset of
@@ -179,7 +179,7 @@ def main(profile_dir=None):
         ips_f32, _, _, _ = _try_measure(
             ge.FLAGSHIP_LAYERS, "mnist_loader",
             (batch, batch // 2, batch // 4), None,
-            n_steps=10, n_epochs=4)
+            n_steps=10, n_epochs=3)
     except Exception:  # noqa: BLE001 - tunneled worker crash
         ips_f32 = 0.0
     eff = ips * fpi
@@ -187,13 +187,13 @@ def main(profile_dir=None):
     # the north-star model (BASELINE.json metric line)
     cifar_ips, cifar_windows, cifar_fpi, cifar_batch = _try_measure(
         root.cifar.layers, "cifar_loader", (4096, 2048), jnp.bfloat16,
-        n_steps=10, n_epochs=6,
+        n_steps=10, n_epochs=5,
         profile_dir=(profile_dir + "_cifar") if profile_dir else None)
 
     # chip-filling wide model: the framework's MFU ceiling
     wide_ips, wide_windows, wide_fpi, wide_batch = _try_measure(
         WIDE_LAYERS, "cifar_loader", (1024, 512), jnp.bfloat16,
-        n_steps=10, n_epochs=6)
+        n_steps=10, n_epochs=5)
 
     baseline = 0.0
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -211,7 +211,7 @@ def main(profile_dir=None):
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
         "batch": batch,
-        "loop": "workflow-control-plane (scan window=20, device dataset)",
+        "loop": "workflow-control-plane (scan window=40, device dataset)",
         "window_ips": [round(w, 1) for w in windows],
         "window_spread_pct": _spread_pct(windows),
         "train_tflops_effective": round(eff / 1e12, 2),
