@@ -303,3 +303,60 @@ def test_pallas_kernel_review_regressions_r4():
     numpy.testing.assert_array_equal(numpy.asarray(val), ref_val)
     numpy.testing.assert_array_equal(numpy.asarray(off), ref_off)
     assert int(numpy.asarray(off).max()) < x.size
+
+
+def test_reshape_pooling_matches_gather_and_has_exact_vjp():
+    """The non-overlapping "reshape" lowering (strided slices +
+    compare/select, elementwise VJP — the auto-selected production
+    path) equals the gather formulation exactly: values, first-winner
+    tie routing (tested with deliberately tied windows), and input
+    gradients, including ceil-mode overhang and maxabs."""
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.ops import pooling as pool_ops
+
+    r = numpy.random.RandomState(11)
+    for (sy, sx, ky, kx, ua, tied) in (
+            (8, 8, 2, 2, False, False),
+            (9, 8, 2, 2, False, False),    # ceil-mode overhang rows
+            (8, 7, 2, 3, True, False),     # overhang cols + maxabs
+            (6, 6, 3, 3, False, True),     # tied windows: first winner
+            (6, 6, 2, 2, True, True)):
+        x = r.uniform(-1, 1, (3, sy, sx, 5))
+        if tied:
+            # quantize hard so in-window ties are guaranteed
+            x = numpy.round(x * 2) / 2
+        x = jnp.asarray(x)
+        sl = (kx, ky)
+        y1 = pool_ops.max_pooling_reshape_jax(x, ky, kx, ua)
+        y2, _ = pool_ops.max_pooling_gather_jax(x, ky, kx, sl, ua)
+        numpy.testing.assert_array_equal(numpy.asarray(y1),
+                                         numpy.asarray(y2))
+        w = jnp.asarray(r.uniform(-1, 1, y1.shape))
+        g1 = jax.grad(lambda a: (pool_ops.max_pooling_reshape_jax(
+            a, ky, kx, ua) * w).sum())(x)
+        g2 = jax.grad(lambda a: (pool_ops.max_pooling_gather_jax(
+            a, ky, kx, sl, ua)[0] * w).sum())(x)
+        diff = numpy.abs(numpy.asarray(g1) - numpy.asarray(g2)).max()
+        assert diff < 1e-12, (sy, sx, ky, kx, ua, tied, diff)
+
+
+def test_reshape_avg_pooling_matches_numpy_and_reduce_window():
+    import jax
+    import jax.numpy as jnp
+    from znicz_tpu.ops import pooling as pool_ops
+
+    r = numpy.random.RandomState(12)
+    for (sy, sx, ky, kx) in ((8, 8, 2, 2), (9, 7, 2, 3), (5, 5, 3, 3)):
+        x = r.uniform(-1, 1, (3, sy, sx, 4))
+        sl = (kx, ky)
+        yn = pool_ops.avg_pooling_numpy(x, ky, kx, sl)
+        yj = pool_ops.avg_pooling_reshape_jax(jnp.asarray(x), ky, kx)
+        assert numpy.abs(yn - numpy.asarray(yj)).max() < 1e-12
+        w = jnp.asarray(r.uniform(-1, 1, yn.shape))
+        g1 = jax.grad(lambda a: (pool_ops.avg_pooling_reshape_jax(
+            a, ky, kx) * w).sum())(jnp.asarray(x))
+        g2 = jax.grad(lambda a: (pool_ops.pooling_fwd_jax(
+            a, ky, kx, sl, mode="avg") * w).sum())(jnp.asarray(x))
+        diff = numpy.abs(numpy.asarray(g1) - numpy.asarray(g2)).max()
+        assert diff < 1e-12, (sy, sx, ky, kx, diff)

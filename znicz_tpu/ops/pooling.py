@@ -197,6 +197,118 @@ def _mpt_bwd(ky, kx, sliding, use_abs, prefer_pallas, res, cts):
 max_pooling_train_jax.defvjp(_mpt_fwd, _mpt_bwd)
 
 
+# -- non-overlapping "reshape" lowering ---------------------------------
+#
+# When sliding == kernel (the common MP2/MP3 case) every pooling window
+# is a disjoint (ky, kx) block, so the whole op decomposes into ky*kx
+# STRIDED SLICES of the input — no window-view gather, no
+# lax.reduce_window, and (crucially) no select-and-scatter in the VJP.
+# The r4 flagship profile (profiles/r4_summary.md) measured
+# select-and-scatter at ~16% and the reduce_window forward fusion at
+# ~13% of device time; both are replaced here by elementwise
+# compare/select chains that run at HBM stream rate.  First-winner tie
+# routing matches the unit path (reference pooling.py:303-312) — unlike
+# select-and-scatter, whose tie routing is implementation-defined.
+
+
+def _trunc_divisor(sy, sx, ky, kx, sliding, ny, nx):
+    """Truncated-window element counts (ny, nx) — the reference's avg
+    divisor (pooling.py:548); pure geometry, a trace-time constant."""
+    t_y = numpy.minimum(ky, sy - numpy.arange(ny) * sliding[1])
+    t_x = numpy.minimum(kx, sx - numpy.arange(nx) * sliding[0])
+    return (t_y[:, None] * t_x[None, :]).astype(numpy.float32)
+
+
+def _pad_nonoverlap(x, ky, kx, fill):
+    """Pad right/bottom to multiples of the kernel (ceil-mode overhang;
+    with sliding == kernel the ceil-mode geometry IS pad-to-multiple)."""
+    b, sy, sx, c = x.shape
+    py = (-sy) % ky
+    px = (-sx) % kx
+    if py or px:
+        x = jnp.pad(x, ((0, 0), (0, py), (0, px), (0, 0)),
+                    constant_values=fill)
+    return x
+
+
+def _nonoverlap_slices(xp, ky, kx):
+    """The ky*kx disjoint-window cell planes, in the reference's
+    row-major window scan order (dy outer, dx inner) — the order that
+    defines FIRST-winner ties."""
+    return [xp[:, dy::ky, dx::kx, :] for dy in range(ky) for dx in range(kx)]
+
+
+def _reshape_max_val(x, ky, kx, use_abs):
+    fill = 0.0 if use_abs else -numpy.inf
+    xp = _pad_nonoverlap(x, ky, kx, fill)
+    slices = _nonoverlap_slices(xp, ky, kx)
+    val = slices[0]
+    key = jnp.abs(val) if use_abs else val
+    for s in slices[1:]:
+        k = jnp.abs(s) if use_abs else s
+        take = k > key  # strict: earlier slices keep ties (first winner)
+        val = jnp.where(take, s, val)
+        key = jnp.where(take, k, key)
+    return val
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def max_pooling_reshape_jax(x, ky, kx, use_abs=False):
+    """Non-overlapping max/maxabs pooling as strided slices + a
+    compare/select chain; backward = winner mask recomputed from the
+    saved (input, output) pair and routed by pure interleave reshapes.
+    Residuals alias tensors the surrounding autodiff keeps alive anyway,
+    so the op adds no residual memory.  Requires sliding == (kx, ky)."""
+    return _reshape_max_val(x, ky, kx, use_abs)
+
+
+def _mpr_fwd(x, ky, kx, use_abs):
+    y = _reshape_max_val(x, ky, kx, use_abs)
+    return y, (x, y)
+
+
+def _mpr_bwd(ky, kx, use_abs, res, err):
+    x, y = res
+    b, sy, sx, c = x.shape
+    fill = 0.0 if use_abs else -numpy.inf
+    xp = _pad_nonoverlap(x, ky, kx, fill)
+    wkey = jnp.abs(y) if use_abs else y
+    ny, nx = y.shape[1], y.shape[2]
+    zero = jnp.zeros((), err.dtype)
+    seen = jnp.zeros(y.shape, dtype=bool)
+    parts = []
+    for s in _nonoverlap_slices(xp, ky, kx):
+        k = jnp.abs(s) if use_abs else s
+        win = (k == wkey) & ~seen
+        seen = seen | win
+        parts.append(jnp.where(win, err, zero))
+    rows = []
+    for dy in range(ky):
+        row = jnp.stack(parts[dy * kx:(dy + 1) * kx], axis=3)
+        rows.append(row.reshape(b, ny, nx * kx, c))
+    g = jnp.stack(rows, axis=2).reshape(b, ny * ky, nx * kx, c)
+    return (g[:, :sy, :sx, :],)
+
+
+max_pooling_reshape_jax.defvjp(_mpr_fwd, _mpr_bwd)
+
+
+@partial(jax.jit, static_argnames=("ky", "kx"))
+def avg_pooling_reshape_jax(x, ky, kx):
+    """Non-overlapping avg pooling as a strided-slice sum; the autodiff
+    VJP is pure pad/interleave (no reduce_window).  The divisor is the
+    reference's TRUNCATED window size (geometry constant), so overhang
+    semantics match pooling_fwd_jax exactly."""
+    b, sy, sx, c = x.shape
+    ny, nx = output_spatial(sy, sx, ky, kx, (kx, ky))
+    xp = _pad_nonoverlap(x, ky, kx, 0.0)
+    s = None
+    for sl in _nonoverlap_slices(xp, ky, kx):
+        s = sl if s is None else s + sl
+    cnt = _trunc_divisor(sy, sx, ky, kx, (kx, ky), ny, nx)
+    return s / jnp.asarray(cnt, x.dtype)[None, :, :, None]
+
+
 @partial(jax.jit, static_argnames=("ky", "kx", "sliding", "mode"))
 def pooling_fwd_jax(x, ky, kx, sliding, mode="max"):
     """Offset-free pooling via ``lax.reduce_window`` — the TPU-native
@@ -234,10 +346,7 @@ def pooling_fwd_jax(x, ky, kx, sliding, mode="max"):
     if mode == "avg":
         s = lax.reduce_window(x, numpy.asarray(0, x.dtype), lax.add,
                               dims, strides, pads)
-        # truncated-window divisor is pure geometry -> trace-time constant
-        t_y = numpy.minimum(ky, sy - numpy.arange(ny) * sliding[1])
-        t_x = numpy.minimum(kx, sx - numpy.arange(nx) * sliding[0])
-        cnt = (t_y[:, None] * t_x[None, :]).astype(numpy.float32)
+        cnt = _trunc_divisor(sy, sx, ky, kx, sliding, ny, nx)
         return s / jnp.asarray(cnt, x.dtype)[None, :, :, None]
     raise ValueError(mode)
 

@@ -210,10 +210,14 @@ class PoolSpec:
 
     ``impl`` selects the max-pool lowering:
 
-    * "reduce_window" (DEFAULT): XLA select-and-scatter VJP; tie
-      routing implementation-defined.  Measured fastest at bench batch
-      sizes despite select-and-scatter's ~16% share of the window
-      (profiles/r4_summary.md) — see BENCH_NOTES.md for the ablation.
+    * "reshape" (DEFAULT when sliding == kernel): ky*kx strided slices
+      + compare/select chain; VJP is a recomputed winner mask routed by
+      interleave reshapes — pure elementwise, first-winner ties.  No
+      reduce_window, select-and-scatter or gather in the compiled
+      program (those were ~29% of the r4 flagship window,
+      profiles/r4_summary.md).
+    * "reduce_window" (DEFAULT for overlapping windows): XLA
+      select-and-scatter VJP; tie routing implementation-defined.
     * "offsets": the custom-VJP op ``ops/pooling.max_pooling_train_jax``
       — Pallas one-pass forward on a single-device TPU (window-view
       argmax elsewhere) and a dense shifted-accumulation backward to
@@ -226,7 +230,8 @@ class PoolSpec:
       parity/golden tests use it (its backward's summation ORDER
       matches the unit path's scatter on overlapping windows).
 
-    avg always uses reduce_window (no ties to break)."""
+    avg uses the reshape lowering when windows are disjoint and
+    reduce_window otherwise (no ties to break either way)."""
     type: str
     in_shape: tuple
     out_shape: tuple
@@ -713,6 +718,18 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
                     y, spec.ky, spec.kx, spec.sliding,
                     use_abs=spec.mode == "maxabs")
                 offsets[i] = offs
+            elif spec.impl == "reshape":
+                # non-overlapping windows: strided-slice compare/select
+                # chain, elementwise VJP — no reduce_window, no
+                # select-and-scatter, no gather (ops/pooling.py; the
+                # auto-selected production lowering when sliding ==
+                # kernel — see FusedNet.__init__)
+                if spec.mode == "avg":
+                    y = pool_ops.avg_pooling_reshape_jax(
+                        y, spec.ky, spec.kx)
+                else:
+                    y = pool_ops.max_pooling_reshape_jax(
+                        y, spec.ky, spec.kx, spec.mode == "maxabs")
             elif spec.mode != "avg" and spec.impl == "offsets":
                 # production path: custom-VJP op — Pallas/window-view
                 # forward with recorded winners, dense accumulation
@@ -899,13 +916,28 @@ class FusedNet:
 
     def __init__(self, layers, input_sample_shape, mesh=None, rand=None,
                  dtype=numpy.float32, defaults=None, dropout_seed=0,
-                 compute_dtype=None, pool_impl="reduce_window",
+                 compute_dtype=None, pool_impl=None,
                  objective="softmax"):
         self.specs = build_specs(layers, input_sample_shape, defaults)
         for spec in self.specs:
             if spec.kind == "pool" and \
                     not getattr(spec, "record_offsets", False):
-                spec.impl = pool_impl
+                nonoverlap = tuple(spec.sliding) == (spec.kx, spec.ky)
+                if pool_impl is None:
+                    # production auto-select: the strided-slice lowering
+                    # when windows are disjoint (elementwise VJP — see
+                    # ops/pooling.py reshape section), reduce_window for
+                    # overlapping windows; stochastic modes ignore impl
+                    spec.impl = ("reshape" if nonoverlap
+                                 and spec.mode in ("max", "maxabs", "avg")
+                                 else "reduce_window")
+                else:
+                    if pool_impl == "reshape" and not nonoverlap:
+                        raise ValueError(
+                            "pool_impl='reshape' needs sliding == kernel "
+                            "(got %r vs (%d, %d))"
+                            % (spec.sliding, spec.kx, spec.ky))
+                    spec.impl = pool_impl
             if spec.kind == "pool":
                 # the Pallas forward is single-device; under a mesh the
                 # offsets impl keeps the window-view forward (GSPMD

@@ -115,11 +115,12 @@ class FusedForwardBackward(Unit):
         self.compute_dtype = kwargs.get("compute_dtype")
         self.defaults = kwargs.get("defaults")
         self.dropout_seed = kwargs.get("dropout_seed", 0)
-        #: max-pool lowering: "reduce_window" (select-and-scatter VJP —
-        #: fastest measured at bench batch sizes), "offsets" (custom
+        #: max-pool lowering: None (auto: "reshape" strided-slice path
+        #: for non-overlapping windows, "reduce_window" otherwise),
+        #: "reduce_window" (select-and-scatter VJP), "offsets" (custom
         #: VJP, first-winner ties) or "gather" (unit-path summation-
         #: order parity) — see fused.PoolSpec.impl
-        self.pool_impl = kwargs.get("pool_impl", "reduce_window")
+        self.pool_impl = kwargs.get("pool_impl")
         self.rand = kwargs.get("rand", prng.get())
         self.output = Array(name="output")
         self.max_idx = Array(name="max_idx")
