@@ -169,10 +169,11 @@ def main(profile_dir=None):
         return round(100.0 * eff / peak, 2) if peak else None
 
     # primary: MNIST conv flagship, bf16 GEMMs + f32 master weights,
-    # through the workflow control plane (window=20)
+    # through the workflow control plane
+    flagship_steps = 40
     ips, windows, fpi, batch = _try_measure(
         ge.FLAGSHIP_LAYERS, "mnist_loader", (16384, 8192), jnp.bfloat16,
-        profile_dir=profile_dir)
+        n_steps=flagship_steps, profile_dir=profile_dir)
     # secondary reference point; never let its failure kill the primary
     # metric (f32 needs ~2x the bf16 run's memory on the same batch)
     try:
@@ -211,7 +212,8 @@ def main(profile_dir=None):
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
         "batch": batch,
-        "loop": "workflow-control-plane (scan window=40, device dataset)",
+        "loop": "workflow-control-plane (scan window=%d, device dataset, "
+                "epoch-materialized perm)" % flagship_steps,
         "window_ips": [round(w, 1) for w in windows],
         "window_spread_pct": _spread_pct(windows),
         "train_tflops_effective": round(eff / 1e12, 2),
@@ -236,5 +238,10 @@ def main(profile_dir=None):
 
 if __name__ == "__main__":
     import sys
-    main(profile_dir=sys.argv[sys.argv.index("--profile") + 1]
-         if "--profile" in sys.argv else None)
+    profile_dir = None
+    if "--profile" in sys.argv:
+        index = sys.argv.index("--profile")
+        if index + 1 >= len(sys.argv):
+            sys.exit("usage: bench.py [--profile TRACE_DIR]")
+        profile_dir = sys.argv[index + 1]
+    main(profile_dir=profile_dir)

@@ -175,3 +175,35 @@ def test_window_stats_replace_evaluator_compute(tmp_path, float64_engine):
     assert wf.fused_trainer.window_stats is None
     assert wf.decision.epoch_n_err[2] is not None  # TRAIN
     assert wf.decision.epoch_n_err[1] is not None  # VALID
+
+
+def test_window_sliced_equals_indexed_gather(tmp_path, float64_engine):
+    """The production sliced data path (per-epoch on-device permutation
+    + contiguous dynamic slices) equals the per-row gather window
+    exactly — float64, multi-epoch (the reshuffle rematerializes), with
+    a padded tail minibatch in every epoch."""
+    wf_s = _mnist(tmp_path, {"pool_impl": "gather", "window": 4})
+    wf_i = _mnist(tmp_path, {"pool_impl": "gather", "window": 4,
+                             "device_perm": False})
+    assert wf_s.fused_trainer._use_sliced
+    assert wf_i.fused_trainer._use_device_data
+    assert not wf_i.fused_trainer._use_sliced
+    _assert_same_trajectory(wf_s, wf_i)
+
+
+def test_window_sliced_no_valid_segment_epoch_boundary(tmp_path,
+                                                       float64_engine):
+    """With NO validation split, TRAIN is the epoch's last served
+    segment and the loader reshuffles IN PLACE while serving the
+    epoch-final minibatch — i.e. mid window-collection.  The sliced
+    path must train that window on the order its starts were collected
+    against (the code-review repro: rematerializing at flush time
+    trained the tail window of every epoch on next-epoch rows)."""
+    wf_s = _mnist(tmp_path, {"pool_impl": "gather", "window": 4},
+                  max_epochs=3, valid=0)
+    wf_i = _mnist(tmp_path, {"pool_impl": "gather", "window": 4,
+                             "device_perm": False},
+                  max_epochs=3, valid=0)
+    assert wf_s.fused_trainer._use_sliced
+    assert not wf_i.fused_trainer._use_sliced
+    _assert_same_trajectory(wf_s, wf_i)

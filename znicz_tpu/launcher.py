@@ -132,10 +132,21 @@ class Launcher(Logger):
                 if attr == "fused_state" and isinstance(value, dict) and \
                         getattr(u, "net", None) is not None:
                     cur_sd = u.fused_state
+                    snap_params = list(value.get("params", ()))
+                    # zip would truncate: a different topology with
+                    # fewer/more layers whose leading shapes agree must
+                    # still be rejected (ADVICE r4 medium)
+                    if len(snap_params) != len(cur_sd["params"]):
+                        return ("fused layer count %d != %d"
+                                % (len(snap_params),
+                                   len(cur_sd["params"])))
                     for p_cur, p_new in zip(cur_sd["params"],
-                                            value.get("params", ())):
+                                            snap_params):
+                        if set(p_cur) != set(p_new):
+                            return ("fused param keys %s != %s"
+                                    % (sorted(p_new), sorted(p_cur)))
                         for k in p_cur:
-                            if k in p_new and numpy.shape(p_cur[k]) != \
+                            if numpy.shape(p_cur[k]) != \
                                     numpy.shape(p_new[k]):
                                 return ("fused param shape %s != %s"
                                         % (numpy.shape(p_new[k]),

@@ -87,6 +87,12 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.testing = kwargs.get("testing", False)
 
         self.class_lengths = [0, 0, 0]
+        #: CONTRACT under skip_fill: on TRAIN minibatches in windowed
+        #: fused mode the host fill is skipped, so minibatch_data /
+        #: minibatch_labels (and minibatch_targets) retain the PREVIOUS
+        #: fill's contents — only minibatch_indices / size / class /
+        #: offsets are valid; units reading data or labels on TRAIN
+        #: must link through the fused trainer's window stats instead
         self.minibatch_data = Array(name="minibatch_data")
         self.minibatch_labels = Array(name="minibatch_labels")
         self.minibatch_indices = Array(name="minibatch_indices")
@@ -103,6 +109,15 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         #: skipped for them (minibatch_indices/labels flags still serve;
         #: VALID/TEST minibatches always fill)
         self.skip_fill = False
+        #: bumped every time the TRAIN order actually reshuffles — the
+        #: fused trainer's device-resident permuted dataset is
+        #: rematerialized when this changes (per-epoch, not per-window)
+        self.shuffle_serial = 0
+        #: this minibatch's start offset WITHIN its class segment — for
+        #: TRAIN, the row range [offset, offset+size) of the epoch's
+        #: shuffled order (minibatches are contiguous slices of
+        #: ``_indices[clazz]`` by construction, see run())
+        self.minibatch_class_offset = 0
         self._indices = {}       # class -> index array into the dataset
         self._segment = 0        # position in the serving order
         self._offset_in_class = 0
@@ -110,7 +125,7 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         #: snapshotted iteration state — with the PRNG states this makes
         #: resume-retrain exact (epoch position + the shuffled order)
         self.exports = ["epoch_number", "_segment", "_offset_in_class",
-                        "_global_offset", "_indices"]
+                        "_global_offset", "_indices", "shuffle_serial"]
         self.normalizer = None
         self._labels_mapping = {}
 
@@ -216,9 +231,17 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             self.class_lengths[VALID], self.class_lengths[TRAIN],
             self.max_minibatch_size)
 
+    @property
+    def train_indices(self):
+        """The epoch's shuffled TRAIN order (global dataset indices) —
+        the permutation the fused sliced-window path materializes on
+        device once per :attr:`shuffle_serial` change."""
+        return self._indices[TRAIN]
+
     def _shuffle(self):
         if self.epoch_number < self.shuffle_limit:
             self.prng.shuffle(self._indices[TRAIN])
+            self.shuffle_serial += 1
 
     def run(self):
         order = self._serve_order()
@@ -230,6 +253,7 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
 
         self.minibatch_class = clazz
         self.minibatch_size = int(n)
+        self.minibatch_class_offset = int(off)
         self._global_offset += n
         self.minibatch_offset = self._global_offset
 
@@ -280,7 +304,11 @@ class FullBatchLoader(Loader):
         super(FullBatchLoader, self).__init__(workflow, **kwargs)
         self.original_data = Array(name="original_data")
         self._original_labels = []
-        self._labels_array = None  # cached numpy view of the label list
+        #: cached numpy copy of the label list, rebuilt in initialize
+        #: (after load_data) and when the list LENGTH changes; a loader
+        #: that relabels IN PLACE mid-run with the same length must
+        #: clear this cache itself
+        self._labels_array = None
         self.force_numpy = kwargs.get("force_numpy", False)
 
     @property
@@ -302,6 +330,10 @@ class FullBatchLoader(Loader):
             (self.max_minibatch_size,) + tuple(sample_shape), dtype=dtype))
 
     def initialize(self, device=None, **kwargs):
+        # load_data just (re)filled the labels — drop any stale cache
+        # (re-initialize after an in-place relabel must not serve the
+        # old values, ADVICE r4)
+        self._labels_array = None
         super(FullBatchLoader, self).initialize(device=device, **kwargs)
         self._apply_normalization()
 

@@ -147,6 +147,12 @@ class FusedForwardBackward(Unit):
         #: host-stacked path; True fails loudly if the loader does not
         #: qualify
         self.device_data = kwargs.get("device_data", "auto")
+        #: "auto" additionally materializes the shuffled dataset on
+        #: device once per epoch and feeds windows by contiguous
+        #: dynamic slices (the fastest data path — no per-row gather);
+        #: False forces the per-row gather window; True fails loudly
+        #: if the loader's slice contract does not hold
+        self.device_perm = kwargs.get("device_perm", "auto")
         #: the loader unit driven directly during window collection
         #: (wired by StandardWorkflow.link_fused_trainer)
         self.loader_unit = None
@@ -280,8 +286,21 @@ class FusedForwardBackward(Unit):
                 and lu.original_data
                 and len(lu.original_labels) > 0)
 
+    def _loader_serves_contiguous_slices(self):
+        """The sliced fast path additionally needs the STOCK minibatch
+        walk (run) and reshuffle (_shuffle): TRAIN minibatch at class
+        offset ``o`` must be rows ``train_indices[o:o+n]`` and the order
+        must only change when ``shuffle_serial`` bumps.  Overriding
+        loaders fall back to the per-row gather window."""
+        from znicz_tpu.loader.base import Loader
+        lu = self.loader_unit
+        return (type(lu).run is Loader.run
+                and type(lu)._shuffle is Loader._shuffle)
+
     def _setup_device_data(self):
         self._use_device_data = False
+        self._use_sliced = False
+        self._mat_serial = None
         qualifies = (self.device_data in ("auto", True)
                      and self.loss == "softmax"
                      and self.loader_unit is not None
@@ -295,14 +314,30 @@ class FusedForwardBackward(Unit):
             self.window = 8 if qualifies else 1
         if qualifies and self.window > 1:
             self._use_device_data = True
-            # TRAIN minibatches are consumed as device gathers; the
-            # loader skips its host fill for them (VALID/TEST still
-            # fill — they run per-minibatch through predict)
+            # TRAIN minibatches are consumed on device; the loader
+            # skips its host fill for them (VALID/TEST still fill —
+            # they run per-minibatch through predict).  The production
+            # variant is "sliced": the permuted dataset materializes on
+            # device once per reshuffle and windows read contiguous
+            # dynamic slices; loaders with overridden run/_shuffle keep
+            # the per-row gather window (device_perm=False forces it)
             self.loader_unit.skip_fill = True
+            self._use_sliced = (
+                self.device_perm in ("auto", True)
+                and self._loader_serves_contiguous_slices())
         elif self.device_data is True and not qualifies:
             raise ValueError(
                 "fused device_data=True needs a stock FullBatchLoader "
                 "(no fill_minibatch override) with labels")
+        if self.device_perm is True and not self._use_sliced:
+            # loudly, wherever the sliced path failed to engage — a
+            # non-qualifying loader, an overridden run/_shuffle, or no
+            # windowed device-data path at all (window=1 / device_data
+            # off / MSE objective)
+            raise ValueError(
+                "fused device_perm=True needs the windowed device-data "
+                "path and the stock Loader run/_shuffle "
+                "(contiguous-slice contract)")
 
     def _run_train_window(self):
         """Collect up to ``window`` TRAIN minibatches (driving the loader
@@ -313,10 +348,28 @@ class FusedForwardBackward(Unit):
         and decision semantics are untouched (reference decision.py only
         consumes segment aggregates + end-of-segment output)."""
         loader = self.loader_unit
+        if self._use_device_data and not self.net.has_dataset:
+            data = numpy.asarray(loader.original_data.mem,
+                                 dtype=self.input.dtype)
+            self.net.set_dataset(data, loader.original_labels)
+        if self._use_device_data and self._use_sliced:
+            # materialize BEFORE driving the loader: when TRAIN is the
+            # epoch's last served segment (no VALID split), the loader
+            # reshuffles IN PLACE while serving the epoch-final
+            # minibatch — i.e. mid collection — so the order the
+            # collected starts index into is the one current NOW, not
+            # the one after the window is collected
+            if self._mat_serial != loader.shuffle_serial:
+                self.net.set_epoch_perm(
+                    numpy.asarray(loader.train_indices),
+                    pad=int(loader.max_minibatch_size))
+                self._mat_serial = loader.shuffle_serial
         idx_steps, x_steps, lbl_steps = [], [], []
-        sizes, hyper_steps = [], []
+        starts, sizes, hyper_steps = [], [], []
         while True:
-            if self._use_device_data:
+            if self._use_device_data and self._use_sliced:
+                starts.append(int(loader.minibatch_class_offset))
+            elif self._use_device_data:
                 idx_steps.append(
                     numpy.array(loader.minibatch_indices.mem,
                                 dtype=numpy.int32))
@@ -344,13 +397,12 @@ class FusedForwardBackward(Unit):
             lambda *leaves: numpy.asarray(leaves, dtype=self.net.dtype),
             *hyper_steps)
         if self._use_device_data:
-            if not self.net.has_dataset:
-                lu = loader
-                data = numpy.asarray(lu.original_data.mem,
-                                     dtype=self.input.dtype)
-                self.net.set_dataset(data, lu.original_labels)
-            stats = self.net.run_window_indexed(
-                numpy.stack(idx_steps), sizes, hypers_s)
+            if self._use_sliced:
+                stats = self.net.run_window_sliced(
+                    starts, int(self.input.shape[0]), sizes, hypers_s)
+            else:
+                stats = self.net.run_window_indexed(
+                    numpy.stack(idx_steps), sizes, hypers_s)
         else:
             stats = self.net.run_window(
                 numpy.stack(x_steps), numpy.stack(lbl_steps), sizes,
