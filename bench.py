@@ -75,7 +75,7 @@ def _peak_flops(device_kind):
 
 
 def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
-             n_epochs=5, profile_dir=None):
+             n_epochs=5, profile_dir=None, fused_extra=None):
     """Steady-state throughput of the SHIPPED fused training loop.
 
     Builds a StandardWorkflow (synthetic full-batch dataset of
@@ -106,7 +106,8 @@ def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
                          "fail_iterations": 10 ** 9},
         snapshotter_config={"interval": 10 ** 9, "time_interval": 1e9,
                             "compression": ""},
-        fused={"window": n_steps, "compute_dtype": compute_dtype})
+        fused=dict({"window": n_steps, "compute_dtype": compute_dtype},
+                   **(fused_extra or {})))
     wf.initialize(device=JaxDevice())
     assert wf.fused_trainer._use_device_data, \
         "bench requires the device-resident dataset path"
@@ -213,7 +214,7 @@ def main(profile_dir=None):
         "vs_baseline": round(vs, 3),
         "batch": batch,
         "loop": "workflow-control-plane (scan window=%d, device dataset, "
-                "epoch-materialized perm)" % flagship_steps,
+                "in-scan indexed gather)" % flagship_steps,
         "window_ips": [round(w, 1) for w in windows],
         "window_spread_pct": _spread_pct(windows),
         "train_tflops_effective": round(eff / 1e12, 2),

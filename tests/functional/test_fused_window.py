@@ -182,7 +182,8 @@ def test_window_sliced_equals_indexed_gather(tmp_path, float64_engine):
     + contiguous dynamic slices) equals the per-row gather window
     exactly — float64, multi-epoch (the reshuffle rematerializes), with
     a padded tail minibatch in every epoch."""
-    wf_s = _mnist(tmp_path, {"pool_impl": "gather", "window": 4})
+    wf_s = _mnist(tmp_path, {"pool_impl": "gather", "window": 4,
+                             "device_perm": True})
     wf_i = _mnist(tmp_path, {"pool_impl": "gather", "window": 4,
                              "device_perm": False})
     assert wf_s.fused_trainer._use_sliced
@@ -199,7 +200,8 @@ def test_window_sliced_no_valid_segment_epoch_boundary(tmp_path,
     path must train that window on the order its starts were collected
     against (the code-review repro: rematerializing at flush time
     trained the tail window of every epoch on next-epoch rows)."""
-    wf_s = _mnist(tmp_path, {"pool_impl": "gather", "window": 4},
+    wf_s = _mnist(tmp_path, {"pool_impl": "gather", "window": 4,
+                             "device_perm": True},
                   max_epochs=3, valid=0)
     wf_i = _mnist(tmp_path, {"pool_impl": "gather", "window": 4,
                              "device_perm": False},
@@ -207,3 +209,59 @@ def test_window_sliced_no_valid_segment_epoch_boundary(tmp_path,
     assert wf_s.fused_trainer._use_sliced
     assert not wf_i.fused_trainer._use_sliced
     _assert_same_trajectory(wf_s, wf_i)
+
+
+def _approximator(tmp_path, fused_cfg, max_epochs=3):
+    from znicz_tpu.samples import approximator
+    _seed()
+    wf = approximator.build(
+        loader_config={"minibatch_size": 64},
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": 100},
+        snapshotter_config={"prefix": "fwm", "interval": 100,
+                            "time_interval": 1e9, "compression": "",
+                            "directory": str(tmp_path)},
+        fused=dict(fused_cfg))
+    wf.initialize(device=JaxDevice())
+    wf.run()
+    return wf
+
+
+def _assert_same_mse_trajectory(wf_a, wf_b, tol=1e-12):
+    ma, mb = wf_a.decision.epoch_metrics, wf_b.decision.epoch_metrics
+    for ca, cb in zip(ma, mb):
+        if ca is None or cb is None:
+            assert ca is None and cb is None
+            continue
+        for a, b in zip(ca, cb):
+            assert abs(a - b) < tol, (ma, mb)
+    pa, pb = _params(wf_a), _params(wf_b)
+    assert set(pa) == set(pb)
+    for i in pa:
+        for k in pa[i]:
+            diff = numpy.abs(pa[i][k] - pb[i][k]).max()
+            assert diff < tol, "layer %d %s diff %g" % (i, k, diff)
+
+
+def test_mse_window8_equals_window1(tmp_path, float64_engine):
+    """The windowed MSE fast path (VERDICT r4 missing #2): float64
+    window=8 (sliced device data, in-scan [sum,max,min] metrics) ==
+    window=1 (per-minibatch step_mse + host evaluator) — epoch metrics
+    and parameters, across epochs with reshuffles and a padded tail
+    minibatch (800 train / 64 -> 13 minibatches, 32-sample tail)."""
+    wf_w = _approximator(tmp_path, {"window": 8})
+    wf_1 = _approximator(tmp_path, {"window": 1})
+    assert wf_w.fused_trainer.window == 8
+    assert wf_w.fused_trainer._use_device_data
+    assert wf_w.fused_trainer._use_sliced
+    _assert_same_mse_trajectory(wf_w, wf_1)
+
+
+def test_mse_window_host_stacked_equals_sliced(tmp_path, float64_engine):
+    """The host-stacked MSE window (non-qualifying loaders' fallback)
+    equals the sliced device path exactly."""
+    wf_h = _approximator(tmp_path, {"window": 4, "device_data": False})
+    wf_s = _approximator(tmp_path, {"window": 4})
+    assert not wf_h.fused_trainer._use_device_data
+    assert wf_s.fused_trainer._use_sliced
+    _assert_same_mse_trajectory(wf_h, wf_s)

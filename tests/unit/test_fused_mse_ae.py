@@ -316,3 +316,50 @@ def test_fused_stochastic_distribution_matches_unit_op():
             x, u16[i:i + 1], 2, 2, (2, 2))
         counts_u[int(round(float(val.ravel()[0]))) - 1] += 1
     assert numpy.abs(counts_u / draws - expect).max() < 0.04
+
+
+def test_fused_ae_windowed_equals_per_step_float64():
+    """The windowed MSE scan (run_window_mse — K steps, one compiled
+    dispatch, in-scan metrics) reproduces K per-minibatch step_mse
+    calls exactly on the AE stage, params AND evaluator metrics
+    (mse_jax semantics; VERDICT r4 missing #2)."""
+    import jax
+    from znicz_tpu.ops import evaluator as ev_ops
+
+    r = numpy.random.RandomState(5)
+    K, B = 4, 4
+    xs = r.uniform(-1, 1, (K, B, 12, 12, 1)).astype(numpy.float64)
+
+    def make_net():
+        return FusedNet(AE_LAYERS, (12, 12, 1),
+                        rand=prng.RandomGenerator().seed(99),
+                        dtype=numpy.float64, objective="mse")
+
+    net_1 = make_net()
+    md_acc = numpy.zeros(3)
+    md_acc[2] = numpy.inf
+    for k in range(K):
+        m = net_1.step_mse(xs[k], xs[k], B)
+        _, md, mse_per = ev_ops.mse_jax(
+            jnp.asarray(numpy.asarray(m["output"])), jnp.asarray(
+                xs[k].reshape(B, -1)), B, mean=True, root=True)
+        md = numpy.asarray(md)
+        md_acc[0] += md[0]
+        md_acc[1] = max(md_acc[1], md[1])
+        md_acc[2] = min(md_acc[2], md[2])
+
+    net_w = make_net()
+    hy = jax.tree.map(
+        lambda *leaves: numpy.asarray(leaves, numpy.float64),
+        *[net_w.hypers] * K)
+    lbl_s = numpy.full((K, B), -1, numpy.int32)
+    stats = net_w.run_window_mse(xs, xs, lbl_s, [B] * K, hy)
+
+    pa, pb = net_1.host_params(), net_w.host_params()
+    for a, b in zip(pa, pb):
+        for key in a:
+            diff = numpy.abs(a[key] - b[key]).max()
+            assert diff < 1e-12, (key, diff)
+    md_w = numpy.asarray(stats["metrics"])
+    assert numpy.abs(md_w - md_acc).max() < 1e-12, (md_w, md_acc)
+    assert numpy.asarray(stats["mse_per"]).shape == (B,)

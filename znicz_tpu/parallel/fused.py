@@ -924,13 +924,13 @@ class FusedNet:
                     not getattr(spec, "record_offsets", False):
                 nonoverlap = tuple(spec.sliding) == (spec.kx, spec.ky)
                 if pool_impl is None:
-                    # production auto-select: the strided-slice lowering
-                    # when windows are disjoint (elementwise VJP — see
-                    # ops/pooling.py reshape section), reduce_window for
-                    # overlapping windows; stochastic modes ignore impl
-                    spec.impl = ("reshape" if nonoverlap
-                                 and spec.mode in ("max", "maxabs", "avg")
-                                 else "reduce_window")
+                    # production default: reduce_window — measured
+                    # FASTEST on a real v5e (r5 microbench: pool1 f+b
+                    # 10.3ms vs 30.8ms "reshape" / 73.8ms "offsets";
+                    # TPU sublane-strided slices force relayout copies,
+                    # so the elementwise-VJP lowerings lose despite
+                    # their lower op count — see BENCH_NOTES.md)
+                    spec.impl = "reduce_window"
                 else:
                     if pool_impl == "reshape" and not nonoverlap:
                         raise ValueError(
@@ -961,7 +961,14 @@ class FusedNet:
         #: (set_epoch_perm) — consumed by contiguous dynamic slices
         self._data_p = None
         self._labels_p = None
+        self._targets_d = None
+        self._targets_p = None
         self._perm_fns = {}
+        #: MSE extras mirrored from the evaluator by the trainer unit
+        #: BEFORE the first window: per-sample sqrt (EvaluatorMSE.root)
+        #: and the optional nearest-class-target matrix
+        self.mse_root = True
+        self.class_targets = None
         if objective == "softmax":
             if not self.specs[-1].is_softmax:
                 raise ValueError(
@@ -1203,7 +1210,7 @@ class FusedNet:
         return metrics
 
     # -- windowed training (the control plane's hot loop) -------------------
-    def set_dataset(self, data, labels):
+    def set_dataset(self, data, labels, targets=None):
         """Place the WHOLE training dataset on device once (replicated
         over the mesh).  Windowed train steps then gather their
         minibatches on device from ``(window, batch)`` index arrays — the
@@ -1222,8 +1229,23 @@ class FusedNet:
             data = jnp.asarray(data).astype(self.compute_dtype)
         rep = None if self.mesh is None else NamedSharding(self.mesh, P())
         self._data_d = jax.device_put(data, rep)
+        if labels is None or not len(labels):
+            # MSE datasets may carry no labels; the padded sentinel
+            # keeps every label-consuming path inert
+            labels = numpy.full(len(data), -1, numpy.int32)
         self._labels_d = jax.device_put(
             numpy.asarray(labels, dtype=numpy.int32), rep)
+        self._targets_d = None
+        if targets is not None:
+            # targets keep float32 (not the bf16 compute dtype): the
+            # MSE loss/stats math is float32 even in bf16 mode and the
+            # per-minibatch path feeds it unrounded targets — storing
+            # bf16 would change the loss, unlike the data rows where
+            # the forward's cast commutes with the gather
+            targets = numpy.ascontiguousarray(targets)
+            if self.compute_dtype is not None:
+                targets = numpy.asarray(targets, dtype=numpy.float32)
+            self._targets_d = jax.device_put(targets, rep)
 
     @property
     def has_dataset(self):
@@ -1246,29 +1268,35 @@ class FusedNet:
         run())."""
         if not self.has_dataset:
             raise RuntimeError("set_dataset() before set_epoch_perm")
-        key_ = (int(len(perm)), int(pad))
+        has_targets = self._targets_d is not None
+        key_ = (int(len(perm)), int(pad), has_targets)
         fn = self._perm_fns.get(key_)
         if fn is None:
-            def materialize(data, labels, p):
-                dp = jnp.take(data, p, axis=0)
-                lp = jnp.take(labels, p, axis=0)
-                dp = jnp.concatenate(
-                    [dp, jnp.zeros((pad,) + dp.shape[1:], dp.dtype)])
-                lp = jnp.concatenate(
-                    [lp, jnp.full((pad,), -1, lp.dtype)])
-                return dp, lp
+            def _mat_one(arr, p, fill):
+                ap = jnp.take(arr, p, axis=0)
+                tail = jnp.full((pad,) + ap.shape[1:], fill, ap.dtype)
+                return jnp.concatenate([ap, tail])
+
+            def materialize(data, labels, targets, p):
+                out = (_mat_one(data, p, 0), _mat_one(labels, p, -1),
+                       _mat_one(targets, p, 0) if has_targets else 0)
+                return out
 
             if self.mesh is not None:
                 rep = NamedSharding(self.mesh, P())
-                fn = jax.jit(materialize, out_shardings=(rep, rep))
+                fn = jax.jit(materialize,
+                             out_shardings=(rep, rep,
+                                            rep if has_targets else None))
             else:
                 fn = jax.jit(materialize)
             self._perm_fns[key_] = fn
         rep = None if self.mesh is None else NamedSharding(self.mesh, P())
         perm_d = jax.device_put(
             numpy.asarray(perm, dtype=numpy.int32), rep)
-        self._data_p, self._labels_p = fn(
-            self._data_d, self._labels_d, perm_d)
+        self._data_p, self._labels_p, tp = fn(
+            self._data_d, self._labels_d,
+            self._targets_d if has_targets else 0, perm_d)
+        self._targets_p = tp if has_targets else None
 
     @property
     def has_epoch_perm(self):
@@ -1444,6 +1472,165 @@ class FusedNet:
         self.params, self.state, self._key, stats = fn(
             self.params, self.state, self._key, self._data_p,
             self._labels_p, starts, None, bs, hypers_s)
+        return stats
+
+    # -- windowed MSE (the AE/regression hot loop) --------------------------
+    def _get_window_fn_mse(self, n_steps, mode, batch=None):
+        """K-step MSE scan window (reference evaluator contract:
+        /root/reference/evaluator.py:334-556).  Carry aggregates the
+        evaluator-identical metrics ([sum, max, min] of per-sample mse,
+        ops/evaluator.mse_jax semantics, with ``mse_root`` mirrored
+        from EvaluatorMSE.root) and — when ``class_targets`` is set —
+        the nearest-class-target n_err integers.  The LAST step's
+        output and per-sample mse come back for the downstream units.
+
+        ``mode``: "stacked" or "sliced" (MSE has no indexed-gather
+        variant; non-contiguous loaders use the host-stacked window)."""
+        ct = self.class_targets
+        key_ = ("mse", int(n_steps), mode, batch, ct is not None)
+        fn = self._window_fns.get(key_)
+        if fn is not None:
+            return fn
+        specs = tuple(self.specs)
+        cd = self.compute_dtype
+        needs_key = self._needs_key
+        root = bool(self.mse_root)
+        mean = bool(self.stats_mean)
+        out_dtype = jnp.float32 if cd is not None else self.dtype
+        ct_c = None if ct is None else jnp.asarray(ct, out_dtype)
+        out_shape = tuple(self.specs[-1].out_shape)
+
+        def _stats(out, target, lbl, bs):
+            """Evaluator-identical per-minibatch MSE stats — THE
+            evaluator op itself runs inside the scan (its err output is
+            unused and dead-code-eliminated under jit), so the windowed
+            parity has one source of truth — plus the optional
+            nearest-class-target error (the evaluator's host loop:
+            squared distance summed over the sample axis, argmin vs
+            label)."""
+            from znicz_tpu.ops import evaluator as ev_ops
+            out = out.astype(out_dtype)
+            B = out.shape[0]
+            o2 = out.reshape(B, -1)
+            t2 = target.reshape(B, -1).astype(out_dtype)
+            _, md, mse_per = ev_ops.mse_jax(o2, t2, bs, mean=mean,
+                                            root=root)
+            if ct_c is None:
+                nerr_d = jnp.zeros((2,), jnp.int32)
+            else:
+                in_batch = jnp.arange(B) < bs
+                d = ((ct_c[None, :, :] - o2[:, None, :]) ** 2).sum(-1)
+                pred = jnp.argmin(d, axis=1).astype(jnp.int32)
+                n_ok = (in_batch & (pred == lbl)).sum()
+                nerr_d = jnp.stack([bs - n_ok, bs]).astype(jnp.int32)
+            return md, mse_per, nerr_d, out
+
+        def body(carry, step):
+            p, s, k, _, _, msum, mmax, mmin, nerr = carry
+            if mode == "sliced":
+                data, tgt_all, lbl_all, start, bs, hy = step
+                x = jax.lax.dynamic_slice_in_dim(data, start, batch,
+                                                 axis=0)
+                t = jax.lax.dynamic_slice_in_dim(tgt_all, start, batch,
+                                                 axis=0)
+                lbl = jax.lax.dynamic_slice_in_dim(lbl_all, start, batch)
+                lbl = jnp.where(jnp.arange(batch) < bs, lbl,
+                                jnp.int32(-1))
+            else:
+                x, t, lbl, bs, hy = step
+            if needs_key:
+                k, sub = jax.random.split(k)
+            else:
+                sub = k
+            p, s, m = _train_step_mse(p, s, x, t, bs, specs, sub, cd, hy)
+            md, mse_per, nerr_d, out = _stats(m["output"], t, lbl, bs)
+            carry = (p, s, k, out, mse_per,
+                     msum + md[0], jnp.maximum(mmax, md[1]),
+                     jnp.minimum(mmin, md[2]), nerr + nerr_d)
+            return carry, m["loss"]
+
+        def window_fn(p, s, k, data, tgt_all, lbl_all, xs, ts, ls,
+                      bs_s, hy_s):
+            b = batch if mode == "sliced" else xs.shape[1]
+            out0 = jnp.zeros((b,) + out_shape, dtype=out_dtype)
+            mse0 = jnp.zeros((b,), dtype=out_dtype)
+            msum0 = jnp.zeros((), dtype=out_dtype)
+            mmax0 = jnp.zeros((), dtype=out_dtype)
+            mmin0 = jnp.full((), jnp.inf, dtype=out_dtype)
+            nerr0 = jnp.zeros((2,), dtype=jnp.int32)
+            if mode == "sliced":
+                def scan_body(carry, step):
+                    start, bs, hy = step
+                    return body(carry, (data, tgt_all, lbl_all, start,
+                                        bs, hy))
+                xs_scan = (xs, bs_s, hy_s)
+            else:
+                xs_scan = (xs, ts, ls, bs_s, hy_s)
+                scan_body = body
+            carry0 = (p, s, k, out0, mse0, msum0, mmax0, mmin0, nerr0)
+            (p, s, k, out, mse_per, msum, mmax, mmin, nerr), losses = \
+                jax.lax.scan(scan_body, carry0, xs_scan)
+            stats = {"loss": losses,
+                     "metrics": jnp.stack([msum, mmax, mmin]),
+                     "mse_per": mse_per, "n_err": nerr, "output": out}
+            return p, s, k, stats
+
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            oshard = NamedSharding(
+                self.mesh, P("data", *([None] * len(out_shape))))
+            mshard = {"loss": rep, "metrics": rep, "n_err": rep,
+                      "mse_per": NamedSharding(self.mesh, P("data")),
+                      "output": oshard}
+            fn = jax.jit(window_fn, donate_argnums=(0, 1),
+                         out_shardings=(self._pshard, self._sshard, rep,
+                                        mshard))
+        else:
+            fn = jax.jit(window_fn, donate_argnums=(0, 1))
+        self._window_fns[key_] = fn
+        return fn
+
+    def run_window_mse(self, xs, ts, lbl_s, batch_sizes, hypers_s):
+        """K MSE train steps in ONE compiled dispatch over host-stacked
+        minibatches ``xs (K, B, *sample)`` / ``ts (K, B, *target)``;
+        ``lbl_s (K, B)`` feeds the nearest-class-target error when
+        ``class_targets`` is set (pass -1s otherwise)."""
+        if self.objective != "mse":
+            raise ValueError("run_window_mse needs the mse objective")
+        self._check_window_batch(xs.shape[1])
+        n_steps = xs.shape[0]
+        fn = self._get_window_fn_mse(n_steps, "stacked")
+        xs = self._place_window(numpy.ascontiguousarray(xs), xs.ndim - 2)
+        ts = self._place_window(numpy.ascontiguousarray(ts), ts.ndim - 2)
+        lbl_s = self._place_window(
+            numpy.asarray(lbl_s, dtype=numpy.int32), 0)
+        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        self.params, self.state, self._key, stats = fn(
+            self.params, self.state, self._key, 0, 0, 0, xs, ts, lbl_s,
+            bs, hypers_s)
+        return stats
+
+    def run_window_mse_sliced(self, starts, batch, batch_sizes, hypers_s):
+        """Windowed MSE training over the epoch-materialized dataset —
+        the sliced production path (see :meth:`run_window_sliced`);
+        needs targets passed to :meth:`set_dataset`."""
+        if self.objective != "mse":
+            raise ValueError("run_window_mse_sliced needs the mse "
+                             "objective")
+        if not self.has_epoch_perm or self._targets_p is None:
+            raise RuntimeError("set_epoch_perm() with targets before "
+                               "run_window_mse_sliced")
+        self._check_window_batch(batch)
+        n_steps = len(starts)
+        fn = self._get_window_fn_mse(n_steps, "sliced", int(batch))
+        rep = None if self.mesh is None else NamedSharding(self.mesh, P())
+        starts = jax.device_put(
+            numpy.asarray(starts, dtype=numpy.int32), rep)
+        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        self.params, self.state, self._key, stats = fn(
+            self.params, self.state, self._key, self._data_p,
+            self._targets_p, self._labels_p, starts, None, None, bs,
+            hypers_s)
         return stats
 
     def params_finite(self):

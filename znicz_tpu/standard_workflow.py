@@ -192,6 +192,13 @@ class StandardWorkflow(StandardWorkflowBase):
             if hasattr(self.loader, "class_targets"):
                 self.evaluator.link_attrs(self.loader, "class_targets",
                                           ("labels", "minibatch_labels"))
+            if self.fused_trainer is not None:
+                # windowed MSE TRAIN dispatches hand the evaluator
+                # their in-scan [sum,max,min] metrics (+ class-target
+                # n_err); mirror the evaluator's flags into the scan
+                self.evaluator.stats_source = self.fused_trainer
+                self.fused_trainer.stats_mean = self.evaluator.mean
+                self.fused_trainer.stats_root = self.evaluator.root
         return self.evaluator
 
     # -- decision (reference 451-490) ---------------------------------------

@@ -198,6 +198,10 @@ class EvaluatorMSE(EvaluatorBase):
         self.squared_mse = kwargs.get("squared_mse", False)
         self.class_targets = None
         self.labels = None
+        #: a unit exposing ``window_stats`` with "metrics" (the fused
+        #: trainer in MSE scan-window mode) — same contract as the
+        #: softmax evaluator's stats_source
+        self.stats_source = None
         self.demand("target")
 
     def initialize(self, device=None, **kwargs):
@@ -243,7 +247,35 @@ class EvaluatorMSE(EvaluatorBase):
         self.n_err.mem[0] += bs - n_ok
         self.n_err.mem[1] += bs
 
+    def _consume_window_stats(self):
+        """Fold a just-run MSE scan window's in-scan stats (trainer's
+        fused._get_window_fn_mse — evaluator-identical [sum,max,min]
+        metrics, last-step per-sample mse, optional class-target
+        n_err) instead of recomputing from the (last-minibatch-only)
+        output buffer."""
+        ws = getattr(self.stats_source, "window_stats", None) \
+            if self.stats_source is not None else None
+        if ws is None or "metrics" not in ws:
+            return False
+        md = numpy.asarray(ws["metrics"])
+        self.metrics.map_write()
+        self.metrics.mem[0] += md[0]
+        self.metrics.mem[1] = max(self.metrics.mem[1], md[1])
+        self.metrics.mem[2] = min(self.metrics.mem[2], md[2])
+        if ws.get("mse_per") is not None:
+            self.mse.map_invalidate()
+            self.mse.mem[...] = numpy.asarray(ws["mse_per"])
+        if (self.class_targets is not None and self.labels is not None
+                and ws.get("n_err") is not None):
+            self.n_err.map_write()
+            self.n_err.mem += numpy.asarray(ws["n_err"])
+        if self.testing:
+            self.merge_output()
+        return True
+
     def numpy_run(self):
+        if self._consume_window_stats():
+            return
         self.output.map_read()
         self.target.map_read()
         err, md, mse_per = ev_ops.mse_numpy(
@@ -256,6 +288,8 @@ class EvaluatorMSE(EvaluatorBase):
             self.merge_output()
 
     def jax_run(self):
+        if self._consume_window_stats():
+            return
         err, md, mse_per = ev_ops.mse_jax(
             self.output.dev, self.target.dev, int(self.batch_size),
             mean=self.mean, root=self.root)
