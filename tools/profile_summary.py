@@ -22,7 +22,20 @@ import sys
 
 
 def _categorize(name):
-    n = name.lower()
+    # categorize by the RESULT name only (the text before " = "): the
+    # full HLO line lists operand names and layouts, so e.g. an
+    # elementwise fusion consuming a %copy-done operand would be
+    # miscounted as copy-transpose (this inflated the r4 cifar
+    # "copy-transpose 34%" reading — see BENCH_NOTES.md r5)
+    n = name.split(" = ")[0].lower()
+    if "convolution" in n:
+        return "convolution"
+    if "convert" in n:
+        # pure dtype casts, NOT convolutions — must precede the bare
+        # "conv" test (%convert_element_type would otherwise count as
+        # convolution, while %convolution_convert_fusion is caught by
+        # the full-word test above)
+        return "copy-transpose"
     if "conv" in n:
         return "convolution"
     if "dot" in n or "matmul" in n or "gemm" in n:

@@ -18,16 +18,29 @@ import jax
 import jax.numpy as jnp
 
 
+def _band_matrix(c, n):
+    """(c, c) 0/1 band: M[i, j] = 1 iff j is inside i's channel window.
+    Trace-time constant (channel counts are static)."""
+    idx = numpy.arange(c)
+    return (numpy.abs(idx[:, None] - idx[None, :]) <= n // 2)
+
+
 def _subsums_jax(x2, n):
-    """Windowed channel sums (reference _subsums, normalization.py:64-78)."""
+    """Windowed channel sums (reference _subsums, normalization.py:64-78)
+    as ONE band-matrix matmul on the channel (lane) axis.
+
+    The r4 north-star profile measured 34% of cifar-caffe device time
+    in copy-transpose: the previous cumsum/fancy-index formulation
+    produced odd-width channel tensors (C+2·half, C+2·half+1) and a
+    lane-axis gather, forcing Mosaic relayouts between every stage.
+    ``x2 @ M`` (M symmetric banded, a trace-time constant) keeps the
+    NHWC layout bit-for-bit — lanes contract to lanes on the MXU, no
+    pads, no gathers — and its autodiff VJP is the same matmul with
+    M^T = M.  In bf16 the MXU accumulates in f32, strictly better
+    than the bf16 cumsum it replaces."""
     c = x2.shape[3]
-    half = n // 2
-    padded = jnp.pad(x2, ((0, 0), (0, 0), (0, 0), (half, half)))
-    csum = jnp.cumsum(padded, axis=3)
-    csum = jnp.pad(csum, ((0, 0), (0, 0), (0, 0), (1, 0)))
-    upper = jnp.arange(c) + 2 * half + 1
-    lower = jnp.arange(c)
-    return csum[:, :, :, upper] - csum[:, :, :, lower]
+    m = jnp.asarray(_band_matrix(c, n), x2.dtype)
+    return x2 @ m
 
 
 @partial(jax.jit, static_argnames=("alpha", "beta", "k", "n"))

@@ -210,14 +210,15 @@ class PoolSpec:
 
     ``impl`` selects the max-pool lowering:
 
-    * "reshape" (DEFAULT when sliding == kernel): ky*kx strided slices
-      + compare/select chain; VJP is a recomputed winner mask routed by
-      interleave reshapes — pure elementwise, first-winner ties.  No
-      reduce_window, select-and-scatter or gather in the compiled
-      program (those were ~29% of the r4 flagship window,
-      profiles/r4_summary.md).
-    * "reduce_window" (DEFAULT for overlapping windows): XLA
-      select-and-scatter VJP; tie routing implementation-defined.
+    * "reduce_window" (DEFAULT): XLA select-and-scatter VJP; tie
+      routing implementation-defined.  Measured the FASTEST lowering
+      on a real v5e (r5 microbench, BENCH_NOTES.md).
+    * "reshape" (sliding == kernel only): ky*kx strided slices +
+      compare/select chain; VJP is a recomputed winner mask routed by
+      interleave reshapes — no reduce_window/select-and-scatter/
+      gather, unit-path first-winner ties.  Kept selectable as a
+      measured negative result: TPU sublane-strided slices relayout,
+      making it ~3x slower than reduce_window.
     * "offsets": the custom-VJP op ``ops/pooling.max_pooling_train_jax``
       — Pallas one-pass forward on a single-device TPU (window-view
       argmax elsewhere) and a dense shifted-accumulation backward to
@@ -230,8 +231,8 @@ class PoolSpec:
       parity/golden tests use it (its backward's summation ORDER
       matches the unit path's scatter on overlapping windows).
 
-    avg uses the reshape lowering when windows are disjoint and
-    reduce_window otherwise (no ties to break either way)."""
+    avg uses reduce_window unless pool_impl forces "reshape" (no ties
+    to break either way)."""
     type: str
     in_shape: tuple
     out_shape: tuple
@@ -721,9 +722,9 @@ def forward(params, x, specs, return_logits=False, key=None, train=False,
             elif spec.impl == "reshape":
                 # non-overlapping windows: strided-slice compare/select
                 # chain, elementwise VJP — no reduce_window, no
-                # select-and-scatter, no gather (ops/pooling.py; the
-                # auto-selected production lowering when sliding ==
-                # kernel — see FusedNet.__init__)
+                # select-and-scatter, no gather (ops/pooling.py;
+                # opt-in via pool_impl — measured slower than
+                # reduce_window on TPU, BENCH_NOTES.md r5)
                 if spec.mode == "avg":
                     y = pool_ops.avg_pooling_reshape_jax(
                         y, spec.ky, spec.kx)
