@@ -36,11 +36,47 @@ def apply_override(root_cfg, assignment):
     setattr(node, parts[-1], value)
 
 
-def run_genetics(module, spec):
+def _generic_population_evaluator(sites):
+    """DEFAULT fused GA path (VERDICT r4 missing #4): find the
+    top-level config namespace whose subtree holds every Range site
+    (a StandardWorkflow sample's root.<ns> with layers + loader_name)
+    and build the generic vmapped evaluator for it — no sample-file
+    opt-in needed.  Returns None (with a printed reason) when the
+    sample/sites are not fusable; the serial path remains the general
+    fallback."""
+    from znicz_tpu.parallel.population import workflow_population_evaluator
+    from znicz_tpu.core.genetics import enumerate_ranges
+    want = {(id(c), k) for c, k, _ in sites}
+    try:
+        for name, node in root.items():
+            if not isinstance(node, type(root)):
+                continue
+            if "layers" not in node or "loader_name" not in node:
+                continue
+            found = {(id(c), k) for c, k, _ in enumerate_ranges(node)}
+            if found and found == want:
+                ev = workflow_population_evaluator(node, sites,
+                                                   verbose=True)
+                if ev is not None:
+                    print("fused GA: vmapping each generation over "
+                          "root.%s (generic Range-site mapping)" % name)
+                return ev
+    except Exception as e:  # the serial path is the promised fallback
+        print("fused GA unavailable (%s); evaluating serially" % e)
+        return None
+    print("fused GA unavailable: no single sample namespace holds all "
+          "Range sites; evaluating serially")
+    return None
+
+
+def run_genetics(module, spec, fused=None):
     """--optimize GENSxPOP: evolve the Range values found anywhere under
     the config root (the reference's GA tier, SURVEY.md §3.5 —
     samples/MNIST/mnist_config.py:62 declares Range sites the same way).
-    Each fitness evaluation is a full training run of the workflow."""
+    The whole generation trains as ONE vmapped XLA computation whenever
+    the sites map onto fused hyper slots (any registered sample —
+    generic path); otherwise each fitness evaluation is a full training
+    run of the workflow (fused when ``--fused`` is given)."""
     from znicz_tpu.core.genetics import GeneticsOptimizer, enumerate_ranges
     from znicz_tpu.launcher import run_workflow
     gens_s, _, pop_s = spec.partition("x")
@@ -58,25 +94,48 @@ def run_genetics(module, spec):
             "--optimize needs Range(...) values in the config; e.g. "
             'root.myns.learning_rate = Range(0.01, 0.001, 0.1)')
 
-    # fused population path: samples exposing population_evaluator(sites)
-    # train the whole generation as ONE vmapped XLA computation
+    # fused population path: a sample-level population_evaluator factory
+    # takes precedence (it may carry sample-specific epochs/seeds); the
+    # generic Range-site mapping is the default for everything else
     evaluate_population = None
     factory = getattr(module, "population_evaluator", None)
     if factory is not None:
+        # a factory that returns None already probed (and logged) its
+        # namespace — do not re-initialize the dataset loader generically
         try:
             evaluate_population = factory(enumerate_ranges(root))
-        except Exception as e:  # fall back to serial evaluations
-            print("population evaluator unavailable (%s); evaluating "
-                  "serially" % e)
+        except Exception as e:
+            print("sample population evaluator unavailable (%s); "
+                  "evaluating serially" % e)
+    else:
+        evaluate_population = _generic_population_evaluator(
+            enumerate_ranges(root))
+    if evaluate_population is not None and fused:
+        print("note: --fused K=V settings do not apply to the vmapped "
+              "population path (it is already fused; pass a "
+              "population_evaluator for custom control)")
+
+    metric = {"label": "-err%"}  # the vmapped path scores -err% always
 
     def evaluate(_cfg):
-        wf = run_workflow(module)
+        wf = run_workflow(module, fused=fused)
         decision = getattr(wf, "decision", None)
         err = None
         if decision is not None:
             pts = getattr(decision, "best_n_err_pt", None)
             if pts is not None:
                 err = pts[1] if pts[1] is not None else pts[2]
+            if err is None:
+                # MSE decisions track [avg, max, min] mse instead of
+                # error percent — fitness is the best (VALID, else
+                # TRAIN) average mse
+                bm = getattr(decision, "best_metrics", None)
+                if bm is not None:
+                    for clazz in (1, 2):
+                        if bm[clazz] is not None:
+                            err = bm[clazz][0]
+                            metric["label"] = "-avg_mse"
+                            break
         if err is None:
             raise SystemExit("workflow exposes no error metric to "
                              "optimize against")
@@ -86,7 +145,7 @@ def run_genetics(module, spec):
                             population_size=pop,
                             evaluate_population=evaluate_population)
     values, fitness = opt.run()
-    print("best fitness (-err%%): %.4f" % fitness)
+    print("best fitness (%s): %.4f" % (metric["label"], fitness))
     for (container, key, rng), value in zip(opt.sites, values):
         print("  %s = %s  (range %s..%s)" % (key, value, rng.min_value,
                                              rng.max_value))
@@ -157,11 +216,6 @@ def main(argv=None):
     module = resolve_workflow_module(args.workflow)
     for assignment in args.config:
         apply_override(root, assignment)
-    if args.fused is not None and args.optimize:
-        # not silently ignored: the GA driver runs its own training path
-        # (its fused population evaluator is a sample-level opt-in)
-        parser.error("--fused applies to plain training and --parity "
-                     "runs; it cannot combine with --optimize")
     fused = args.fused
     if isinstance(fused, str):
         cfg = {}
@@ -192,7 +246,7 @@ def main(argv=None):
                 args.dump_graph:
             parser.error("--optimize cannot be combined with --snapshot/"
                          "--testing/--dry-run/--dump-graph")
-        return run_genetics(module, args.optimize)
+        return run_genetics(module, args.optimize, fused=fused)
     dry_run = args.dry_run or (bool(args.dump_graph) and not args.testing)
     wf = run_workflow(module, snapshot=args.snapshot,
                       testing=args.testing, dry_run=dry_run, fused=fused,

@@ -209,14 +209,24 @@ def _collapse_ranges(obj):
 
 
 def workflow_population_evaluator(ns, sites, epochs=None, seed=12,
-                                  loader_kwargs=None):
+                                  loader_kwargs=None, verbose=False):
     """Generic ``--optimize`` fused path for StandardWorkflow samples:
     builds the sample's registered loader from its config namespace
     ``ns`` (root.<sample>), maps the Range ``sites`` onto fused hyper
     slots, and returns the vmapped population evaluator — or ``None``
-    when the topology/sites are not fusable (serial fallback)."""
+    when the topology/sites are not fusable (serial fallback; with
+    ``verbose`` the reason is printed so the fallback is visible)."""
     from znicz_tpu.core.workflow import DummyWorkflow
     from znicz_tpu.loader.base import UserLoaderRegistry, VALID, TRAIN
+
+    def bail(reason):
+        if verbose:
+            import logging
+            from znicz_tpu.core.logger import setup_logging
+            setup_logging()
+            logging.getLogger("genetics").info(
+                "fused GA unavailable: %s; evaluating serially", reason)
+        return None
 
     layers = _collapse_ranges(list(ns.layers))
     loader_cfg = dict(ns.loader.as_dict() if hasattr(ns.loader, "as_dict")
@@ -226,32 +236,45 @@ def workflow_population_evaluator(ns, sites, epochs=None, seed=12,
         loader_cls = UserLoaderRegistry.get_factory(ns.loader_name)
         loader = loader_cls(DummyWorkflow(), **loader_cfg)
         loader.initialize()
-    except Exception:
-        return None
+    except Exception as e:
+        return bail("loader %r failed to initialize (%s)"
+                    % (ns.loader_name, e))
     data = getattr(loader, "original_data", None)
     labels = getattr(loader, "original_labels", None)
     if data is None or not data or not labels:
-        return None
+        return bail("loader exposes no in-memory dataset/labels")
     x = numpy.asarray(data.mem)
     y = numpy.asarray(labels, dtype=numpy.int32)
     vs, ve = loader.class_index_range(VALID)
     ts, te = loader.class_index_range(TRAIN)
     if te <= ts:
-        return None
+        return bail("loader has no TRAIN segment")
     if ve <= vs:  # no validation split: score on train
         vs, ve = ts, te
     sample_shape = tuple(x.shape[1:])
+    last = layers[-1] if layers else {}
+    if isinstance(last, dict) and last.get("type") == "softmax":
+        # head width comes from the loader at link time when the config
+        # omits it (StandardWorkflowBase link_forwards parity)
+        fwd = last.setdefault("->", {})
+        if "output_sample_shape" not in fwd and \
+                "output_samples" not in fwd:
+            try:
+                fwd["output_sample_shape"] = int(
+                    loader.unique_labels_count)
+            except Exception:
+                pass
     try:
         specs = tuple(fused.build_specs(layers, sample_shape, None))
-    except Exception:
-        return None
+    except Exception as e:
+        return bail("topology is not fusable (%s)" % e)
     if not specs[-1].is_softmax:
-        return None
+        return bail("population fitness needs a softmax head")
     # site identity must match the ORIGINAL config dicts (the collapsed
     # copy exists only for spec building)
     mapper = config_values_to_hypers(sites, list(ns.layers), specs)
     if mapper is None:
-        return None
+        return bail("a Range site does not map onto fused hyper slots")
     max_epochs = getattr(ns.decision, "max_epochs", None)
     return make_population_evaluator(
         layers, sample_shape, x[ts:te], y[ts:te], x[vs:ve], y[vs:ve],
