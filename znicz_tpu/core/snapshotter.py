@@ -110,6 +110,14 @@ class SnapshotterToFile(SnapshotterBase):
 
     def export(self):
         from znicz_tpu.core import prng
+        import jax
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # multi-host SPMD runs the same gang-scheduled program on
+            # every process with identical state — one writer (process
+            # 0) is sufficient AND necessary (concurrent writers would
+            # race on the same prefix); every process restores from the
+            # shared directory on resume
+            return
         payload = {
             "format": 1,
             "workflow": type(self.workflow).__name__,

@@ -41,6 +41,15 @@ class Launcher(Logger):
     def __init__(self, testing=False, snapshot=None, device=None,
                  dry_run=False, fused=None, auto_resume=False):
         super(Launcher, self).__init__(logger_name="Launcher")
+        # multi-host SPMD: bring up jax.distributed from the env
+        # (JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID or
+        # a managed-cluster runtime) BEFORE any backend use; a no-op
+        # for single-process runs
+        from znicz_tpu.parallel import multihost
+        if multihost.initialize():
+            self.info("jax.distributed up: process %d of %d",
+                      __import__("jax").process_index(),
+                      __import__("jax").process_count())
         self.testing = testing
         self.snapshot_path = snapshot
         self.device = device

@@ -1020,6 +1020,13 @@ class FusedNet:
         else:
             step_fn = lambda p, s, x, l, k, hy: _train_step(  # noqa: E731
                 p, s, x, l, specs, k, compute_dtype, hy, with_output=True)
+        #: multi-host runs must hand host-read outputs (output/max_idx/
+        #: mse_per — the evaluator/decision inputs) back REPLICATED:
+        #: jax.device_get of a batch-sharded array whose shards live on
+        #: other processes' devices is not addressable (single-process
+        #: meshes keep the cheaper data-sharded outputs)
+        self._replicate_outputs = (mesh is not None
+                                   and jax.process_count() > 1)
         if mesh is not None:
             # Pin output shardings to the input placements: GSPMD would
             # otherwise return spec variants (P('model',) vs
@@ -1032,15 +1039,16 @@ class FusedNet:
                        for k, slots in st.items()}
                       for s, st in zip(self.specs, self.state)]
             out_ndim = 1 + len(self.specs[-1].out_shape)
-            oshard = NamedSharding(mesh, P("data", *([None] * (out_ndim - 1))))
+            rep = NamedSharding(mesh, P())
+            oshard = rep if self._replicate_outputs else NamedSharding(
+                mesh, P("data", *([None] * (out_ndim - 1))))
+            ishard = rep if self._replicate_outputs else NamedSharding(
+                mesh, P("data"))
             if objective == "mse":
-                mshard = {"loss": NamedSharding(mesh, P()),
-                          "output": oshard}
+                mshard = {"loss": rep, "output": oshard}
             else:
-                mshard = {"loss": NamedSharding(mesh, P()),
-                          "n_err": NamedSharding(mesh, P()),
-                          "output": oshard,
-                          "max_idx": NamedSharding(mesh, P("data"))}
+                mshard = {"loss": rep, "n_err": rep,
+                          "output": oshard, "max_idx": ishard}
             self._pshard, self._sshard = pshard, sshard
             self._step = jax.jit(step_fn, donate_argnums=(0, 1),
                                  out_shardings=(pshard, sshard, mshard))
@@ -1050,16 +1058,24 @@ class FusedNet:
         # stochastic-pool nets sample winners at inference too (reference
         # StochasticPooling draws on every run, pooling.py:368-460) — the
         # compiled forward takes a key; others keep the keyless signature
+        fwd_kw = {}
+        if self._replicate_outputs:
+            # inference outputs are host-read by the evaluator — same
+            # multi-host addressability rule as the train-step outputs
+            fwd_kw["out_shardings"] = NamedSharding(mesh, P())
         self._fwd = jax.jit(
             lambda p, x, k=None: forward(p, x, specs, key=k,
-                                         compute_dtype=compute_dtype))
+                                         compute_dtype=compute_dtype),
+            **fwd_kw)
 
         def fwd_idx(p, x, k=None):
             probs = forward(p, x, specs, key=k,
                             compute_dtype=compute_dtype)
             return probs, jnp.argmax(probs, axis=1).astype(jnp.int32)
 
-        self._fwd_idx = jax.jit(fwd_idx)
+        self._fwd_idx = jax.jit(fwd_idx, **({"out_shardings": (
+            fwd_kw["out_shardings"], fwd_kw["out_shardings"])}
+            if fwd_kw else {}))
 
     # -- sharding -----------------------------------------------------------
     def _param_spec(self, spec, name):
@@ -1386,11 +1402,13 @@ class FusedNet:
 
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
-            oshard = NamedSharding(self.mesh, P("data", None))
+            oshard = rep if self._replicate_outputs else NamedSharding(
+                self.mesh, P("data", None))
+            ishard = rep if self._replicate_outputs else NamedSharding(
+                self.mesh, P("data"))
             mshard = {"loss": rep, "n_err": rep, "confusion": rep,
                       "max_err_sum": rep,
-                      "output": oshard,
-                      "max_idx": NamedSharding(self.mesh, P("data"))}
+                      "output": oshard, "max_idx": ishard}
             fn = jax.jit(window_fn, donate_argnums=(0, 1),
                          out_shardings=(self._pshard, self._sshard, rep,
                                         mshard))
@@ -1578,11 +1596,12 @@ class FusedNet:
 
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
-            oshard = NamedSharding(
+            oshard = rep if self._replicate_outputs else NamedSharding(
                 self.mesh, P("data", *([None] * len(out_shape))))
+            pshard_ = rep if self._replicate_outputs else NamedSharding(
+                self.mesh, P("data"))
             mshard = {"loss": rep, "metrics": rep, "n_err": rep,
-                      "mse_per": NamedSharding(self.mesh, P("data")),
-                      "output": oshard}
+                      "mse_per": pshard_, "output": oshard}
             fn = jax.jit(window_fn, donate_argnums=(0, 1),
                          out_shardings=(self._pshard, self._sshard, rep,
                                         mshard))

@@ -29,16 +29,26 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_initialized = False
+
+
 def initialize(coordinator_address=None, num_processes=None,
                process_id=None, **kwargs):
     """Bring up the JAX distributed runtime across hosts.
 
-    A no-op for single-process runs (the common case and every test).
-    Arguments default from the standard env vars
+    A no-op for single-process runs (the common case and every test),
+    and IDEMPOTENT: a second call in an already-distributed process
+    returns True without touching the runtime (jax.distributed raises
+    on double-initialize, and e.g. a serial GA constructs one Launcher
+    per evaluation).  Arguments default from the standard env vars
     (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) —
     under TPU pod runtimes jax.distributed autodetects and none are
     needed.
     """
+    global _initialized
+    if _initialized or getattr(
+            jax._src.distributed.global_state, "client", None) is not None:
+        return True
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if num_processes is None:
@@ -47,18 +57,34 @@ def initialize(coordinator_address=None, num_processes=None,
     if process_id is None:
         pid = os.environ.get("JAX_PROCESS_ID")
         process_id = int(pid) if pid is not None else None
+    def _cpu_collectives():
+        # multi-process CPU (tests / dev boxes) needs a cross-process
+        # collectives implementation; gloo is the one shipped with jax.
+        # Harmless if the backend turns out to be TPU (config is only
+        # read by the CPU client).
+        if "cpu" in (os.environ.get("JAX_PLATFORMS") or "cpu"):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
+
     if coordinator_address is None and num_processes in (None, 1):
         # no explicit config: managed cluster runtimes (TPU pods, GKE,
         # Slurm/MPI) carry their own env markers and jax.distributed
         # autodetects from them — skipping initialize there would let
         # every host train independently with NO gradient sync
         if _cluster_env_detected():
+            _cpu_collectives()
             jax.distributed.initialize(**kwargs)
+            _initialized = True
             return True
         return False  # genuinely single process
+    _cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes, process_id=process_id, **kwargs)
+    _initialized = True
     return True
 
 
@@ -106,14 +132,28 @@ def make_hybrid_mesh(model_parallel=1, devices=None):
     if n_processes > 1:
         from jax.experimental import mesh_utils
         per_host = n // n_processes
-        if per_host % model_parallel:
+        # TPU multislice: the DCN boundary is the SLICE (hosts inside a
+        # slice are ICI-connected even across processes) — group by
+        # slice with one DCN granule per slice.  Everything else
+        # (multi-host single slice, CPU/GPU clusters, the 2-process CPU
+        # elastic test) groups by process.
+        n_slices = len({getattr(d, "slice_index", 0) or 0
+                        for d in devices})
+        if n_slices > 1:
+            per_granule, n_granules, by_process = n // n_slices, \
+                n_slices, False
+        else:
+            per_granule, n_granules, by_process = per_host, \
+                n_processes, True
+        if per_granule % model_parallel:
             raise ValueError(
-                "model_parallel %d does not fit inside one host's %d "
-                "devices — the model axis must not cross DCN"
-                % (model_parallel, per_host))
+                "model_parallel %d does not fit inside one DCN "
+                "granule's %d devices — the model axis must not cross "
+                "DCN" % (model_parallel, per_granule))
         arr = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(per_host // model_parallel, model_parallel),
-            dcn_mesh_shape=(n_processes, 1), devices=devices)
+            mesh_shape=(per_granule // model_parallel, model_parallel),
+            dcn_mesh_shape=(n_granules, 1), devices=devices,
+            process_is_granule=by_process)
         return Mesh(arr, ("data", "model"))
     from znicz_tpu.parallel.mesh import make_mesh
     return make_mesh(model_parallel=model_parallel, devices=devices)

@@ -163,10 +163,6 @@ def run(load, main):
     main()
 
 
-def population_evaluator(sites, epochs=None, seed=12):
-    """``--optimize`` fused path: whole GA generations train as ONE
-    vmapped XLA computation over any hyper-key Range sites (generic
-    mapping, parallel/population.workflow_population_evaluator)."""
-    from znicz_tpu.parallel.population import workflow_population_evaluator
-    return workflow_population_evaluator(root.mnistr, sites,
-                                         epochs=epochs, seed=seed)
+# --optimize trains whole GA generations as ONE vmapped XLA computation
+# by default (the generic Range-site mapping in __main__.run_genetics
+# finds root.mnistr itself); no sample-level factory needed.

@@ -82,7 +82,14 @@ class StandardWorkflow(StandardWorkflowBase):
         from znicz_tpu.units.fused_trainer import FusedForwardBackward
         cfg = dict(self.fused_config or {})
         mesh = cfg.pop("mesh", None)
-        if isinstance(mesh, int):
+        if mesh == "hybrid":
+            # all processes' devices, model axis inside one host's ICI
+            # domain (multi-host SPMD; launcher calls
+            # multihost.initialize() from env before this)
+            from znicz_tpu.parallel import multihost
+            mesh = multihost.make_hybrid_mesh(
+                model_parallel=cfg.pop("model_parallel", 1))
+        elif isinstance(mesh, int):
             from znicz_tpu.parallel import make_mesh
             mesh = make_mesh(mesh,
                              model_parallel=cfg.pop("model_parallel", 1))
