@@ -77,9 +77,15 @@ def _spawn_gang(snapdir, port, extra=()):
 
 def _finish_gang(procs, timeout=900):
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=timeout)
-        outs.append((p.returncode, out + err))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out + err))
+    finally:
+        for p in procs:  # never leak a live trainer on timeout/failure
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=60)
     return outs
 
 
@@ -105,24 +111,33 @@ def test_two_process_sigkill_then_auto_resume_matches_straight(tmp_path):
     # 2) identical gang, worker (process 1) SIGKILLed after the first
     # snapshot lands, then the blocked survivor — a host loss takes the
     # whole gang down (SPMD is gang-scheduled; the scheduler restarts
-    # the job, which is step 3)
+    # the job, which is step 3).  try/finally: a failed assertion must
+    # not leak live training subprocesses
     procs = _spawn_gang(killed_dir, _free_port())
-    deadline = time.time() + 600
-    snap_seen = False
-    while time.time() < deadline and all(p.poll() is None for p in procs):
-        if any(f.endswith(".pickle") for f in os.listdir(killed_dir)):
-            snap_seen = True
-            break
-        time.sleep(0.05)
-    assert snap_seen, "no snapshot appeared before the deadline"
-    assert all(p.poll() is None for p in procs), \
-        "gang finished before the kill — grow the dataset"
-    procs[1].send_signal(signal.SIGKILL)
-    time.sleep(1.0)
-    procs[0].send_signal(signal.SIGKILL)
-    for p in procs:
-        p.wait(timeout=60)
-        assert p.returncode != 0
+    try:
+        deadline = time.time() + 600
+        snap_seen = False
+        while time.time() < deadline and \
+                all(p.poll() is None for p in procs):
+            if any(f.endswith(".pickle")
+                   for f in os.listdir(killed_dir)):
+                snap_seen = True
+                break
+            time.sleep(0.05)
+        assert snap_seen, "no snapshot appeared before the deadline"
+        assert all(p.poll() is None for p in procs), \
+            "gang finished before the kill — grow the dataset"
+        procs[1].send_signal(signal.SIGKILL)
+        time.sleep(1.0)
+        procs[0].send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=60)
+            assert p.returncode != 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=60)
 
     # 3) restart the gang with --auto-resume: both processes restore
     # process 0's snapshot from the shared directory and continue;

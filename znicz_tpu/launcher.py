@@ -44,9 +44,25 @@ class Launcher(Logger):
         # multi-host SPMD: bring up jax.distributed from the env
         # (JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID or
         # a managed-cluster runtime) BEFORE any backend use; a no-op
-        # for single-process runs
+        # for single-process runs.  A failed init degrades to
+        # single-process ONLY for autodetected cluster markers (a stale
+        # SLURM_JOB_ID in an interactive shell); with an EXPLICIT
+        # JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES config it stays
+        # fatal — silently training unsynced on one host while the
+        # gang expects gradient sync would corrupt the job
+        import os as _os
         from znicz_tpu.parallel import multihost
-        if multihost.initialize():
+        explicit = bool(_os.environ.get("JAX_COORDINATOR_ADDRESS")
+                        or _os.environ.get("JAX_NUM_PROCESSES"))
+        try:
+            up = multihost.initialize()
+        except Exception as e:
+            if explicit:
+                raise
+            self.warning("jax.distributed init failed (%s); continuing "
+                         "single-process", e)
+            up = False
+        if up:
             self.info("jax.distributed up: process %d of %d",
                       __import__("jax").process_index(),
                       __import__("jax").process_count())

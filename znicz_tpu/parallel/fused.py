@@ -1020,11 +1020,13 @@ class FusedNet:
         else:
             step_fn = lambda p, s, x, l, k, hy: _train_step(  # noqa: E731
                 p, s, x, l, specs, k, compute_dtype, hy, with_output=True)
-        #: multi-host runs must hand host-read outputs (output/max_idx/
-        #: mse_per — the evaluator/decision inputs) back REPLICATED:
-        #: jax.device_get of a batch-sharded array whose shards live on
-        #: other processes' devices is not addressable (single-process
-        #: meshes keep the cheaper data-sharded outputs)
+        #: multi-host: batch-sharded outputs are not fully addressable
+        #: for device_get.  The WINDOW outputs stay data-sharded (they
+        #: are read only on segment-final windows — replicating inside
+        #: every compiled window would pay a per-window DCN all-gather
+        #: for unread buffers) and :meth:`host_fetch` reshards at
+        #: readback; the PREDICT outputs are consumed every call, so
+        #: those jits return replicated directly
         self._replicate_outputs = (mesh is not None
                                    and jax.process_count() > 1)
         if mesh is not None:
@@ -1040,10 +1042,9 @@ class FusedNet:
                       for s, st in zip(self.specs, self.state)]
             out_ndim = 1 + len(self.specs[-1].out_shape)
             rep = NamedSharding(mesh, P())
-            oshard = rep if self._replicate_outputs else NamedSharding(
+            oshard = NamedSharding(
                 mesh, P("data", *([None] * (out_ndim - 1))))
-            ishard = rep if self._replicate_outputs else NamedSharding(
-                mesh, P("data"))
+            ishard = NamedSharding(mesh, P("data"))
             if objective == "mse":
                 mshard = {"loss": rep, "output": oshard}
             else:
@@ -1402,10 +1403,8 @@ class FusedNet:
 
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
-            oshard = rep if self._replicate_outputs else NamedSharding(
-                self.mesh, P("data", None))
-            ishard = rep if self._replicate_outputs else NamedSharding(
-                self.mesh, P("data"))
+            oshard = NamedSharding(self.mesh, P("data", None))
+            ishard = NamedSharding(self.mesh, P("data"))
             mshard = {"loss": rep, "n_err": rep, "confusion": rep,
                       "max_err_sum": rep,
                       "output": oshard, "max_idx": ishard}
@@ -1596,12 +1595,11 @@ class FusedNet:
 
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
-            oshard = rep if self._replicate_outputs else NamedSharding(
+            oshard = NamedSharding(
                 self.mesh, P("data", *([None] * len(out_shape))))
-            pshard_ = rep if self._replicate_outputs else NamedSharding(
-                self.mesh, P("data"))
             mshard = {"loss": rep, "metrics": rep, "n_err": rep,
-                      "mse_per": pshard_, "output": oshard}
+                      "mse_per": NamedSharding(self.mesh, P("data")),
+                      "output": oshard}
             fn = jax.jit(window_fn, donate_argnums=(0, 1),
                          out_shardings=(self._pshard, self._sshard, rep,
                                         mshard))
@@ -1652,6 +1650,23 @@ class FusedNet:
             self._targets_p, self._labels_p, starts, None, None, bs,
             hypers_s)
         return stats
+
+    def host_fetch(self, tree):
+        """``jax.device_get`` that works across processes: leaves whose
+        shards live on other hosts are resharded to replicated first
+        (one all-gather at READBACK time — window outputs stay
+        data-sharded on the hot path and only segment-final reads pay
+        the transfer)."""
+        if not self._replicate_outputs:
+            return jax.device_get(tree)
+        rep = NamedSharding(self.mesh, P())
+
+        def _rep(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                return jax.jit(lambda a: a, out_shardings=rep)(x)
+            return x
+
+        return jax.device_get(jax.tree.map(_rep, tree))
 
     def params_finite(self):
         """Device-side all-finite reduction over every parameter — the

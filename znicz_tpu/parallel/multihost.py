@@ -46,8 +46,10 @@ def initialize(coordinator_address=None, num_processes=None,
     needed.
     """
     global _initialized
-    if _initialized or getattr(
-            jax._src.distributed.global_state, "client", None) is not None:
+    if _initialized:
+        return True
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
         return True
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")

@@ -115,11 +115,12 @@ class FusedForwardBackward(Unit):
         self.compute_dtype = kwargs.get("compute_dtype")
         self.defaults = kwargs.get("defaults")
         self.dropout_seed = kwargs.get("dropout_seed", 0)
-        #: max-pool lowering: None (auto: "reshape" strided-slice path
-        #: for non-overlapping windows, "reduce_window" otherwise),
-        #: "reduce_window" (select-and-scatter VJP), "offsets" (custom
-        #: VJP, first-winner ties) or "gather" (unit-path summation-
-        #: order parity) — see fused.PoolSpec.impl
+        #: max-pool lowering: None (default: "reduce_window" — measured
+        #: fastest on a real v5e, BENCH_NOTES.md r5), "reduce_window"
+        #: (select-and-scatter VJP), "reshape" (strided-slice,
+        #: disjoint windows only), "offsets" (custom VJP, first-winner
+        #: ties) or "gather" (unit-path summation-order parity) — see
+        #: fused.PoolSpec.impl
         self.pool_impl = kwargs.get("pool_impl")
         self.rand = kwargs.get("rand", prng.get())
         self.output = Array(name="output")
@@ -491,7 +492,7 @@ class FusedForwardBackward(Unit):
             keys = ["metrics", "n_err"]
             if pull_output:
                 keys += ["output", "mse_per"]
-            host = jax.device_get({k: stats[k] for k in keys})
+            host = self.net.host_fetch({k: stats[k] for k in keys})
             self.window_stats = {
                 "metrics": host["metrics"],
                 "n_err": host["n_err"],
@@ -502,7 +503,7 @@ class FusedForwardBackward(Unit):
             keys = ["n_err", "confusion", "max_err_sum"]
             if pull_output:
                 keys += ["output", "max_idx"]
-            host = jax.device_get({k: stats[k] for k in keys})
+            host = self.net.host_fetch({k: stats[k] for k in keys})
             self.window_stats = {
                 "n_err": host["n_err"],
                 "confusion": host["confusion"],
@@ -565,7 +566,7 @@ class FusedForwardBackward(Unit):
         # single-device loader arrays — a mesh-committed jax.Array would
         # clash there, and the per-minibatch pull is small.  device_get
         # pipelines the transfers (one round trip, not one per array).
-        out, idx = jax.device_get((out, idx))
+        out, idx = self.net.host_fetch((out, idx))
         self.output.map_invalidate()
         self.output.mem[...] = numpy.asarray(out, dtype=self.output.dtype)
         if idx is not None:
