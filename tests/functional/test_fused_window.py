@@ -265,3 +265,41 @@ def test_mse_window_host_stacked_equals_sliced(tmp_path, float64_engine):
     assert not wf_h.fused_trainer._use_device_data
     assert wf_s.fused_trainer._use_sliced
     _assert_same_mse_trajectory(wf_h, wf_s)
+
+
+def test_mse_window_class_targets_equals_window1(tmp_path,
+                                                 float64_engine):
+    """Windowed MSE with CLASS TARGETS (kanji-style): the in-scan
+    nearest-class-target n_err (fused._get_window_fn_mse) must equal
+    the per-minibatch evaluator's host loop integer-for-integer, along
+    with metrics and params — float64, window=4 vs window=1 on the
+    host-stacked path (image loaders do not qualify for device data)."""
+    from znicz_tpu.samples import kanji
+
+    def run(window):
+        _seed()
+        wf = kanji.build(
+            loader_config={
+                "minibatch_size": 30,
+                "train_paths": [str(tmp_path / ("kj%d" % window) / "train")],
+                "target_paths": [str(tmp_path / ("kj%d" % window) /
+                                     "target")]},
+            decision_config={"max_epochs": 2, "fail_iterations": 100},
+            snapshotter_config={"prefix": "kw%d" % window,
+                                "interval": 100, "time_interval": 1e9,
+                                "compression": "",
+                                "directory": str(tmp_path)},
+            fused={"window": window})
+        wf.initialize(device=JaxDevice())
+        wf.run()
+        return wf
+
+    wf_w = run(4)
+    wf_1 = run(1)
+    assert wf_w.fused_trainer.window == 4
+    assert not wf_w.fused_trainer._use_device_data  # host-stacked path
+    assert wf_w.fused_trainer.net.class_targets is not None
+    _assert_same_mse_trajectory(wf_w, wf_1)
+    assert list(wf_w.decision.epoch_n_err) == \
+        list(wf_1.decision.epoch_n_err)
+    assert wf_w.decision.epoch_n_err[2] is not None

@@ -102,7 +102,8 @@ class KanjiWorkflow(StandardWorkflow):
     (reference samples/Kanji/kanji.py:46)."""
 
 
-def build(layers=None, loader_config=None, decision_config=None, **kwargs):
+def build(layers=None, loader_config=None, decision_config=None,
+          snapshotter_config=None, **kwargs):
     cfg = root.kanji
     loader_cfg = cfg.loader.as_dict()
     # default paths resolve against the CURRENT datasets dir
@@ -116,13 +117,15 @@ def build(layers=None, loader_config=None, decision_config=None, **kwargs):
             train_paths[0]) if train_paths else None)
     decision_cfg = cfg.decision.as_dict()
     decision_cfg.update(decision_config or {})
+    snap_cfg = cfg.snapshotter.as_dict()
+    snap_cfg.update(snapshotter_config or {})
     kwargs.setdefault("loss_function", cfg.loss_function)
     return KanjiWorkflow(
         layers=layers if layers is not None else cfg.layers,
         loader_name=cfg.loader_name,
         loader_config=loader_cfg,
         decision_config=decision_cfg,
-        snapshotter_config=cfg.snapshotter.as_dict(),
+        snapshotter_config=snap_cfg,
         **kwargs)
 
 
