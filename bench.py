@@ -157,6 +157,24 @@ def _spread_pct(windows):
     return round(100.0 * (max(windows) - min(windows)) / max(windows), 2)
 
 
+def _measure_rtt(n=5):
+    """Host<->device round-trip latency (median of ``n`` 1-element
+    readbacks) — the tunnel-day quality signal.  The axon tunnel's RTT
+    varies from ~10 ms to ~100 ms day to day and bounds every
+    dispatch+readback pair, so round-over-round img/s comparisons are
+    only meaningful alongside this number (BENCH_NOTES.md r5)."""
+    import jax
+    import jax.numpy as jnp
+    x = jax.device_put(jnp.zeros((8,), jnp.float32))
+    numpy.asarray(x[:1])  # warm the path
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        numpy.asarray(x[:1])
+        times.append(time.perf_counter() - t0)
+    return round(1e3 * sorted(times)[n // 2], 2)
+
+
 def main(profile_dir=None):
     import __graft_entry__ as ge
     from znicz_tpu.core.config import root
@@ -165,6 +183,7 @@ def main(profile_dir=None):
     import jax.numpy as jnp
 
     peak = _peak_flops(jax.devices()[0].device_kind)
+    rtt_before = _measure_rtt()
 
     def mfu(eff):
         return round(100.0 * eff / peak, 2) if peak else None
@@ -217,6 +236,10 @@ def main(profile_dir=None):
                 "in-scan indexed gather)" % flagship_steps,
         "window_ips": [round(w, 1) for w in windows],
         "window_spread_pct": _spread_pct(windows),
+        # RTT swings over a multi-minute run — sample both ends so the
+        # recorded img/s can be read against the tunnel quality that
+        # actually prevailed (review finding r5)
+        "tunnel_rtt_ms": [rtt_before, _measure_rtt()],
         "train_tflops_effective": round(eff / 1e12, 2),
         "compute_dtype": "bfloat16",
         "f32_images_per_sec": round(ips_f32, 1),
