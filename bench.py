@@ -88,12 +88,17 @@ def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
     discarded.  Returns (best_ips, [per-window ips...], train FLOPs/img).
     """
     from znicz_tpu.core import prng
+    from znicz_tpu.core import telemetry
     from znicz_tpu.core.backends import JaxDevice
     from znicz_tpu.standard_workflow import StandardWorkflow
     from znicz_tpu.parallel.fused import flops_per_image
     import znicz_tpu.loader.loader_mnist  # noqa: F401
     import znicz_tpu.loader.loader_cifar  # noqa: F401
 
+    # per-attempt isolation: a failed larger-batch attempt (_try_measure
+    # falls back on OOM/worker crash) must not leave its compiles and
+    # transfer bytes in the registry the surviving run's summary reads
+    telemetry.reset()
     prng.get(1).seed(1234)
     prng.get(2).seed(5678)
     wf = StandardWorkflow(
@@ -178,6 +183,7 @@ def _measure_rtt(n=5):
 def main(profile_dir=None):
     import __graft_entry__ as ge
     from znicz_tpu.core.config import root
+    from znicz_tpu.core import telemetry
     import znicz_tpu.samples.cifar  # noqa: F401 (root.cifar)
     import jax
     import jax.numpy as jnp
@@ -188,12 +194,23 @@ def main(profile_dir=None):
     def mfu(eff):
         return round(100.0 * eff / peak, 2) if peak else None
 
+    # telemetry rides the flagship run so every BENCH_*.json carries
+    # the WHY (compile count, transfer bytes, step-time spread), not
+    # just the img/s.  Hooks fire at window cadence — noise for a
+    # 40-minibatch scan is one span + three counter bumps per epoch.
+    # (_measure resets the registry per attempt, so the summary below
+    # reflects exactly the surviving flagship run.)
+    root.common.telemetry.enabled = True
+
     # primary: MNIST conv flagship, bf16 GEMMs + f32 master weights,
     # through the workflow control plane
     flagship_steps = 40
     ips, windows, fpi, batch = _try_measure(
         ge.FLAGSHIP_LAYERS, "mnist_loader", (16384, 8192), jnp.bfloat16,
         n_steps=flagship_steps, profile_dir=profile_dir)
+    # flagship-attributed telemetry, captured before the other models
+    # pollute the counters
+    flagship_telemetry = telemetry.summary()
     # secondary reference point; never let its failure kill the primary
     # metric (f32 needs ~2x the bf16 run's memory on the same batch)
     try:
@@ -252,6 +269,9 @@ def main(profile_dir=None):
         "mfu_note": "flagship topologies are MXU-starved by design "
                     "(1..87ch convs); wide 128/256ch model shows the "
                     "framework ceiling; see BENCH_NOTES.md",
+        # the why-block: compile count, host<->device bytes, step-time
+        # p50/p99 of the flagship run (core/telemetry.py summary())
+        "telemetry": flagship_telemetry,
     }
     if peak:
         out["mfu_pct"] = mfu(eff)

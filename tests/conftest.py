@@ -44,3 +44,17 @@ def _snapshots_to_tmp(tmp_path, monkeypatch):
     from znicz_tpu.core.config import root
     monkeypatch.setattr(root.common.dirs, "snapshots", str(tmp_path))
 
+
+@pytest.fixture(autouse=True)
+def _engine_flags_isolated():
+    """One test must not leak engine-mode flags into the rest of the
+    suite: blocking-sync timing (``root.common.timings.sync_each_run``,
+    formerly the mutable class global ``Unit.sync_timings``) and the
+    telemetry gate are snapshotted and restored around every test."""
+    from znicz_tpu.core.config import root
+    sync = root.common.timings.get("sync_each_run", False)
+    tel = root.common.telemetry.get("enabled", False)
+    yield
+    root.common.timings.sync_each_run = sync
+    root.common.telemetry.enabled = tel
+

@@ -1,0 +1,350 @@
+"""Telemetry subsystem: span tracing, metrics registry, JAX-aware
+counters, Prometheus exposition, no-op fast path (core/telemetry.py).
+
+The acceptance contract (ISSUE 1): a 2-epoch wine run with telemetry
+enabled produces Perfetto-valid nested spans and >= 8 Prometheus
+series; with telemetry disabled the instrumented hot paths record
+NOTHING."""
+
+import json
+import urllib.request
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import telemetry
+from znicz_tpu.core.memory import Array
+from znicz_tpu.core.status_server import StatusServer
+from znicz_tpu.core.units import Unit, sync_timings_enabled
+from znicz_tpu.core.workflow import DummyWorkflow
+from znicz_tpu.parallel.multihost import merge_telemetry_snapshots
+
+
+@pytest.fixture
+def tel():
+    """Telemetry ON with a clean registry; wiped after the test (the
+    conftest autouse fixture restores the enabled flag itself)."""
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    yield telemetry
+    telemetry.reset()
+
+
+# -- span tracer -------------------------------------------------------------
+
+def test_span_nesting_and_trace_export(tel, tmp_path):
+    with tel.span("outer", phase="train"):
+        with tel.span("inner"):
+            pass
+    path = tel.export_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    events = {e["name"]: e for e in doc["traceEvents"]}
+    assert set(events) == {"outer", "inner"}
+    outer, inner = events["outer"], events["inner"]
+    for ev in (outer, inner):
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["dur"], (int, float))
+        assert ev["pid"] == 0 and isinstance(ev["tid"], int)
+    # containment = Perfetto nesting
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"phase": "train"}
+    assert doc["displayTimeUnit"] == "ms"
+
+
+def test_span_survives_exception(tel):
+    with pytest.raises(RuntimeError):
+        with tel.span("dies"):
+            raise RuntimeError("boom")
+    names = [e["name"] for e in tel.trace_events()]
+    assert names == ["dies"]
+
+
+def test_instant_event(tel):
+    tel.instant("epoch_end", epoch=3)
+    (ev,) = tel.trace_events()
+    assert ev["ph"] == "i" and ev["s"] == "t"
+    assert ev["args"] == {"epoch": 3}
+
+
+def test_trace_ring_caps_and_counts_drops(tel):
+    old = root.common.telemetry.trace_capacity
+    root.common.telemetry.trace_capacity = 8
+    try:
+        tel.reset()  # re-read capacity
+        for i in range(20):
+            with tel.span("s%d" % i):
+                pass
+        snap = tel.snapshot()
+        assert snap["trace"]["buffered_events"] == 8
+        assert snap["trace"]["dropped_events"] == 12
+    finally:
+        root.common.telemetry.trace_capacity = old
+        tel.reset()
+
+
+# -- metrics registry --------------------------------------------------------
+
+def test_histogram_percentiles(tel):
+    h = tel.histogram("t.secs")
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.sum == sum(range(1, 101))
+    assert 50 <= h.percentile(50) <= 51
+    assert 99 <= h.percentile(99) <= 100
+    st = h.stats()
+    assert st["min"] == 1.0 and st["max"] == 100.0
+    assert 50 <= st["p50"] <= 51
+
+
+def test_histogram_weighted_observe(tel):
+    h = tel.histogram("w.secs")
+    h.observe(0.5, count=10)
+    assert h.count == 10
+    assert h.sum == pytest.approx(5.0)
+
+
+def test_counter_and_gauge(tel):
+    c = tel.counter("c.things")
+    c.inc()
+    c.inc(4)
+    assert tel.counter("c.things") is c  # registry, not a new object
+    assert c.value == 5
+    tel.gauge("g.level").set(2.5)
+    snap = tel.snapshot()
+    assert snap["counters"]["c.things"] == 5
+    assert snap["gauges"]["g.level"] == 2.5
+
+
+def test_prometheus_exposition_format(tel):
+    tel.counter("loader.minibatches").inc(7)
+    tel.gauge("mem.used").set(1.5)
+    tel.histogram("step.seconds").observe(0.003)
+    text = tel.prometheus_text()
+    assert "znicz_loader_minibatches 7" in text
+    assert 'znicz_step_seconds_bucket{le="+Inf"} 1' in text
+    assert "znicz_step_seconds_count 1" in text
+    # the shared validator checks every sample line and TYPE headers
+    families = tel.parse_prometheus(text)
+    assert families == {"znicz_loader_minibatches": "counter",
+                        "znicz_mem_used": "gauge",
+                        "znicz_step_seconds": "histogram"}
+    with pytest.raises(ValueError):
+        tel.parse_prometheus("not a metric line at all!")
+
+
+# -- disabled-by-default fast path ------------------------------------------
+
+def test_noop_mode_records_nothing():
+    root.common.telemetry.enabled = False
+    telemetry.reset()
+    # shared singletons — zero allocation on the hot path
+    assert telemetry.span("a") is telemetry.span("b")
+    assert telemetry.counter("x") is telemetry.counter("y")
+    assert telemetry.counter("x") is telemetry.histogram("h")
+    with telemetry.span("dead", attr=1):
+        telemetry.counter("dead.counter").inc(100)
+        telemetry.histogram("dead.hist").observe(1.0)
+        telemetry.gauge("dead.gauge").set(5)
+        telemetry.instant("dead.marker")
+    snap = telemetry.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {} \
+        and snap["histograms"] == {}
+    assert snap["trace"]["buffered_events"] == 0
+    assert telemetry.trace_events() == []
+
+
+def test_mid_run_toggle(tel):
+    with tel.span("on1"):
+        pass
+    root.common.telemetry.enabled = False
+    with telemetry.span("off"):
+        pass
+    root.common.telemetry.enabled = True
+    with tel.span("on2"):
+        pass
+    assert [e["name"] for e in tel.trace_events()] == ["on1", "on2"]
+
+
+# -- engine wiring -----------------------------------------------------------
+
+def test_unit_fire_records_span_and_metrics(tel):
+    w = DummyWorkflow()
+    u = Unit(w, name="worker")
+    w.start_point.link_from(u)  # no-op edge; fire u directly
+    u._fire()
+    names = [e["name"] for e in tel.trace_events()]
+    assert "unit.worker" in names
+    snap = tel.snapshot()
+    assert snap["counters"]["unit.runs"] == 1
+    assert snap["histograms"]["unit.run_seconds"]["count"] == 1
+
+
+def test_transfer_byte_counters(tel):
+    a = Array(numpy.zeros((4, 8), dtype=numpy.float32), name="t")
+    a.dev  # host -> device upload
+    snap = tel.snapshot()
+    assert snap["counters"]["transfer.h2d_bytes"] == 4 * 8 * 4
+    assert snap["counters"]["transfer.h2d_calls"] == 1
+    import jax.numpy as jnp
+    a.set_dev(jnp.ones((4, 8), jnp.float32))
+    a.map_read()  # device -> host download
+    snap = tel.snapshot()
+    assert snap["counters"]["transfer.d2h_bytes"] == 4 * 8 * 4
+    a.map_read()  # already SYNC: no second transfer
+    assert tel.snapshot()["counters"]["transfer.d2h_calls"] == 1
+
+
+def test_jax_compile_counters(tel):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(x):
+        return x * 3.14159 + 2.71828
+
+    x = jnp.arange(7 * 3, dtype=jnp.float32).reshape(7, 3)
+    fn(x).block_until_ready()
+    snap = tel.snapshot()
+    compiles = snap["counters"].get("jax.backend_compiles", 0)
+    traces = snap["counters"].get("jax.traces", 0)
+    assert compiles >= 1
+    assert traces >= 1
+    assert snap["histograms"]["jax.compile_seconds"]["count"] == compiles
+    fn(x).block_until_ready()  # cache hit: no new compile, no re-trace
+    snap2 = tel.snapshot()
+    assert snap2["counters"]["jax.backend_compiles"] == compiles
+    assert snap2["counters"]["jax.traces"] == traces
+
+
+# -- sync_timings config (was a mutable class global) ------------------------
+
+def test_sync_timings_is_config_backed():
+    assert sync_timings_enabled() is False
+    root.common.timings.sync_each_run = True
+    assert sync_timings_enabled() is True
+    # the conftest autouse fixture restores the flag after this test
+
+
+def test_sync_timings_syncs_device_when_enabled():
+    class FakeDevice(object):
+        syncs = 0
+
+        def sync(self):
+            FakeDevice.syncs += 1
+
+    w = DummyWorkflow()
+    u = Unit(w, name="synced")
+    u.device = FakeDevice()
+    u._fire()
+    assert FakeDevice.syncs == 0
+    root.common.timings.sync_each_run = True
+    u._fire()
+    assert FakeDevice.syncs == 1
+
+
+# -- status server -----------------------------------------------------------
+
+def test_status_server_metrics_endpoint(tel):
+    tel.counter("loader.minibatches").inc(3)
+    server = StatusServer(None, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d/metrics" % server.port
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "znicz_loader_minibatches 3" in text
+    finally:
+        server.stop()
+
+
+def test_status_server_partial_payload_before_initialize():
+    """A workflow queried before initialize() (units missing
+    run_count_/timings) must serve a partial payload, not a 500."""
+    w = DummyWorkflow()
+    u = Unit(w, name="half_built")
+    del u.run_count_
+    del u.run_time_
+    server = StatusServer(w, port=0)
+    st = server.status()  # must not raise
+    assert st["workflow"] == "DummyWorkflow"
+    assert st["run_counts"]["half_built"] == 0
+    assert "unit_timings" in st
+    # a poisoned section is reported, not fatal
+    w.unit_timings = lambda: (_ for _ in ()).throw(RuntimeError("nope"))
+    st = server.status()
+    assert st["workflow"] == "DummyWorkflow"
+    assert "unit_timings" in st["errors"]
+    server2 = StatusServer(w, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d/status.json" % server2.port
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.status == 200
+            json.loads(r.read())
+    finally:
+        server2.stop()
+
+
+# -- multihost aggregation ---------------------------------------------------
+
+def test_merged_snapshot_single_process_is_identity(tel):
+    tel.counter("a.b").inc(2)
+    assert tel.merged_snapshot()["counters"] == {"a.b": 2}
+
+
+def test_merge_telemetry_snapshots_math():
+    s1 = {"counters": {"steps": 10, "bytes": 100},
+          "gauges": {"epoch": 3},
+          "histograms": {"t": {"count": 4, "sum": 2.0, "p50": 0.5}}}
+    s2 = {"counters": {"steps": 12, "bytes": 50},
+          "gauges": {"epoch": 2},
+          "histograms": {"t": {"count": 6, "sum": 3.0, "p50": 0.7}}}
+    m = merge_telemetry_snapshots([s1, s2])
+    assert m["counters"] == {"steps": 22, "bytes": 150}
+    assert m["gauges"] == {"epoch": 3}
+    assert m["histograms"]["t"]["count"] == 10
+    assert m["histograms"]["t"]["sum"] == pytest.approx(5.0)
+    # percentiles come from the FIRST (local) host, flagged as such
+    assert m["histograms"]["t"]["p50"] == 0.5
+    assert m["histograms"]["t"]["percentiles_local_host_only"] is True
+    assert m["hosts"] == 2
+
+
+# -- acceptance: 2-epoch wine run -------------------------------------------
+
+def test_wine_two_epochs_trace_and_metrics(tel, tmp_path):
+    from znicz_tpu.samples import wine
+    root.wine.decision.max_epochs = 2
+    try:
+        wf = wine.run_sample()
+    finally:
+        root.wine.decision.max_epochs = 100
+
+    # Perfetto-valid nested trace: workflow > unit > loader.fill —
+    # validated by the SAME helper the CI smoke uses
+    path = tel.export_trace(str(tmp_path / "wine_trace.json"))
+    doc = json.load(open(path))
+    tel.validate_trace(
+        doc,
+        require_names=("workflow.run", "unit.loader", "loader.fill",
+                       "unit.evaluator", "unit.decision"),
+        require_nested=(("loader.fill", "unit.loader"),
+                        ("unit.loader", "workflow.run")))
+
+    # >= 8 distinct series over the /metrics endpoint
+    server = StatusServer(wf, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d/metrics" % server.port
+        with urllib.request.urlopen(url, timeout=10) as r:
+            text = r.read().decode()
+    finally:
+        server.stop()
+    families = tel.parse_prometheus(text)
+    assert len(families) >= 8, sorted(families)
+    snap = tel.snapshot()
+    assert snap["counters"]["loader.epochs"] == 2
+    assert snap["counters"]["loader.minibatches"] >= \
+        snap["counters"]["loader.epochs"]

@@ -13,6 +13,8 @@ echo "== lint"
 python tools/lint.py
 echo "== cpp"
 make -C cpp -s
+echo "== telemetry smoke (2-epoch wine, trace + /metrics)"
+JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 if [ "$1" = "full" ]; then
     echo "== tests (full lane)"
     python -m pytest tests/ -q

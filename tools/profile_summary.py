@@ -1,22 +1,32 @@
-"""Summarize a JAX/XLA profiler trace into a time-by-op table.
+"""Summarize a profiler trace into a time table.
 
 Usage::
 
-    python tools/profile_summary.py <trace_dir> [top_n]
+    python tools/profile_summary.py <trace_dir> [top_n]      # XLA xplane
+    python tools/profile_summary.py <trace.json> [top_n]     # telemetry
 
-``trace_dir`` is what ``jax.profiler.trace`` (or ``bench.py --profile``)
-wrote; the tool finds the ``*.xplane.pb`` planes, aggregates DEVICE
-event durations by HLO op and by coarse category (convolution / matmul
-/ reduce / elementwise-fusion / copy-transpose / gather-scatter /
-infeed-outfeed / other), and prints a markdown table — the committed
-profile artifact the bench notes reference (VERDICT r3 next #2).
+Two input kinds, dispatched on the argument:
 
-Parsing uses tensorflow's bundled XPlane proto only (no tensorboard
-server needed); the trace itself remains viewable in xprof/tensorboard.
+* a DIRECTORY is what ``jax.profiler.trace`` (or ``bench.py
+  --profile``) wrote; the tool finds the ``*.xplane.pb`` planes,
+  aggregates DEVICE event durations by HLO op and by coarse category
+  (convolution / matmul / reduce / elementwise-fusion / copy-transpose
+  / gather-scatter / infeed-outfeed / other), and prints a markdown
+  table — the committed profile artifact the bench notes reference
+  (VERDICT r3 next #2).  Parsing uses tensorflow's bundled XPlane
+  proto only (no tensorboard server needed); the trace itself remains
+  viewable in xprof/tensorboard.
+
+* a ``.json`` FILE is a Chrome-trace export from the telemetry span
+  tracer (``telemetry.export_trace``); the tool prints the top-N span
+  names by SELF time (wall time minus the time spent in nested child
+  spans on the same thread) — where the host-side control plane
+  actually spends its time.
 """
 
 import collections
 import glob
+import json
 import os
 import sys
 
@@ -142,8 +152,71 @@ def summarize(trace_dir, top_n=25):
     return "\n".join(lines)
 
 
+# -- telemetry Chrome-trace summaries ---------------------------------------
+
+def _span_self_times(events):
+    """{name: [count, total_us, self_us]} over ph="X" events.  Self
+    time = duration minus directly-nested child durations on the same
+    (pid, tid) — computed with an interval stack per thread, the same
+    containment rule Perfetto uses to draw nesting."""
+    by_thread = collections.defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X" and "name" in ev and "ts" in ev:
+            by_thread[(ev.get("pid"), ev.get("tid"))].append(ev)
+    agg = {}
+    for evs in by_thread.values():
+        # by start time; ties (same ts) put the LONGER event first so
+        # the parent is on the stack before its zero-gap child
+        evs.sort(key=lambda e: (float(e["ts"]), -float(e.get("dur", 0))))
+        stack = []  # [end_ts, name, dur, child_dur_sum]
+
+        def pop_one():
+            end, name, dur, child = stack.pop()
+            a = agg.setdefault(name, [0, 0.0, 0.0])
+            a[0] += 1
+            a[1] += dur
+            a[2] += max(0.0, dur - child)
+            if stack:
+                stack[-1][3] += dur
+
+        for ev in evs:
+            ts = float(ev["ts"])
+            dur = float(ev.get("dur", 0))
+            while stack and stack[-1][0] <= ts + 1e-6:
+                pop_one()
+            stack.append([ts + dur, ev["name"], dur, 0.0])
+        while stack:
+            pop_one()
+    return agg
+
+
+def summarize_chrome_trace(path, top_n=25):
+    """Markdown top-N spans by self time for a telemetry trace file."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    agg = _span_self_times(events)
+    if not agg:
+        raise SystemExit("no complete (ph=X) events in %s" % path)
+    total_self = sum(a[2] for a in agg.values()) or 1.0
+    lines = ["trace: %s  (%d spans, %d distinct names)"
+             % (path, sum(a[0] for a in agg.values()), len(agg)), ""]
+    lines.append("| span | runs | total (ms) | self (ms) | self share |")
+    lines.append("|---|---|---|---|---|")
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][2])[:top_n]
+    for name, (count, total, self_t) in rows:
+        lines.append("| `%s` | %d | %.3f | %.3f | %.1f%% |"
+                     % (name[:60], count, total / 1e3, self_t / 1e3,
+                        100.0 * self_t / total_self))
+    return "\n".join(lines)
+
+
 if __name__ == "__main__":
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
-    print(summarize(sys.argv[1],
-                    int(sys.argv[2]) if len(sys.argv) > 2 else 25))
+    target = sys.argv[1]
+    top = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    if os.path.isfile(target) and target.endswith(".json"):
+        print(summarize_chrome_trace(target, top))
+    else:
+        print(summarize(target, top))

@@ -1,0 +1,80 @@
+"""CI smoke: 2-epoch wine sample with telemetry ON — asserts the
+acceptance contract of the telemetry subsystem end to end:
+
+* the exported Chrome-trace JSON parses and carries nested
+  workflow/unit/loader spans (valid ``traceEvents`` schema, loadable
+  in Perfetto),
+* the status server's ``/metrics`` endpoint emits >= 8 distinct
+  series in Prometheus text exposition format,
+* ``tools/profile_summary.py`` summarizes the trace file.
+
+The schema/nesting/exposition checks themselves live in
+``telemetry.validate_trace`` / ``telemetry.parse_prometheus`` — ONE
+definition shared with ``tests/unit/test_telemetry.py`` so the two
+can't drift.  Run by ``tools/ci.sh`` (fast lane).  Exit code 0 = pass.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from znicz_tpu.core.config import root  # noqa: E402
+from znicz_tpu.core import telemetry  # noqa: E402
+from znicz_tpu.core.status_server import StatusServer  # noqa: E402
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="telemetry_smoke_")
+    root.common.dirs.snapshots = os.path.join(tmp, "snapshots")
+    telemetry.enable()
+    telemetry.reset()
+
+    from znicz_tpu.samples import wine
+    root.wine.decision.max_epochs = 2
+    wf = wine.run_sample()
+
+    # -- trace file: valid traceEvents schema, nested spans -------------
+    trace_path = telemetry.export_trace(os.path.join(tmp, "trace.json"))
+    with open(trace_path) as f:
+        doc = json.load(f)
+    events = telemetry.validate_trace(
+        doc,
+        require_names=("workflow.run", "unit.loader", "loader.fill",
+                       "unit.decision"),
+        require_nested=(("loader.fill", "unit.loader"),
+                        ("unit.loader", "workflow.run")))
+
+    # -- /metrics: >= 8 series in Prometheus text format ----------------
+    server = StatusServer(wf, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d/metrics" % server.port
+        with urllib.request.urlopen(url, timeout=10) as r:
+            text = r.read().decode()
+    finally:
+        server.stop()
+    families = telemetry.parse_prometheus(text)
+    assert len(families) >= 8, \
+        "only %d series families: %s" % (len(families),
+                                         sorted(families))
+
+    # -- profile_summary over the trace ---------------------------------
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import profile_summary
+    table = profile_summary.summarize_chrome_trace(trace_path, 10)
+    assert "unit.loader" in table
+
+    print("telemetry smoke OK: %d trace events, %d metric families"
+          % (len(events), len(families)))
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,17 @@ root.common.update({
         "cache": "/root/repo/.cache",
     },
     "disable": {"plotting": True, "publishing": True},
+    # unified telemetry (core/telemetry.py) — off by default so every
+    # instrumented hot path reduces to a guard-only no-op
+    "telemetry": {
+        "enabled": False,
+        "trace_capacity": 65536,    # span ring-buffer size (events)
+        "histogram_window": 2048,   # percentile reservoir per series
+    },
+    # engine timing behavior (was the mutable class global
+    # Unit.sync_timings; config-backed so tests can't leak
+    # blocking-sync mode into the rest of the suite)
+    "timings": {"sync_each_run": False},
 })
 
 

@@ -16,6 +16,8 @@ States:
 
 import numpy
 
+from znicz_tpu.core import telemetry
+
 HOST, DEV, SYNC = "host", "dev", "sync"
 
 
@@ -56,6 +58,8 @@ class Array(object):
         if self._state == DEV:
             self._host = numpy.asarray(self._dev)
             self._state = SYNC
+            if telemetry.enabled():
+                telemetry.add_bytes("d2h", self._host.nbytes)
         return self._host
 
     @mem.setter
@@ -72,6 +76,8 @@ class Array(object):
         if self._state == DEV:
             self._host = numpy.asarray(self._dev)
             self._state = SYNC
+            if telemetry.enabled():
+                telemetry.add_bytes("d2h", self._host.nbytes)
         return self
 
     def map_write(self):
@@ -120,6 +126,8 @@ class Array(object):
                 host = numpy.array(host)
             self._dev = jax.device_put(host)
             self._state = SYNC
+            if telemetry.enabled():
+                telemetry.add_bytes("h2d", host.nbytes)
         return self._dev
 
     def set_dev(self, arr):

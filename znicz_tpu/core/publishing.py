@@ -16,6 +16,7 @@ import time
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core.units import Unit
+from znicz_tpu.core import telemetry
 
 
 class Publisher(Unit):
@@ -94,6 +95,11 @@ class Publisher(Unit):
             plot_dir = os.path.join(root.common.dirs.cache, "plots")
             report["plots"] = sorted(glob.glob(
                 os.path.join(plot_dir, "*.png")))
+        if telemetry.enabled():
+            # multi-host runs publish ONE merged view (process 0 is
+            # the writer; merged_snapshot is collective and must run
+            # on every host of the gang)
+            report["telemetry"] = telemetry.merged_snapshot()
         self.report = report
         return report
 
@@ -137,6 +143,19 @@ class Publisher(Unit):
             lines += ["| %s | %s | %s |" % (r["unit"], r["seconds"],
                                             r["runs"])
                       for r in report["unit_timings"][:20]]
+            lines.append("")
+        tel = report.get("telemetry")
+        if tel:
+            lines += ["## Telemetry", "",
+                      "| series | value |", "|---|---|"]
+            for k, v in sorted(tel.get("counters", {}).items()):
+                lines.append("| %s | %s |" % (k, v))
+            for k, v in sorted(tel.get("gauges", {}).items()):
+                lines.append("| %s | %s |" % (k, v))
+            for k, h in sorted(tel.get("histograms", {}).items()):
+                lines.append(
+                    "| %s | n=%s p50=%s p99=%s |"
+                    % (k, h.get("count"), h.get("p50"), h.get("p99")))
             lines.append("")
         if report["plots"]:
             lines += ["## Plots", ""]

@@ -27,6 +27,7 @@ import time
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core.config import root
 from znicz_tpu.core.memory import Array
+from znicz_tpu.core import telemetry
 
 import numpy
 
@@ -76,9 +77,28 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         if time.time() - self._last_time < self.time_interval:
             return
         self._last_time = time.time()
-        self.export()
+        if not telemetry.enabled():
+            self.export()
+            return
+        t0 = time.perf_counter()
+        with telemetry.span("snapshotter.export", prefix=self.prefix):
+            wrote = self.export()
+        # the series are created on EVERY rank (registries must stay
+        # SPMD-identical or cross-host aggregation refuses to merge)
+        # but recorded only for actual writes: export() returns the
+        # written path, None when it skipped (non-zero ranks of a
+        # multi-host gang) — merged counters must not multiply one
+        # snapshot by process_count
+        exports = telemetry.counter("snapshotter.exports")
+        seconds = telemetry.histogram("snapshotter.export_seconds")
+        if wrote:
+            exports.inc()
+            seconds.observe(time.perf_counter() - t0)
 
     def export(self):
+        """Write a snapshot; return the destination path, or None when
+        this process skipped the write (telemetry counts only actual
+        writes)."""
         raise NotImplementedError
 
     # -- state collection ---------------------------------------------------
@@ -117,7 +137,7 @@ class SnapshotterToFile(SnapshotterBase):
             # 0) is sufficient AND necessary (concurrent writers would
             # race on the same prefix); every process restores from the
             # shared directory on resume
-            return
+            return None
         payload = {
             "format": 1,
             "workflow": type(self.workflow).__name__,
@@ -142,6 +162,7 @@ class SnapshotterToFile(SnapshotterBase):
             pickle.dump(payload, f, protocol=4)
         os.replace(tmp, self.destination)
         self.info("snapshot -> %s", self.destination)
+        return self.destination
 
     @staticmethod
     def import_(file_name):
@@ -163,4 +184,4 @@ class SnapshotterToDB(SnapshotterBase):
     MAPPING = "odbc"
 
     def export(self):  # pragma: no cover - parity stub
-        SnapshotterToFile.export(self)
+        return SnapshotterToFile.export(self)

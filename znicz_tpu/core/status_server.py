@@ -6,6 +6,8 @@ a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
 
 * ``/``            — a small auto-refreshing HTML dashboard,
 * ``/status.json`` — workflow status (units, metrics, timings),
+* ``/metrics``     — the telemetry registry in Prometheus text
+  exposition format (core/telemetry.py; scrape it),
 * ``/plots/``      — the pngs the plotters render into <cache>/plots.
 
 Usage::
@@ -23,6 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import telemetry
 
 _PAGE = """<html><head><title>znicz_tpu status</title>
 <meta http-equiv="refresh" content="5"></head>
@@ -45,27 +48,55 @@ class StatusServer(Logger):
 
     # -- status payload -----------------------------------------------------
     def status(self):
+        """Status dict — TOLERANT of a workflow queried before (or
+        mid-) ``initialize()``: units may lack ``run_count_``/timing
+        attributes, the decision may be half-built.  Every section is
+        gathered independently; a failing section lands in
+        ``payload["errors"]`` instead of turning the whole endpoint
+        into a 500 (the dashboard polls from the first second of a
+        run)."""
         wf = self.workflow
-        payload = {"workflow": None}
+        payload = {"workflow": None, "errors": {}}
         if wf is not None:
-            payload = {
-                "workflow": type(wf).__name__,
-                "units": [u.name for u in wf.units],
-                "run_counts": {u.name: u.run_count_ for u in wf.units},
-            }
-            decision = getattr(wf, "decision", None)
-            if decision is not None:
-                for attr in ("epoch_number", "complete",
-                             "best_n_err_pt", "epoch_n_err_pt"):
-                    v = getattr(decision, attr, None)
-                    if v is not None:
-                        payload[attr] = _plain(v)
-            if hasattr(wf, "unit_timings"):
-                payload["unit_timings"] = [
-                    {"unit": u.name, "seconds": round(t, 4), "runs": n}
-                    for u, t, n in wf.unit_timings()]
-        payload["plots"] = [os.path.basename(p)
-                            for p in self._plot_files()]
+            payload["workflow"] = type(wf).__name__
+            try:
+                units = list(wf.units)
+                payload["units"] = [getattr(u, "name", repr(u))
+                                    for u in units]
+                payload["run_counts"] = {
+                    getattr(u, "name", repr(u)):
+                        int(getattr(u, "run_count_", 0) or 0)
+                    for u in units}
+            except Exception as e:  # noqa: BLE001 - partial payload
+                payload["errors"]["units"] = repr(e)
+            try:
+                decision = getattr(wf, "decision", None)
+                if decision is not None:
+                    for attr in ("epoch_number", "complete",
+                                 "best_n_err_pt", "epoch_n_err_pt"):
+                        v = getattr(decision, attr, None)
+                        if v is not None:
+                            payload[attr] = _plain(v)
+            except Exception as e:  # noqa: BLE001 - partial payload
+                payload["errors"]["decision"] = repr(e)
+            try:
+                if hasattr(wf, "unit_timings"):
+                    payload["unit_timings"] = [
+                        {"unit": u.name, "seconds": round(t, 4),
+                         "runs": n}
+                        for u, t, n in wf.unit_timings()]
+            except Exception as e:  # noqa: BLE001 - partial payload
+                payload["errors"]["unit_timings"] = repr(e)
+        try:
+            payload["plots"] = [os.path.basename(p)
+                                for p in self._plot_files()]
+        except Exception as e:  # noqa: BLE001 - partial payload
+            payload["plots"] = []
+            payload["errors"]["plots"] = repr(e)
+        if telemetry.enabled():
+            payload["telemetry"] = telemetry.snapshot()
+        if not payload["errors"]:
+            del payload["errors"]
         return payload
 
     @staticmethod
@@ -89,6 +120,11 @@ class StatusServer(Logger):
                     elif self.path == "/status.json":
                         self._send(200, "application/json", json.dumps(
                             server.status(), default=str).encode())
+                    elif self.path == "/metrics":
+                        self._send(
+                            200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            telemetry.prometheus_text().encode())
                     elif self.path.startswith("/plots/"):
                         name = os.path.basename(self.path)
                         path = os.path.join(root.common.dirs.cache,

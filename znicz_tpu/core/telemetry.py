@@ -1,0 +1,661 @@
+"""Unified telemetry — span tracing, metrics registry, JAX-aware counters.
+
+The reference Veles core shipped live observability as a first-class
+tier (SURVEY.md §5.5: web status + plot streaming); znicz_tpu's tier-2
+equivalent is this module, shared by the trainer, the loaders, the
+snapshotter, ``bench.py`` and the status server.  Three pillars:
+
+* **Span tracer** — nestable ``with telemetry.span("name", **attrs):``
+  blocks record complete events into a bounded ring buffer;
+  :func:`export_trace` writes Chrome-trace/Perfetto JSON
+  (``traceEvents`` schema — load it at https://ui.perfetto.dev).
+  Nesting needs no explicit stack: Perfetto nests same-thread events
+  by time containment.
+* **Metrics registry** — process-global :func:`counter` /
+  :func:`gauge` / :func:`histogram` series.  :func:`prometheus_text`
+  renders the Prometheus text exposition (served at ``/metrics`` by
+  :class:`znicz_tpu.core.status_server.StatusServer`);
+  :func:`snapshot` returns the JSON view merged into Publisher
+  reports and ``bench.py`` output.
+* **JAX-aware counters** — ``jax.monitoring`` listeners count backend
+  compiles (`jax.backend_compiles` + `jax.compile_seconds`), jaxpr
+  traces (`jax.traces` — a re-trace on every dispatch means the jit
+  cache is MISSING; steady counters with growing step counts mean
+  cache hits), and persistent-compilation-cache hits/misses.
+  Host↔device traffic is metered where it actually happens —
+  ``memory.Array`` map_read/dev (`transfer.d2h_bytes` /
+  `transfer.h2d_bytes`).
+
+Disabled-by-default fast path: everything is gated on
+``root.common.telemetry.enabled``.  When off, :func:`span` returns one
+shared no-op context manager and :func:`counter`/:func:`gauge`/
+:func:`histogram` return one shared null metric — no events, no
+registry entries, no allocation.  Hot call sites additionally guard
+with ``if telemetry.enabled():`` so the disabled cost is a single
+predicate.
+
+Multi-host: every process keeps its own registry;
+:func:`merged_snapshot` reduces all hosts' counters into one view
+through :func:`znicz_tpu.parallel.multihost.aggregate_telemetry`.
+"""
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+from znicz_tpu.core.config import root
+
+logger = logging.getLogger("telemetry")
+
+#: the config node (object identity is stable: config.py creates it at
+#: import and Config merges dict assignments into the existing node)
+_cfg = root.common.telemetry
+
+#: trace time origin — spans are stamped relative to module import so
+#: timestamps stay small (Chrome trace ts/dur are microseconds)
+_T0 = time.perf_counter()
+
+_lock = threading.Lock()
+
+
+def enabled():
+    """The one gate every hook checks.  Reads the live config value so
+    flipping ``root.common.telemetry.enabled`` mid-run takes effect
+    immediately (the status server can watch a run that enables
+    tracing for one epoch).  The first enabled check also installs the
+    jax.monitoring listeners — deferring the (heavy) jax import out of
+    module import keeps telemetry-importing tools jax-free until
+    telemetry is actually turned on."""
+    if _cfg.get("enabled", False):
+        if not _jax_hooked:
+            install_jax_hooks()
+        return True
+    return False
+
+
+def enable():
+    root.common.telemetry.enabled = True
+    install_jax_hooks()
+    return True
+
+
+def disable():
+    root.common.telemetry.enabled = False
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Span tracer
+# ---------------------------------------------------------------------------
+
+class _NullSpan(object):
+    """Shared no-op context manager — the disabled-mode span."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Ring(object):
+    """Bounded trace-event buffer (oldest events drop first)."""
+
+    def __init__(self):
+        self._events = None
+        self.dropped = 0
+
+    def _buf(self):
+        if self._events is None:
+            cap = int(_cfg.get("trace_capacity", 65536))
+            self._events = collections.deque(maxlen=cap)
+        return self._events
+
+    def append(self, ev):
+        buf = self._buf()
+        if len(buf) == buf.maxlen:
+            self.dropped += 1
+        buf.append(ev)
+
+    def clear(self):
+        self._events = None
+        self.dropped = 0
+
+    def __len__(self):
+        return 0 if self._events is None else len(self._events)
+
+    def events(self):
+        return [] if self._events is None else list(self._events)
+
+
+_ring = _Ring()
+
+
+class _Span(object):
+    """A live span: records one Chrome-trace complete ("X") event on
+    exit.  Exceptions propagate; the span still closes (the trace shows
+    where the run died)."""
+
+    __slots__ = ("name", "args", "t0")
+
+    def __init__(self, name, args):
+        self.name = name
+        self.args = args or None
+        self.t0 = None
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        _ring.append(("X", self.name, (self.t0 - _T0) * 1e6,
+                      (t1 - self.t0) * 1e6, threading.get_ident(),
+                      self.args))
+        return False
+
+
+def span(name, **attrs):
+    """``with telemetry.span("loader.fill", size=n):`` — a nestable
+    traced region.  Returns the shared no-op when telemetry is off."""
+    if not enabled():
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+def instant(name, **attrs):
+    """A zero-duration marker event (epoch boundaries etc.)."""
+    if not enabled():
+        return
+    _ring.append(("i", name, (time.perf_counter() - _T0) * 1e6, 0.0,
+                  threading.get_ident(), attrs or None))
+
+
+def _process_index():
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def trace_events():
+    """The buffered events as Chrome-trace dicts."""
+    pid = _process_index()
+    out = []
+    for ph, name, ts, dur, tid, args in _ring.events():
+        ev = {"name": name, "ph": ph, "ts": round(ts, 3), "pid": pid,
+              "tid": tid, "cat": "znicz"}
+        if ph == "X":
+            ev["dur"] = round(dur, 3)
+        elif ph == "i":
+            ev["s"] = "t"
+        if args:
+            ev["args"] = args
+        out.append(ev)
+    return out
+
+
+def export_trace(path):
+    """Write the ring buffer as Chrome-trace/Perfetto JSON and return
+    the path.  Loadable by chrome://tracing and ui.perfetto.dev."""
+    events = trace_events()
+    payload = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "znicz_tpu.telemetry",
+            "process_index": _process_index(),
+            "dropped_events": _ring.dropped,
+        },
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, default=str)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+class _NullMetric(object):
+    """Shared do-nothing metric — what the factories hand out when
+    telemetry is disabled (no registry entry is created)."""
+
+    __slots__ = ()
+
+    def inc(self, n=1):
+        pass
+
+    def set(self, value):
+        pass
+
+    def observe(self, value, count=1):
+        pass
+
+    @property
+    def value(self):
+        return 0
+
+
+_NULL_METRIC = _NullMetric()
+
+
+class Counter(object):
+    """Monotonic counter."""
+
+    kind = "counter"
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge(object):
+    """Last-write-wins instantaneous value."""
+
+    kind = "gauge"
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0.0
+
+    def set(self, value):
+        self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+
+#: default histogram bucket upper bounds — log-spaced seconds, wide
+#: enough for sub-ms jitted steps and minute-scale compiles
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                   0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0)
+
+
+class Histogram(object):
+    """Cumulative-bucket histogram + a bounded reservoir of recent
+    observations for percentile queries.
+
+    ``observe(v, count=k)`` records ``k`` occurrences of ``v`` in one
+    call (the fused window path reports its per-step average once per
+    window, weighted by the window's step count).  The reservoir gets
+    ``min(k, 256)`` copies so percentile queries stay count-weighted —
+    a 1-step epoch-tail window must not weigh as much as a 40-step
+    one."""
+
+    kind = "histogram"
+
+    def __init__(self, name, buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self._bucket_counts = [0] * (len(self.buckets) + 1)  # +Inf last
+        self._count = 0
+        self._sum = 0.0
+        window = int(_cfg.get("histogram_window", 2048))
+        self._recent = collections.deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def observe(self, value, count=1):
+        value = float(value)
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._bucket_counts[i] += count
+            self._count += count
+            self._sum += value * count
+            if count == 1:
+                self._recent.append(value)
+            else:
+                self._recent.extend([value] * min(int(count), 256))
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def percentile(self, p):
+        """p in [0, 100] over the bounded reservoir of recent
+        observations (None when empty)."""
+        with self._lock:
+            data = sorted(self._recent)
+        if not data:
+            return None
+        k = max(0, min(len(data) - 1,
+                       int(round(p / 100.0 * (len(data) - 1)))))
+        return data[k]
+
+    def stats(self):
+        with self._lock:
+            data = sorted(self._recent)
+            count, total = self._count, self._sum
+        st = {"count": count, "sum": round(total, 6)}
+        if data:
+            n = len(data)
+
+            def q(p):
+                return data[max(0, min(n - 1,
+                                       int(round(p / 100.0 * (n - 1)))))]
+
+            st.update({"min": data[0], "max": data[-1],
+                       "p50": q(50), "p90": q(90), "p99": q(99)})
+        return st
+
+
+_metrics = {}
+
+
+def _get_metric(name, factory):
+    if not enabled():
+        return _NULL_METRIC
+    m = _metrics.get(name)
+    if m is None:
+        with _lock:
+            m = _metrics.get(name)
+            if m is None:
+                m = factory(name)
+                _metrics[name] = m
+    return m
+
+
+def counter(name):
+    """Get-or-create the named counter (null metric when disabled)."""
+    return _get_metric(name, Counter)
+
+
+def gauge(name):
+    return _get_metric(name, Gauge)
+
+
+def histogram(name, buckets=DEFAULT_BUCKETS):
+    return _get_metric(name, lambda n: Histogram(n, buckets))
+
+
+def add_bytes(direction, nbytes):
+    """Host↔device transfer meter (``direction`` is "d2h" or "h2d").
+    Call sites guard with :func:`enabled` so the disabled path never
+    computes nbytes."""
+    counter("transfer.%s_bytes" % direction).inc(int(nbytes))
+    counter("transfer.%s_calls" % direction).inc()
+
+
+def reset():
+    """Drop all metrics and trace events (tests, bench isolation)."""
+    with _lock:
+        _metrics.clear()
+        _ring.clear()
+
+
+# ---------------------------------------------------------------------------
+# Export: snapshot / Prometheus exposition / bench summary
+# ---------------------------------------------------------------------------
+
+def snapshot():
+    """JSON-able view of every registered metric."""
+    with _lock:
+        metrics = list(_metrics.values())
+    snap = {"counters": {}, "gauges": {}, "histograms": {}}
+    for m in metrics:
+        if m.kind == "counter":
+            snap["counters"][m.name] = m.value
+        elif m.kind == "gauge":
+            snap["gauges"][m.name] = m.value
+        else:
+            snap["histograms"][m.name] = m.stats()
+    snap["trace"] = {"buffered_events": len(_ring),
+                     "dropped_events": _ring.dropped}
+    return snap
+
+
+def merged_snapshot():
+    """:func:`snapshot`, reduced across hosts on multi-process runs
+    (one merged view per the SPMD gang; identity single-process)."""
+    snap = snapshot()
+    try:
+        import jax
+        if jax.process_count() > 1:
+            from znicz_tpu.parallel import multihost
+            snap = multihost.aggregate_telemetry(snap)
+    except Exception as e:  # noqa: BLE001 - report local rather than die
+        logger.warning("telemetry aggregation failed (%s); "
+                       "reporting local host only", e)
+    return snap
+
+
+def _prom_name(name):
+    """Sanitize a dotted series name into Prometheus [a-zA-Z0-9_:]."""
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() and ch.isascii()) or ch in "_:"
+                   else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return "znicz_" + s
+
+
+def prometheus_text():
+    """Prometheus text exposition (format version 0.0.4) of the whole
+    registry — what ``/metrics`` serves."""
+    with _lock:
+        metrics = sorted(_metrics.values(), key=lambda m: m.name)
+    lines = []
+    for m in metrics:
+        name = _prom_name(m.name)
+        if m.kind == "counter":
+            lines.append("# TYPE %s counter" % name)
+            lines.append("%s %s" % (name, m.value))
+        elif m.kind == "gauge":
+            lines.append("# TYPE %s gauge" % name)
+            lines.append("%s %s" % (name, _fmt(m.value)))
+        else:
+            lines.append("# TYPE %s histogram" % name)
+            # consistent point-in-time view: a scrape racing observe()
+            # must never emit +Inf bucket != count (the Prometheus
+            # histogram invariant recording rules rely on)
+            with m._lock:
+                bucket_counts = list(m._bucket_counts)
+                total, count = m._sum, m._count
+            acc = 0
+            for bound, c in zip(m.buckets, bucket_counts):
+                acc += c
+                lines.append('%s_bucket{le="%s"} %d'
+                             % (name, _fmt(bound), acc))
+            acc += bucket_counts[-1]
+            lines.append('%s_bucket{le="+Inf"} %d' % (name, acc))
+            lines.append("%s_sum %s" % (name, _fmt(total)))
+            lines.append("%s_count %d" % (name, count))
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(v):
+    """Float formatting without exponent-capital quirks ('1e-05' style
+    is valid Prometheus; plain repr is fine)."""
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def summary():
+    """The compact why-block bench.py stamps into its JSON: compile
+    count, transfer bytes, step-time percentiles."""
+    snap = snapshot()
+    c = snap["counters"]
+    h = snap["histograms"]
+    out = {
+        "backend_compiles": int(c.get("jax.backend_compiles", 0)),
+        "jaxpr_traces": int(c.get("jax.traces", 0)),
+        "d2h_bytes": int(c.get("transfer.d2h_bytes", 0)),
+        "h2d_bytes": int(c.get("transfer.h2d_bytes", 0)),
+    }
+    cs = h.get("jax.compile_seconds")
+    if cs:
+        out["compile_seconds_total"] = round(cs.get("sum", 0.0), 3)
+    steps = h.get("trainer.step_seconds") or h.get("unit.run_seconds")
+    if steps and steps.get("count"):
+        out["step_seconds"] = {
+            "count": steps["count"],
+            "p50": steps.get("p50"),
+            "p99": steps.get("p99"),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Self-check validators (shared by tests, the CI smoke, and users
+# wiring scrapers/trace viewers — one definition of "valid")
+# ---------------------------------------------------------------------------
+
+def validate_trace(doc, require_names=(), require_nested=()):
+    """Validate a Chrome-trace document (the dict ``export_trace``
+    wrote, already json-loaded) and return its event list.
+
+    * every event must carry the ``traceEvents`` schema fields
+      (name/ph/ts, dur for complete events);
+    * ``require_names`` — span names that must be present;
+    * ``require_nested`` — (child, parent) name pairs: every child
+      span must lie within some parent span on the timeline (the
+      containment rule Perfetto nests by).
+
+    Raises ``ValueError`` on any violation.
+    """
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        raise ValueError("missing or empty traceEvents")
+    names = set()
+    for ev in events:
+        if ev.get("ph") not in ("X", "i"):
+            raise ValueError("unexpected event phase: %r" % (ev,))
+        if not isinstance(ev.get("ts"), (int, float)) or "name" not in ev:
+            raise ValueError("malformed event: %r" % (ev,))
+        if ev["ph"] == "X" and not isinstance(ev.get("dur"),
+                                              (int, float)):
+            raise ValueError("complete event without dur: %r" % (ev,))
+        names.add(ev["name"])
+    missing = set(require_names) - names
+    if missing:
+        raise ValueError("missing spans %s (have %s)"
+                         % (sorted(missing), sorted(names)))
+    for child, parent in require_nested:
+        spans = [(e["ts"], e["ts"] + e["dur"]) for e in events
+                 if e["name"] == parent and e["ph"] == "X"]
+        kids = [e for e in events
+                if e["name"] == child and e["ph"] == "X"]
+        if not kids:
+            raise ValueError("no %r spans to nest-check" % child)
+        for ev in kids:
+            t0, t1 = ev["ts"], ev["ts"] + ev["dur"]
+            if not any(a - 1e-3 <= t0 and t1 <= b + 1e-3
+                       for a, b in spans):
+                raise ValueError("%r span at ts=%s not nested in any "
+                                 "%r span" % (child, ev["ts"], parent))
+    return events
+
+
+#: one Prometheus sample line: name{labels} value
+_PROM_SAMPLE_RE = None
+
+
+def parse_prometheus(text):
+    """Validate Prometheus text exposition; return {family: type}.
+    Raises ``ValueError`` on a malformed sample line."""
+    import re
+    global _PROM_SAMPLE_RE
+    if _PROM_SAMPLE_RE is None:
+        _PROM_SAMPLE_RE = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? [0-9eE+.-]+$")
+    families = {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, fam, kind = line.split()
+            families[fam] = kind
+        elif line.startswith("#") or not line:
+            continue
+        elif not _PROM_SAMPLE_RE.match(line):
+            raise ValueError("bad exposition line: %r" % line)
+    return families
+
+
+# ---------------------------------------------------------------------------
+# JAX-aware counters (jax.monitoring listeners)
+# ---------------------------------------------------------------------------
+
+_jax_hooked = False
+
+#: substring → our counter name for discrete jax.monitoring events
+_JAX_EVENT_COUNTERS = (
+    ("/jax/compilation_cache/cache_hits", "jax.persistent_cache_hits"),
+    ("/jax/compilation_cache/cache_misses",
+     "jax.persistent_cache_misses"),
+)
+
+
+def _on_jax_event(event, **kwargs):
+    if not enabled():
+        return
+    for needle, name in _JAX_EVENT_COUNTERS:
+        if needle in event:
+            counter(name).inc()
+            return
+
+
+def _on_jax_duration(event, duration_secs, **kwargs):
+    if not enabled():
+        return
+    if "backend_compile" in event:
+        counter("jax.backend_compiles").inc()
+        histogram("jax.compile_seconds").observe(duration_secs)
+    elif "jaxpr_trace" in event:
+        counter("jax.traces").inc()
+        histogram("jax.trace_seconds").observe(duration_secs)
+
+
+def install_jax_hooks():
+    """Register the jax.monitoring listeners (idempotent; tolerant of
+    a jax-free interpreter so config-only tools can import this
+    module).  The callbacks early-return when telemetry is off, so the
+    standing cost is one predicate per compile/trace event."""
+    global _jax_hooked
+    if _jax_hooked:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover - jax is a baked-in dep
+        return False
+    with _lock:
+        # re-check under the lock: the status-server thread and the
+        # main thread can both see the first enabled() == True, and
+        # jax.monitoring has no listener dedup — a double registration
+        # would double-count every compile for the process lifetime
+        if _jax_hooked:
+            return True
+        monitoring.register_event_listener(_on_jax_event)
+        monitoring.register_event_duration_secs_listener(_on_jax_duration)
+        _jax_hooked = True
+    return True

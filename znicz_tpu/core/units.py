@@ -20,17 +20,25 @@ is cheap at that cadence and semantically identical to the reference.
 
 import time
 
+from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core import telemetry
+
+
+def sync_timings_enabled():
+    """Sync the device after each run() so run_time_ measures compute,
+    not async dispatch.  Config-backed (``root.common.timings.
+    sync_each_run``, default off — it serializes the pipeline; turn on
+    when profiling with Workflow.log_unit_timings).  Was the mutable
+    class global ``Unit.sync_timings``: a test flipping that leaked
+    blocking-sync mode into every later test, while config is
+    restored by the harness (tests/conftest.py)."""
+    return bool(root.common.timings.get("sync_each_run", False))
 
 
 class Unit(Logger):
     """A node in the control-plane dataflow graph."""
-
-    #: class-wide switch: sync the device after each run() so run_time_
-    #: measures compute, not async dispatch.  Off by default (it serializes
-    #: the pipeline); turn on when profiling with Workflow.log_unit_timings.
-    sync_timings = False
 
     def __init__(self, workflow, **kwargs):
         self.name = kwargs.get("name", type(self).__name__)
@@ -162,18 +170,34 @@ class Unit(Logger):
             return  # consume the signal
         if not bool(self.gate_skip):
             t0 = time.perf_counter()
-            self.run()
-            if Unit.sync_timings:
-                # device work is dispatched async: without a sync, compute
-                # time lands on whichever later unit blocks (map_read)
-                device = getattr(self, "device", None)
-                if device is not None and hasattr(device, "sync"):
-                    device.sync()
-            self.run_time_ += time.perf_counter() - t0
+            if telemetry.enabled():
+                # the sync stays INSIDE the span so the trace and
+                # run_time_/unit.run_seconds agree about the same fire
+                with telemetry.span("unit." + self.name,
+                                    cls=type(self).__name__):
+                    self.run()
+                    self._sync_device_for_timings()
+            else:
+                self.run()
+                self._sync_device_for_timings()
+            dt = time.perf_counter() - t0
+            self.run_time_ += dt
             self.run_count_ += 1
             self.run_was_called = True
+            if telemetry.enabled():
+                telemetry.counter("unit.runs").inc()
+                telemetry.histogram("unit.run_seconds").observe(dt)
         for dst in list(self._links_to):
             dst._signal(self)
+
+    def _sync_device_for_timings(self):
+        """Blocking-sync timing mode (sync_timings_enabled): device
+        work is dispatched async, so without a sync compute time lands
+        on whichever later unit blocks (map_read)."""
+        if sync_timings_enabled():
+            device = getattr(self, "device", None)
+            if device is not None and hasattr(device, "sync"):
+                device.sync()
 
     # -- lifecycle ------------------------------------------------------------
     @property

@@ -15,6 +15,7 @@ from collections import deque
 
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core import prng as random_generator
+from znicz_tpu.core import telemetry
 
 
 class NoMoreJobs(Exception):
@@ -169,17 +170,22 @@ class Workflow(Unit):
         self._queue.append(unit)
 
     def run(self):
-        """Run the dataflow until quiescence or end_point."""
+        """Run the dataflow until quiescence or end_point.  Each
+        scheduled unit's run() is span-traced by the engine
+        (core/units.py _fire) under this workflow-level span."""
         self._running = True
         self._stopped_by_end_point = False
         self._queue.clear()
         for u in self._units:
             u._reset_fired()
         self._schedule(self.start_point)
+        if telemetry.enabled():
+            telemetry.counter("workflow.runs").inc()
         try:
-            while self._queue and self._running:
-                unit = self._queue.popleft()
-                unit._fire()
+            with telemetry.span("workflow.run", workflow=self.name):
+                while self._queue and self._running:
+                    unit = self._queue.popleft()
+                    unit._fire()
         except NoMoreJobs:
             pass
         self._running = False
@@ -254,10 +260,12 @@ class Workflow(Unit):
 
         NOTE: device work is dispatched asynchronously, so by default a
         unit's time covers dispatch only and compute lands on whichever
-        unit blocks first (map_read).  Set ``Unit.sync_timings = True``
-        before the run to charge compute to the unit that issued it."""
-        rows = [(u, u.run_time_, u.run_count_) for u in self._units
-                if u.run_count_]
+        unit blocks first (map_read).  Set
+        ``root.common.timings.sync_each_run = True`` before the run to
+        charge compute to the unit that issued it."""
+        rows = [(u, getattr(u, "run_time_", 0.0),
+                 getattr(u, "run_count_", 0)) for u in self._units
+                if getattr(u, "run_count_", 0)]
         rows.sort(key=lambda r: -r[1])
         return rows
 

@@ -32,6 +32,7 @@ from znicz_tpu.core.units import Unit
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core import prng
+from znicz_tpu.core import telemetry
 from znicz_tpu.core.config import root
 
 TEST, VALID, TRAIN = 0, 1, 2
@@ -260,8 +261,16 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         idx = self.minibatch_indices.mem
         idx[:n] = sel
         idx[n:] = -1
+        traced = telemetry.enabled()
+        if traced:
+            telemetry.counter("loader.minibatches").inc()
         if not (self.skip_fill and clazz == TRAIN):
-            self.fill_minibatch()
+            if traced:
+                with telemetry.span("loader.fill", size=int(n),
+                                    clazz=CLASS_NAME[clazz]):
+                    self.fill_minibatch()
+            else:
+                self.fill_minibatch()
             if n < self.max_minibatch_size:
                 self.minibatch_labels.map_write()
                 self.minibatch_labels.mem[n:] = -1
@@ -278,6 +287,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
 
         if epoch_done:
             self.epoch_number += 1
+            if telemetry.enabled():
+                telemetry.counter("loader.epochs").inc()
+                telemetry.instant("loader.epoch_end",
+                                  epoch=self.epoch_number)
             self._segment = 0
             self._offset_in_class = 0
             self._global_offset = 0

@@ -178,6 +178,115 @@ def global_batch(mesh, local_x, local_labels):
     return x, labels
 
 
+# -- telemetry aggregation ---------------------------------------------------
+
+def _flatten_telemetry(snap):
+    """Deterministic (kind, name) -> float flattening of the numeric
+    parts of a telemetry snapshot.  SPMD gangs run the same program, so
+    every host produces the same key list — verified by the caller."""
+    items = []
+    for kind in ("counters", "gauges"):
+        for k in sorted(snap.get(kind, {})):
+            items.append((kind, k, float(snap[kind][k])))
+    for k in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][k]
+        items.append(("hist_count", k, float(h.get("count", 0))))
+        items.append(("hist_sum", k, float(h.get("sum", 0.0))))
+    return items
+
+
+def merge_telemetry_snapshots(snaps):
+    """Merge per-host telemetry snapshots into one view: counters and
+    histogram count/sum are SUMMED, gauges take the MAX (a summed
+    "loader.epoch" gauge would be nonsense).  Histogram percentiles
+    are kept from the FIRST snapshot (this host) and flagged — exact
+    cross-host percentile merge would need the raw reservoirs over
+    DCN, which the counters' one-allgather budget doesn't buy."""
+    if not snaps:
+        return {}
+    merged = {"counters": {}, "gauges": {}, "histograms": {}}
+    for kind, agg in (("counters", sum), ("gauges", max)):
+        keys = set()
+        for s in snaps:
+            keys.update(s.get(kind, {}))
+        for k in sorted(keys):
+            vals = [s.get(kind, {}).get(k, 0) for s in snaps]
+            v = agg(vals)
+            merged[kind][k] = int(v) if kind == "counters" else v
+    hkeys = set()
+    for s in snaps:
+        hkeys.update(s.get("histograms", {}))
+    for k in sorted(hkeys):
+        hs = [s.get("histograms", {}).get(k) or {} for s in snaps]
+        h = dict(hs[0])
+        h["count"] = int(sum(x.get("count", 0) for x in hs))
+        h["sum"] = float(sum(x.get("sum", 0.0) for x in hs))
+        if any(x.get("count") for x in hs[1:]):
+            h["percentiles_local_host_only"] = True
+        merged["histograms"][k] = h
+    merged["hosts"] = len(snaps)
+    return merged
+
+
+def aggregate_telemetry(snap):
+    """Reduce every host's numeric telemetry into ONE merged view with
+    a single allgather (collective — every process of the gang must
+    call it, e.g. via ``telemetry.merged_snapshot()``).  Single-process
+    it is the identity.  If the hosts' key sets disagree (a
+    non-SPMD-identical code path registered an extra series), the
+    local snapshot is returned unreduced rather than mis-summing
+    misaligned columns."""
+    import numpy
+    import zlib
+
+    if jax.process_count() == 1:
+        return snap
+    from jax.experimental import multihost_utils
+    items = _flatten_telemetry(snap)
+    keys_sig = zlib.crc32("|".join(
+        "%s:%s" % (kind, k) for kind, k, _ in items).encode())
+    # two collectives, BOTH shape-consistent across hosts: the first is
+    # a fixed-shape (2,) signature exchange — hosts whose registries
+    # diverged (a rank-0-only series like snapshotter.exports) would
+    # otherwise feed different-length vectors into ONE allgather, which
+    # crashes or hangs the collective before any guard can run.  Every
+    # host sees every signature, so every host takes the same branch.
+    sig = numpy.array([float(len(items)), float(keys_sig)],
+                      dtype=numpy.float64)
+    sigs = numpy.asarray(multihost_utils.process_allgather(sig))
+    if not (sigs[:, 0] == len(items)).all() or \
+            not (sigs[:, 1] == float(keys_sig)).all():
+        snap = dict(snap)
+        snap["aggregated"] = False
+        return snap
+    # signatures agree -> identical keys -> identical vector length
+    vec = numpy.array([v for _, _, v in items], dtype=numpy.float64)
+    gathered = numpy.asarray(
+        multihost_utils.process_allgather(vec))  # (nproc, n)
+    # rebuild per-host snapshots from the gathered columns, merge
+    snaps = []
+    for row in gathered:
+        s = {"counters": {}, "gauges": {}, "histograms": {}}
+        for (kind, k, _), v in zip(items, row):
+            if kind in ("counters", "gauges"):
+                s[kind][k] = v
+            elif kind == "hist_count":
+                s["histograms"].setdefault(k, {})["count"] = v
+            else:
+                s["histograms"].setdefault(k, {})["sum"] = v
+        snaps.append(s)
+    # carry this host's percentiles into slot 0 so the merge keeps them
+    for k, h in snap.get("histograms", {}).items():
+        snaps[jax.process_index()]["histograms"][k] = dict(
+            h, **snaps[jax.process_index()]["histograms"].get(k, {}))
+    local = snaps.pop(jax.process_index())
+    merged = merge_telemetry_snapshots([local] + snaps)
+    merged["hosts"] = int(jax.process_count())
+    if "trace" in snap:
+        merged["trace"] = snap["trace"]
+    return merged
+
+
 def host_shard(global_size, process_index=None, process_count=None):
     """(start, stop) of this host's contiguous slice of a global batch
     or dataset — the per-host data-loading contract."""

@@ -68,7 +68,12 @@ def forward(params, x, mesh, heads):
     b, t = x.shape
     e = params["embed"].shape[1]
     h = params["embed"][x]                              # (B, T, E)
-    h_prev = jnp.pad(h[:, :-1], ((0, 0), (1, 0), (0, 0)))
+    # concatenate, not jnp.pad: pad's VJP lowers to a
+    # dynamic-update-slice whose index arithmetic mixes s64/s32 under
+    # x64 + spmd partitioning on this jaxlib (hlo verifier rejects it);
+    # the concat VJP is plain slices and is numerically identical
+    h_prev = jnp.concatenate(
+        [jnp.zeros_like(h[:, :1]), h[:, :-1]], axis=1)
     h2 = jnp.concatenate([h, h_prev], axis=-1)          # (B, T, 2E)
     q = (h2 @ params["wq"] + params["bq"]).reshape(b, t, heads,
                                                   e // heads)
@@ -76,7 +81,13 @@ def forward(params, x, mesh, heads):
     v = (h2 @ params["wv"]).reshape(b, t, heads, e // heads)
     a = ring_attention(q, k, v, mesh, causal=False)
     a = a.reshape(b, t, e)
-    return a[:, -1] @ params["wo"]               # read out at last pos
+    # read out at the last position via a one-hot contraction: the VJP
+    # of a[:, -1] is a pad/dynamic-update-slice on the t-sharded axis,
+    # which this jaxlib's spmd partitioner rejects under x64 (mixed
+    # s64/s32 offset compare); the mask-multiply VJP is elementwise
+    last = (jnp.arange(t) == t - 1).astype(a.dtype)
+    a_last = (a * last[None, :, None]).sum(axis=1)
+    return a_last @ params["wo"]
 
 
 def loss_fn(params, x, labels, mesh, heads):

@@ -27,6 +27,8 @@ through the same attribute contract they use on real GD units.
 whole-net state snapshots instead of per-GD-unit weight histories.
 """
 
+import time
+
 import numpy
 
 import jax
@@ -36,6 +38,7 @@ from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.config import root
 from znicz_tpu.core import prng
+from znicz_tpu.core import telemetry
 from znicz_tpu.loader.base import TRAIN
 from znicz_tpu.parallel import fused
 
@@ -406,13 +409,33 @@ class FusedForwardBackward(Unit):
                 "(contiguous-slice contract)")
 
     def _run_train_window(self):
+        """Telemetry shell around :meth:`_run_train_window_inner`: spans
+        the device-window path and reports per-step time (the window's
+        wall time divided by its step count, weighted by that count —
+        so `trainer.step_seconds` percentiles read as per-minibatch
+        time across windows) plus the minibatch counter."""
+        if not telemetry.enabled():
+            self._run_train_window_inner()
+            return
+        t0 = time.perf_counter()
+        with telemetry.span("fused.window", sliced=self._use_sliced,
+                            device_data=self._use_device_data):
+            n = self._run_train_window_inner()
+        dt = time.perf_counter() - t0
+        telemetry.counter("trainer.minibatches").inc(n)
+        telemetry.counter("trainer.windows").inc()
+        telemetry.histogram("trainer.step_seconds").observe(
+            dt / max(n, 1), count=n)
+
+    def _run_train_window_inner(self):
         """Collect up to ``window`` TRAIN minibatches (driving the loader
         directly; the LR adjuster ticks per minibatch via hyper_tick) and
         dispatch them as ONE compiled scan window.  The window never
         crosses a segment boundary — collection stops at the loader's
         last_minibatch, so epoch/segment bookkeeping, snapshotter gating
         and decision semantics are untouched (reference decision.py only
-        consumes segment aggregates + end-of-segment output)."""
+        consumes segment aggregates + end-of-segment output).  Returns
+        the number of minibatches dispatched."""
         loader = self.loader_unit
         if self._use_device_data and not self.net.has_dataset:
             data = numpy.asarray(loader.original_data.mem,
@@ -536,6 +559,7 @@ class FusedForwardBackward(Unit):
                 self.max_idx.map_invalidate()
                 self.max_idx.mem[...] = host["max_idx"]
         self._refresh_weight_views()
+        return len(sizes)
 
     def _collect_hypers(self):
         """Rebuild the traced hyper pytree from the live proxies."""
@@ -560,6 +584,7 @@ class FusedForwardBackward(Unit):
                 and self.loader_unit is not None):
             self._run_train_window()
             return
+        t0 = time.perf_counter()
         self.input.map_read()
         x = self.input.mem
         idx = None
@@ -595,6 +620,10 @@ class FusedForwardBackward(Unit):
             # re-point the plotter views at the post-update params
             # (zero-copy; plotters pull to host only when they fire)
             self._refresh_weight_views()
+            if telemetry.enabled():
+                telemetry.counter("trainer.minibatches").inc()
+                telemetry.histogram("trainer.step_seconds").observe(
+                    time.perf_counter() - t0)
 
     # -- snapshot / resume ---------------------------------------------------
     @property

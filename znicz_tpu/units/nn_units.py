@@ -532,7 +532,7 @@ class NNSnapshotterBase(SnapshotterToFile):
         for uname, ustate in state.items():
             for attr, value in ustate.items():
                 self._log_attr("%s.%s" % (uname, attr), value)
-        super(NNSnapshotterBase, self).export()
+        return super(NNSnapshotterBase, self).export()
 
     def run(self):
         if self.skip is not None and bool(self.skip):
