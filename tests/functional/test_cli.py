@@ -48,6 +48,28 @@ def test_dry_run_builds_but_does_not_train():
     assert not wf.decision.complete
 
 
+def test_serve_subcommand_dispatches():
+    """'python -m znicz_tpu serve' routes to the serving CLI (its own
+    parser), and newest_snapshot picks the latest prefix match."""
+    import time
+    import pytest
+    from znicz_tpu.__main__ import main
+    from znicz_tpu.launcher import newest_snapshot
+    with pytest.raises(SystemExit) as e:
+        main(["serve", "--help"])
+    assert e.value.code == 0
+    assert newest_snapshot("/nonexistent", "x") is None
+    d = root.common.dirs.snapshots  # conftest points this at tmp
+    os.makedirs(d, exist_ok=True)
+    for i, name in enumerate(("p_old.1.pickle", "p_new.2.pickle",
+                              "p_part.3.pickle.part", "q_no.4.pickle")):
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(b"x")
+        os.utime(os.path.join(d, name), (time.time() + i,
+                                         time.time() + i))
+    assert newest_snapshot(d, "p").endswith("p_new.2.pickle")
+
+
 def test_launcher_roles():
     l = Launcher()
     assert l.is_standalone and not l.is_master and not l.is_slave

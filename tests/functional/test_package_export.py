@@ -425,6 +425,60 @@ def test_mul_activation_exports_and_replays(tmp_path):
     assert numpy.abs(numpy.load(out_npy) - y_pkg).max() < 1e-5
 
 
+def test_zero_filter_export_roundtrips_losslessly(tmp_path):
+    """The grouping mask folds into the next layer's weights AND
+    survives in the manifest (mask + grouping recoverable —
+    import_package loses nothing), while manifest.txt stays clean for
+    the C++ parser."""
+    import zipfile
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.export import import_package
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"name": "zf", "type": "zero_filter", "grouping": 2},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"prefix": "zf", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    pkg = str(tmp_path / "zf.zip")
+    export_package(wf, pkg)
+
+    manifest, arrays = import_package(pkg)  # strict loader accepts it
+    assert [e["type"] for e in manifest["layers"]] == \
+        ["all2all_tanh", "softmax"]
+    entry = manifest["layers"][1]
+    assert entry["zero_filter_grouping"] == 2
+    mask = arrays[entry["arrays"]["zero_filter_mask"]]
+    w = arrays[entry["arrays"]["weights"]]
+    assert mask.shape == w.shape
+    # the exported weights ARE the masked weights — folding again is a
+    # no-op (the lossless-fold invariant)
+    assert numpy.array_equal(w, w * mask)
+    assert (mask == 0).any() and (mask == 1).any()
+    # the C++ flat manifest never sees the provenance attrs
+    with zipfile.ZipFile(pkg) as zf:
+        txt = zf.read("manifest.txt").decode()
+    assert "zero_filter" not in txt
+    # the numpy runner serves the masked stack
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (10, 13)).astype(numpy.float32)
+    y_pkg = run_package_numpy(pkg, x)
+    y_py = _python_forward(wf, x)
+    assert numpy.abs(y_pkg - y_py).max() < 1e-5
+
+
 def test_mul_export_refuses_unset_factor(tmp_path):
     """Exporting an activation_mul whose factor was never set must fail
     loudly (review regression: runners would otherwise diverge)."""

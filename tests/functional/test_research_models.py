@@ -311,6 +311,17 @@ def test_zoo_pinned_trajectories():
 # conftest (n_err -1 = decision tracks no class error; mse None = not an
 # MSE decision).  Regenerate ONLY for an intentional numerics change:
 #   pytest tests/functional/test_research_models.py -k pinned -s
+#
+# The integer columns (class, n_err) pin EXACTLY — any drift there is a
+# real trajectory change.  The float mse column is held to a relative
+# bound instead (MSE_RTOL below): XLA is free to re-fuse float32
+# reductions between releases, which legitimately moves the 7th-8th
+# significant digit without changing a single classification (observed
+# going to jaxlib 0.4.36: mnist7 mse shifted ~1.6e-7 relative while
+# every n_err stayed identical).  1e-6 is an order above that noise and
+# three below the ~1e-3 shifts real numerics bugs produce.
+MSE_RTOL = 1e-6
+
 GOLDEN_ZOO2 = {
     "hands": [(2, 38, None), (1, 6, None), (2, 25, None), (1, 4, None),
               (2, 11, None), (1, 4, None)],
@@ -329,6 +340,19 @@ GOLDEN_ZOO2 = {
     "imagenet_ae": [(2, -1, 0.21730876), (1, -1, 0.222695112),
                     (2, -1, 0.217325767), (1, -1, 0.222668648)],
 }
+
+
+def _assert_trajectory(name, seq, golden):
+    """Exact (class, n_err) pin; mse within MSE_RTOL (see above)."""
+    assert len(seq) == len(golden), (name, seq)
+    for i, ((c, err, mse), (gc, gerr, gmse)) in \
+            enumerate(zip(seq, golden)):
+        assert (c, err) == (gc, gerr), (name, i, seq)
+        if mse is None or gmse is None:
+            assert mse == gmse, (name, i, seq)
+        else:
+            assert abs(mse - gmse) <= MSE_RTOL * abs(gmse), \
+                (name, i, mse, gmse)
 
 
 def _traced_run_full(build_and_init):
@@ -406,6 +430,6 @@ def test_zoo_pinned_trajectories_remaining(tmp_path):
         _, seq = _traced_run_full(build)
         print("GOLDEN_ZOO2[%r] = %r" % (name, seq))
         if GOLDEN_ZOO2[name] is not None:
-            assert seq == GOLDEN_ZOO2[name], (name, seq)
+            _assert_trajectory(name, seq, GOLDEN_ZOO2[name])
 
 

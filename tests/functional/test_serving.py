@@ -1,0 +1,498 @@
+"""Functional serving tests — the acceptance contract of the online
+inference subsystem (ISSUE 2):
+
+* a snapshot-trained wine model served over HTTP returns predictions
+  BIT-IDENTICAL to the in-process forward pass (engine.predict and the
+  live unit-graph forward),
+* after warmup, a mixed-size request stream (1..max_batch rows) causes
+  ZERO new JAX compiles (asserted via the PR 1 telemetry
+  ``jax.backend_compiles`` counter),
+* hot-reload picks up a new snapshot without recompiling an unchanged
+  topology.
+"""
+
+import json
+import pickle
+import threading
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from znicz_tpu.core import prng, telemetry
+from znicz_tpu.core.snapshotter import SnapshotterToFile
+from znicz_tpu.serving import (InferenceEngine, MicroBatcher,
+                               ServingServer)
+
+MAX_BATCH = 8
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One trained wine workflow + a post-training snapshot (taken
+    AFTER run() so it captures the final weights — the regular
+    improvement-gated snapshot is written one gradient step earlier by
+    design)."""
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    tmp = tmp_path_factory.mktemp("serving")
+    prng.get(1).seed(1024)
+    prng.get(2).seed(1025)
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 3, "fail_iterations": 20},
+        snapshotter_config={"prefix": "servewine", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": str(tmp)})
+    wf.initialize()
+    wf.run()
+    wf.snapshotter.suffix = "final"
+    snapshot = wf.snapshotter.export()
+    assert snapshot
+    return {"wf": wf, "snapshot": snapshot, "dir": tmp}
+
+
+def _unit_graph_forward(wf, x):
+    """The live workflow's own forward stack on a fresh batch (must be
+    a full minibatch — the unit graph's shapes are fixed)."""
+    wf.forwards[0].input.reset(x.astype(
+        wf.forwards[0].weights.mem.dtype))
+    for fwd in wf.forwards:
+        fwd.run()
+    wf.forwards[-1].output.map_read()
+    return numpy.array(wf.forwards[-1].output.mem)
+
+
+def _post_json(url, obj, timeout=30):
+    req = urllib.request.Request(
+        url, json.dumps(obj).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_snapshot_served_bit_exact_and_zero_recompiles(trained):
+    telemetry.enable()
+    telemetry.reset()
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    assert engine.ready
+    assert engine.warm_buckets == (1, 2, 4, 8)
+
+    # in-process forward == the live training workflow's unit graph,
+    # bit for bit (same weights, same per-layer jitted ops)
+    x10 = numpy.random.RandomState(0).uniform(
+        -1, 1, (10, 13)).astype(numpy.float32)
+    y_graph = _unit_graph_forward(trained["wf"], x10)
+    assert numpy.array_equal(
+        engine.predict(x10[:MAX_BATCH]),
+        y_graph[:MAX_BATCH].astype(numpy.float32))
+
+    server = ServingServer(engine, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d" % server.port
+        # warmup really compiled every bucket: the per-bucket counters
+        # exist and the backend compile counter is now quiescent
+        compiles0 = telemetry.counter("jax.backend_compiles").value
+        assert compiles0 > 0
+        for bucket in engine.buckets:
+            assert telemetry.counter(
+                "serving.compiles.%d" % bucket).value == 1
+
+        # mixed-size stream over HTTP: bit-identical to the in-process
+        # engine forward, serially per request (one request = one
+        # micro-batch = deterministic padded dispatch)
+        rand = numpy.random.RandomState(7)
+        for n in (1, 2, 3, 5, 7, 8, 4, 6, 1, 8):
+            x = rand.uniform(-1, 1, (n, 13)).astype(numpy.float32)
+            status, doc = _post_json(url + "/predict",
+                                     {"inputs": x.tolist()})
+            assert status == 200
+            got = numpy.asarray(doc["outputs"], dtype=numpy.float32)
+            want = engine.predict(x)
+            assert numpy.array_equal(got, want), (n, got, want)
+            assert doc["argmax"] == [int(i) for i in
+                                     want.argmax(axis=1)]
+
+        # ... and concurrently (coalesced micro-batches)
+        errors = []
+
+        def client(seed):
+            try:
+                r = numpy.random.RandomState(seed)
+                x = r.uniform(-1, 1,
+                              (1 + seed % MAX_BATCH, 13)) \
+                    .astype(numpy.float32)
+                status, doc = _post_json(url + "/predict",
+                                         {"inputs": x.tolist()})
+                assert status == 200
+                got = numpy.asarray(doc["outputs"],
+                                    dtype=numpy.float32)
+                assert numpy.allclose(got, engine.predict(x),
+                                      atol=1e-6)
+            except Exception as e:  # noqa: BLE001 - assert below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+
+        # THE acceptance pin: the whole mixed-size stream above caused
+        # zero new XLA compiles — every bucket was warmed eagerly
+        assert telemetry.counter("jax.backend_compiles").value == \
+            compiles0
+
+        # request latency histogram populated (p99 path observable)
+        lat = telemetry.histogram("serving.request_seconds")
+        assert lat.count >= 26
+        assert lat.percentile(99) is not None
+    finally:
+        server.stop()
+        server.stop()  # idempotent (shared HttpServerBase contract)
+
+
+def test_hot_reload_picks_up_new_snapshot(trained):
+    telemetry.enable()
+    telemetry.reset()
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    batcher = MicroBatcher(engine, max_delay_ms=1.0).start()
+    server = ServingServer(engine, batcher, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d" % server.port
+        x = numpy.random.RandomState(3).uniform(
+            -1, 1, (4, 13)).astype(numpy.float32)
+        _, doc0 = _post_json(url + "/predict", {"inputs": x.tolist()})
+        v0 = doc0["model_version"]
+
+        # derive a NEW snapshot: same topology, visibly different
+        # weights (first layer scaled)
+        state = SnapshotterToFile.import_(trained["snapshot"])
+        fwd0 = trained["wf"].forwards[0].name
+        state["units"][fwd0]["weights"] = \
+            numpy.asarray(state["units"][fwd0]["weights"]) * 1.5
+        new_path = str(trained["dir"] / "reloaded.pickle")
+        with open(new_path, "wb") as f:
+            pickle.dump(state, f, protocol=4)
+
+        compiles0 = telemetry.counter("jax.backend_compiles").value
+        status, doc = _post_json(url + "/reload", {"path": new_path})
+        assert status == 200
+        assert doc["model_version"] > v0
+        assert doc["ready"] is True
+
+        _, doc1 = _post_json(url + "/predict", {"inputs": x.tolist()})
+        assert doc1["model_version"] == doc["model_version"]
+        got = numpy.asarray(doc1["outputs"], dtype=numpy.float32)
+        assert numpy.array_equal(got, engine.predict(x))
+        assert not numpy.allclose(got, numpy.asarray(
+            doc0["outputs"], dtype=numpy.float32))
+
+        # param-only reload: the compiled executables were reused —
+        # zero new compiles, warm buckets carried over
+        assert telemetry.counter("jax.backend_compiles").value == \
+            compiles0
+        assert engine.warm_buckets == (1, 2, 4, 8)
+
+        with urllib.request.urlopen(url + "/healthz",
+                                    timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["ready"] and health["model_version"] == \
+            doc1["model_version"]
+    finally:
+        server.stop()
+
+
+def test_failed_reload_rolls_back_to_serving_model(trained):
+    """A reload that passes structural validation but dies at
+    trace/warmup time must NOT brick the server: the old generation
+    keeps serving (review regression)."""
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    x = numpy.random.RandomState(5).uniform(
+        -1, 1, (3, 13)).astype(numpy.float32)
+    want = engine.predict(x)
+    v0 = engine.version
+
+    state = SnapshotterToFile.import_(trained["snapshot"])
+    fwd0 = trained["wf"].forwards[0].name
+    # weights whose width contradicts the recorded sample shape:
+    # structurally fine, explodes when the forward traces
+    state["units"][fwd0]["weights"] = numpy.zeros((8, 7),
+                                                  numpy.float32)
+    bad = str(trained["dir"] / "bad_reload.pickle")
+    with open(bad, "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+    with pytest.raises(Exception):
+        engine.load(bad)
+    assert engine.ready
+    assert engine.version == v0
+    assert numpy.array_equal(engine.predict(x), want)
+
+
+def test_package_and_snapshot_engines_agree(trained):
+    from znicz_tpu.export import export_package
+    pkg = str(trained["dir"] / "wine_pkg.zip")
+    export_package(trained["wf"], pkg)
+    eng_snap = InferenceEngine(trained["snapshot"],
+                               max_batch=MAX_BATCH)
+    eng_pkg = InferenceEngine(pkg, max_batch=MAX_BATCH)
+    x = numpy.random.RandomState(11).uniform(
+        -1, 1, (6, 13)).astype(numpy.float32)
+    assert numpy.array_equal(eng_snap.predict(x), eng_pkg.predict(x))
+
+
+def test_spatial_snapshot_serves_conv_stack(tmp_path):
+    """The spatial tier (conv/pool) serves from a snapshot: engine
+    output matches the numpy package runner (the executable spec), and
+    3-D (B, H, W) input follows the implicit-single-channel NHWC
+    convention like every spatial unit."""
+    from znicz_tpu.core.backends import NumpyDevice
+    from znicz_tpu.core.config import root
+    from znicz_tpu.export import export_package, run_package_numpy
+    from znicz_tpu.samples import mnist
+
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    wf = mnist.build(
+        layers=root.mnistr_caffe.layers,
+        loader_config={"synthetic_train": 60, "synthetic_valid": 30,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"prefix": "sconv", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp_path)})
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    wf.snapshotter.suffix = "final"
+    snap = wf.snapshotter.export()
+    pkg = str(tmp_path / "sconv.zip")
+    export_package(wf, pkg)
+
+    engine = InferenceEngine(snap, max_batch=4)
+    assert engine.ready  # sample shape came from the snapshot topology
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (3, 28, 28, 1)).astype(numpy.float32)
+    y = engine.predict(x)
+    assert y.shape == (3, 10)
+    assert numpy.abs(y - run_package_numpy(pkg, x)).max() < 1e-5
+    # 3-D input == 4-D input (as_nhwc convention)
+    assert numpy.array_equal(engine.predict(x[..., 0]), y)
+    # the package loads into an identical serving function
+    assert numpy.array_equal(InferenceEngine(pkg, max_batch=4)
+                             .predict(x), y)
+
+
+def test_unknown_package_format_is_rejected(tmp_path):
+    import zipfile
+    from znicz_tpu.export import import_package
+    bad = str(tmp_path / "future.zip")
+    with zipfile.ZipFile(bad, "w") as zf:
+        zf.writestr("manifest.json",
+                    json.dumps({"format": 99, "layers": []}))
+    with pytest.raises(ValueError, match="format version"):
+        import_package(bad)
+    with pytest.raises(ValueError, match="format version"):
+        InferenceEngine(bad)
+
+
+def test_snapshot_without_topology_is_rejected(tmp_path):
+    state = {"format": 1, "workflow": "X",
+             "units": {"fwd0": {"weights": numpy.eye(3)}}}
+    path = str(tmp_path / "old.pickle")
+    with open(path, "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    with pytest.raises(ValueError, match="topology"):
+        InferenceEngine(path)
+
+
+def test_engine_pads_and_unpads_in_memory_model():
+    """Identity FC model via the in-memory (manifest, arrays) source:
+    3 rows pad to bucket 4 inside the engine and come back un-padded."""
+    eye = numpy.eye(4, dtype=numpy.float32)
+    manifest = {
+        "format": 1,
+        "layers": [{"type": "all2all", "name": "l0",
+                    "arrays": {"weights": "w.npy", "bias": "b.npy"},
+                    "include_bias": True,
+                    "weights_transposed": False}],
+        "input_sample_shape": [4],
+    }
+    arrays = {"w.npy": eye,
+              "b.npy": numpy.zeros(4, dtype=numpy.float32)}
+    engine = InferenceEngine((manifest, arrays), max_batch=4)
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (3, 4)).astype(numpy.float32)
+    y = engine.predict(x)
+    assert y.shape == (3, 4)
+    assert numpy.allclose(y, x, atol=1e-6)
+    with pytest.raises(ValueError, match="max_batch"):
+        engine.predict(numpy.zeros((5, 4), numpy.float32))
+    # single-sample promotion fires ONLY on an exact sample-shape
+    # match; a (4, 4) batch that merely shares the rank stays a batch
+    assert engine.predict(x[0]).shape == (1, 4)
+    assert engine.predict(numpy.zeros((4, 4),
+                                      numpy.float32)).shape == (4, 4)
+
+
+def test_rank_equal_batch_is_not_a_single_sample():
+    """A 3-D (B, H, W) batch under a 3-D NHWC sample shape must stay a
+    batch (review regression: a rank-only check promoted it to one
+    garbage sample)."""
+    manifest = {
+        "format": 1,
+        "layers": [{"type": "dropout", "name": "d0", "arrays": {}}],
+        "input_sample_shape": [5, 5, 1],
+    }
+    engine = InferenceEngine((manifest, {}), max_batch=4,
+                             warmup=False)
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (4, 5, 5)).astype(numpy.float32)
+    y = engine.predict(x)
+    # 4 samples answered (input normalized to the canonical NHWC
+    # sample shape), not 1 garbage sample
+    assert y.shape == (4, 5, 5, 1)
+    assert numpy.allclose(y[..., 0], x)
+
+
+def test_server_maps_backpressure_and_not_ready(trained):
+    """429 when the queue is full; 503 before warmup finishes."""
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+
+    class Stall(object):
+        max_batch = MAX_BATCH
+
+        def __init__(self):
+            self.release = threading.Event()
+
+        def bucket_for(self, n):
+            return MAX_BATCH
+
+        def predict(self, x):
+            self.release.wait(10)
+            return engine.predict(x)
+
+    stall = Stall()
+    batcher = MicroBatcher(stall, max_batch=MAX_BATCH,
+                           max_delay_ms=1.0, queue_limit=4,
+                           timeout_ms=0).start()
+    server = ServingServer(engine, batcher, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d" % server.port
+        x = numpy.zeros((4, 13), numpy.float32)
+        slow = []
+        t = threading.Thread(target=lambda: slow.append(
+            _post_json(url + "/predict", {"inputs": x.tolist()})))
+        t.start()
+        import time
+        time.sleep(0.1)  # worker stalled inside predict
+        # fill the queue to the 4-row limit, then overflow → 429
+        ok = threading.Thread(target=lambda: slow.append(
+            _post_json(url + "/predict", {"inputs": x.tolist()})))
+        ok.start()
+        time.sleep(0.1)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(url + "/predict", {"inputs": x.tolist()})
+        assert e.value.code == 429
+        stall.release.set()
+        t.join(timeout=30)
+        ok.join(timeout=30)
+        assert [s for s, _ in slow] == [200, 200]
+    finally:
+        server.stop()
+
+    # chunked transfer encoding is refused (400) and the connection is
+    # dropped — an unread chunked payload must not desync keep-alive.
+    # One raw sendall keeps the test deterministic: a streaming client
+    # could hit EPIPE when the server closes mid-stream (also fine).
+    import socket
+    engine2 = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    server2 = ServingServer(engine2, port=0).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", server2.port),
+                                     timeout=10)
+        s.sendall(b"POST /predict HTTP/1.1\r\n"
+                  b"Host: t\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"13\r\n{\"inputs\": [[0.0]]}\r\n0\r\n\r\n")
+        reply = b""
+        while True:  # server closes the socket: read to EOF
+            part = s.recv(65536)
+            if not part:
+                break
+            reply += part
+        assert reply.startswith(b"HTTP/1.1 400"), reply
+        assert b"Connection: close" in reply
+        assert b"Transfer-Encoding" in reply
+        s.close()
+    finally:
+        server2.stop()
+
+    # an engine with no model yet answers 503 on both endpoints — and
+    # the 503 path DRAINS the unread body, so a keep-alive connection
+    # stays usable for the next request (review regression)
+    empty = ServingServer(InferenceEngine(), port=0).start()
+    try:
+        url = "http://127.0.0.1:%d" % empty.port
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/healthz", timeout=10)
+        assert e.value.code == 503
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", empty.port,
+                                          timeout=10)
+        body = json.dumps({"inputs": [[0.0] * 13]})
+        for _ in range(2):  # same socket twice
+            conn.request("POST", "/predict", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 503
+            resp.read()
+        conn.close()
+    finally:
+        empty.stop()
+
+
+def test_malformed_inputs_get_http_errors_not_disconnects(trained):
+    """Bad feature widths and over-nested inputs come back as 400s —
+    never as a dropped connection or a surprise recompile (review
+    regressions: unmapped trace-time exceptions aborted the socket;
+    novel trailing shapes compiled fresh executables)."""
+    telemetry.enable()
+    telemetry.reset()
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    server = ServingServer(engine, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d" % server.port
+        compiles0 = telemetry.counter("jax.backend_compiles").value
+        for bad in ([[1.0, 2.0]],           # wrong feature width
+                    [[[0.0] * 13]],         # over-nested (1, 1, 13)
+                    "not numbers"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post_json(url + "/predict", {"inputs": bad})
+            assert e.value.code == 400, bad
+        # the rejects compiled nothing and the service still serves
+        assert telemetry.counter("jax.backend_compiles").value == \
+            compiles0
+        x = numpy.random.RandomState(0).uniform(
+            -1, 1, (2, 13)).astype(numpy.float32)
+        status, doc = _post_json(url + "/predict",
+                                 {"inputs": x.tolist()})
+        assert status == 200
+        assert numpy.array_equal(
+            numpy.asarray(doc["outputs"], dtype=numpy.float32),
+            engine.predict(x))
+    finally:
+        server.stop()

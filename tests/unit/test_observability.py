@@ -77,6 +77,35 @@ def test_status_server_serves_json_and_page():
         server.stop()
 
 
+def test_status_server_stop_is_idempotent():
+    """Any number of stop() calls — including before start and
+    concurrently — are safe (shared HttpServerBase contract, reused by
+    serving/server.py)."""
+    import threading
+    server = StatusServer(None, port=0)
+    server.stop()  # never started
+    server.start()
+    port = server.port
+    threads = [threading.Thread(target=server.stop) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    server.stop()  # and once more after the dust settles
+    with pytest.raises(Exception):  # noqa: B017 - socket is closed
+        urllib.request.urlopen(
+            "http://127.0.0.1:%d/status.json" % port, timeout=2)
+    # restartable after stop
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/status.json" % server.port,
+                timeout=10) as r:
+            assert json.loads(r.read())["workflow"] is None
+    finally:
+        server.stop()
+
+
 def test_avatar_mirrors_loader_stream():
     """The avatar yields the same minibatch sequence as a twin loader,
     one step behind, through its own Arrays."""

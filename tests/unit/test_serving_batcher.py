@@ -1,0 +1,289 @@
+"""MicroBatcher contract (znicz_tpu/serving/batcher.py): window close
+on size vs deadline, request coalescing + result scattering,
+backpressure rejection, per-request timeout expiry, concurrent
+submitters, lifecycle."""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from znicz_tpu.serving.batcher import (MicroBatcher, QueueFullError,
+                                       RequestTimeoutError)
+
+
+class RecordingModel(object):
+    """Fake engine: y = x + 1, recording every dispatched batch size.
+    ``delay`` stalls the worker so tests can pile up a queue."""
+
+    max_batch = None  # set per instance
+
+    def __init__(self, max_batch=8, delay=0.0):
+        self.max_batch = max_batch
+        self.delay = delay
+        self.batches = []
+        self.release = threading.Event()
+        self.release.set()
+
+    def bucket_for(self, n):
+        return self.max_batch
+
+    def predict(self, x):
+        self.release.wait(10)
+        if self.delay:
+            time.sleep(self.delay)
+        self.batches.append(len(x))
+        return numpy.asarray(x) + 1.0
+
+
+def _rows(n, width=3, base=0.0):
+    return numpy.arange(n * width, dtype=numpy.float64).reshape(
+        n, width) + base
+
+
+def test_window_closes_on_size():
+    """max_batch pending rows close the window immediately — no
+    max_delay wait (the delay here is 10 s; the test would time out)."""
+    model = RecordingModel(max_batch=4)
+    b = MicroBatcher(model, max_batch=4, max_delay_ms=10_000.0,
+                     queue_limit=64, timeout_ms=0).start()
+    try:
+        futures = [b.submit(_rows(1, base=i)) for i in range(4)]
+        results = [f.result(timeout=5) for f in futures]
+        assert model.batches and model.batches[0] == 4
+        for i, r in enumerate(results):
+            assert numpy.array_equal(r, _rows(1, base=i) + 1.0)
+    finally:
+        b.stop()
+
+
+def test_window_closes_on_deadline():
+    """A lone small request is served after max_delay_ms — size close
+    can never trigger for it."""
+    model = RecordingModel(max_batch=8)
+    b = MicroBatcher(model, max_batch=8, max_delay_ms=40.0,
+                     queue_limit=64, timeout_ms=0).start()
+    try:
+        t0 = time.monotonic()
+        y = b.submit(_rows(2)).result(timeout=5)
+        elapsed = time.monotonic() - t0
+        assert numpy.array_equal(y, _rows(2) + 1.0)
+        assert model.batches == [2]
+        # the window really waited (half-bound guards slow-CI jitter)
+        assert elapsed >= 0.02
+    finally:
+        b.stop()
+
+
+def test_coalescing_scatters_results_per_request():
+    """Requests of mixed sizes coalesce into one dispatch; every future
+    receives exactly its own rows back."""
+    model = RecordingModel(max_batch=16)
+    model.release.clear()  # hold the worker until all are queued
+    b = MicroBatcher(model, max_batch=16, max_delay_ms=5.0,
+                     queue_limit=64, timeout_ms=0).start()
+    try:
+        sizes = (2, 3, 1, 4)
+        futures = [b.submit(_rows(n, base=100 * i))
+                   for i, n in enumerate(sizes)]
+        model.release.set()
+        for i, (n, f) in enumerate(zip(sizes, futures)):
+            assert numpy.array_equal(f.result(timeout=5),
+                                     _rows(n, base=100 * i) + 1.0)
+        assert sum(model.batches) == sum(sizes)
+        assert max(model.batches) <= 16
+    finally:
+        b.stop()
+
+
+def test_batch_never_exceeds_max_batch():
+    """FIFO coalescing stops before max_batch; the overflow request
+    rides the next dispatch."""
+    model = RecordingModel(max_batch=4)
+    model.release.clear()
+    b = MicroBatcher(model, max_batch=4, max_delay_ms=1.0,
+                     queue_limit=64, timeout_ms=0).start()
+    try:
+        futures = [b.submit(_rows(3)), b.submit(_rows(3))]
+        model.release.set()
+        for f in futures:
+            f.result(timeout=5)
+        assert model.batches == [3, 3]
+    finally:
+        b.stop()
+
+
+def test_backpressure_rejects_when_queue_full():
+    model = RecordingModel(max_batch=4)
+    model.release.clear()  # the worker will stall inside predict
+    b = MicroBatcher(model, max_batch=4, max_delay_ms=1.0,
+                     queue_limit=6, timeout_ms=0).start()
+    try:
+        first = b.submit(_rows(4))
+        time.sleep(0.05)  # worker popped it and is stalled in predict
+        kept = [b.submit(_rows(2)) for _ in range(3)]  # 6 rows == limit
+        with pytest.raises(QueueFullError):
+            b.submit(_rows(1))
+        model.release.set()
+        first.result(timeout=5)
+        for f in kept:
+            f.result(timeout=5)
+        # drained queue accepts work again
+        assert numpy.array_equal(b.submit(_rows(1)).result(timeout=5),
+                                 _rows(1) + 1.0)
+    finally:
+        b.stop()
+
+
+def test_timeout_expires_queued_request():
+    """A request whose deadline passes while it waits behind a stalled
+    worker fails with RequestTimeoutError and never reaches the
+    model."""
+    model = RecordingModel(max_batch=4)
+    model.release.clear()
+    b = MicroBatcher(model, max_batch=4, max_delay_ms=1.0,
+                     queue_limit=64, timeout_ms=0).start()
+    try:
+        first = b.submit(_rows(4))       # fills a whole batch
+        doomed = b.submit(_rows(1), timeout_ms=10)
+        time.sleep(0.05)                 # let the deadline lapse
+        model.release.set()
+        first.result(timeout=5)
+        with pytest.raises(RequestTimeoutError):
+            doomed.result(timeout=5)
+        assert model.batches == [4]      # the expired rows never ran
+    finally:
+        b.stop()
+
+
+def test_concurrent_submitters_all_get_their_rows():
+    model = RecordingModel(max_batch=8)
+    b = MicroBatcher(model, max_batch=8, max_delay_ms=2.0,
+                     queue_limit=1024, timeout_ms=0).start()
+    errors = []
+
+    def client(tag):
+        try:
+            for j in range(5):
+                x = _rows(1 + (tag + j) % 3, base=1000 * tag + 10 * j)
+                y = b.submit(x).result(timeout=10)
+                assert numpy.array_equal(y, x + 1.0)
+        except Exception as e:  # noqa: BLE001 - collected for assert
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert max(model.batches) <= 8
+    finally:
+        b.stop()
+
+
+def test_submit_validation_and_lifecycle():
+    model = RecordingModel(max_batch=4)
+    b = MicroBatcher(model, max_batch=4, max_delay_ms=1.0,
+                     queue_limit=8, timeout_ms=0)
+    with pytest.raises(RuntimeError):  # not started
+        b.submit(_rows(1))
+    b.start()
+    with pytest.raises(ValueError):    # oversized
+        b.submit(_rows(5))
+    with pytest.raises(ValueError):    # empty
+        b.submit(numpy.zeros((0, 3)))
+    # 1-D convenience: a lone sample is a 1-row batch
+    y = b.submit(numpy.ones(3)).result(timeout=5)
+    assert y.shape == (1, 3)
+    b.stop()
+    b.stop()  # idempotent
+    with pytest.raises(RuntimeError):  # stopped
+        b.submit(_rows(1))
+
+
+def test_stop_flush_serves_queued_requests():
+    model = RecordingModel(max_batch=4)
+    model.release.clear()
+    b = MicroBatcher(model, max_batch=4, max_delay_ms=1.0,
+                     queue_limit=64, timeout_ms=0).start()
+    futures = [b.submit(_rows(1, base=i)) for i in range(3)]
+    model.release.set()
+    b.stop(flush=True)
+    for i, f in enumerate(futures):
+        assert numpy.array_equal(f.result(timeout=1),
+                                 _rows(1, base=i) + 1.0)
+
+
+def test_single_sample_matching_model_shape_is_one_row():
+    """The batcher shares the engine's batch-axis rule: a rank-2
+    spatial SAMPLE counts as one row (not H rows), so two of them
+    coalesce into a 2-sample batch (review regression: they used to
+    concatenate into garbage or fail)."""
+
+    class SpatialModel(RecordingModel):
+        sample_shape = (3, 3)
+
+    model = SpatialModel(max_batch=8)
+    model.release.clear()
+    b = MicroBatcher(model, max_batch=8, max_delay_ms=1.0,
+                     queue_limit=64, timeout_ms=0).start()
+    try:
+        one = numpy.arange(9.0).reshape(3, 3)
+        f1 = b.submit(one)
+        f2 = b.submit(one + 100)
+        model.release.set()
+        y1 = f1.result(timeout=5)
+        y2 = f2.result(timeout=5)
+        assert y1.shape == (1, 3, 3)
+        assert numpy.array_equal(y1[0], one + 1.0)
+        assert numpy.array_equal(y2[0], one + 101.0)
+        assert model.batches == [2]  # coalesced as TWO samples
+    finally:
+        b.stop()
+
+
+def test_mixed_sample_shapes_never_coalesce():
+    """Requests with different trailing shapes cannot share a
+    concatenated dispatch — each gets its own batch, the worker
+    survives, and both callers get correct results (review regression:
+    a cross-shape concatenate used to kill the worker thread)."""
+    model = RecordingModel(max_batch=8)
+    model.release.clear()
+    b = MicroBatcher(model, max_batch=8, max_delay_ms=1.0,
+                     queue_limit=64, timeout_ms=0).start()
+    try:
+        wide = numpy.ones((2, 5))
+        narrow = numpy.ones((2, 3))
+        f1 = b.submit(wide)
+        f2 = b.submit(narrow)
+        model.release.set()
+        assert f1.result(timeout=5).shape == (2, 5)
+        assert f2.result(timeout=5).shape == (2, 3)
+        assert model.batches == [2, 2]  # two dispatches, not one
+    finally:
+        b.stop()
+
+
+def test_predict_error_fails_the_batch_not_the_worker():
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return x
+
+    b = MicroBatcher(flaky, max_batch=4, max_delay_ms=1.0,
+                     queue_limit=8, timeout_ms=0).start()
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            b.submit(_rows(2)).result(timeout=5)
+        # the worker survived and serves the next request
+        y = b.submit(_rows(2)).result(timeout=5)
+        assert numpy.array_equal(y, _rows(2))
+    finally:
+        b.stop()

@@ -1,0 +1,138 @@
+"""CI smoke: the serving tier end to end — train a tiny wine model,
+snapshot it, bring up the HTTP front end, fire 64 CONCURRENT requests
+of mixed batch sizes, and assert the subsystem's acceptance contract:
+
+* every request answers 200 with a well-formed prediction,
+* request latency was recorded (p99 observable from the
+  ``serving.request_seconds`` histogram),
+* ZERO new XLA compiles after warmup (the ``jax.backend_compiles``
+  telemetry counter is quiescent across the whole request storm),
+* requests coalesced into micro-batches (batch counter < request
+  count).
+
+Run by ``tools/ci.sh`` (fast lane).  Exit code 0 = pass.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy  # noqa: E402
+
+from znicz_tpu.core.config import root  # noqa: E402
+from znicz_tpu.core import prng, telemetry  # noqa: E402
+
+N_REQUESTS = 64
+MAX_BATCH = 8
+
+
+def _train(tmp):
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    prng.get(1).seed(1024)
+    prng.get(2).seed(1025)
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 2, "fail_iterations": 20},
+        snapshotter_config={"prefix": "smoke", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": tmp})
+    wf.initialize()
+    wf.run()
+    wf.snapshotter.suffix = "final"
+    return wf.snapshotter.export()
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="serving_smoke_")
+    root.common.dirs.snapshots = os.path.join(tmp, "snapshots")
+    snapshot = _train(tmp)
+
+    telemetry.enable()
+    telemetry.reset()
+    from znicz_tpu.serving import (InferenceEngine, MicroBatcher,
+                                   ServingServer)
+    engine = InferenceEngine(snapshot, max_batch=MAX_BATCH)
+    assert engine.ready, "warmup did not finish"
+    batcher = MicroBatcher(engine, max_delay_ms=2.0,
+                           queue_limit=1024, timeout_ms=30_000).start()
+    server = ServingServer(engine, batcher, port=0).start()
+    url = "http://127.0.0.1:%d" % server.port
+
+    compiles0 = telemetry.counter("jax.backend_compiles").value
+    assert compiles0 > 0, "warmup compiled nothing?"
+
+    statuses = []
+    errors = []
+
+    def client(seed):
+        try:
+            r = numpy.random.RandomState(seed)
+            x = r.uniform(-1, 1, (1 + seed % MAX_BATCH, 13))
+            req = urllib.request.Request(
+                url + "/predict",
+                json.dumps({"inputs": x.tolist()}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                doc = json.loads(resp.read())
+            assert len(doc["outputs"]) == len(x)
+            statuses.append(resp.status)
+        except Exception as e:  # noqa: BLE001 - asserted below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_REQUESTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+
+    try:
+        assert not errors, "request failures: %s" % errors[:5]
+        assert statuses.count(200) == N_REQUESTS
+
+        lat = telemetry.histogram("serving.request_seconds")
+        assert lat.count == N_REQUESTS, \
+            "latency histogram saw %d of %d requests" % (lat.count,
+                                                         N_REQUESTS)
+        p99 = lat.percentile(99)
+        assert p99 is not None and p99 > 0, "p99 latency unrecorded"
+
+        compiles1 = telemetry.counter("jax.backend_compiles").value
+        assert compiles1 == compiles0, \
+            "%d recompiles after warmup" % (compiles1 - compiles0)
+
+        batches = telemetry.counter("serving.batches").value
+        assert 0 < batches <= N_REQUESTS
+
+        summary = telemetry.serving_summary()
+        print("serving smoke OK: %d requests in %d micro-batches, "
+              "latency p50 %.2f ms / p99 %.2f ms, 0 recompiles "
+              "(%d warmup compiles, buckets %s)"
+              % (N_REQUESTS, batches, summary["latency_p50_ms"],
+                 summary["latency_p99_ms"], compiles0,
+                 list(engine.buckets)))
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

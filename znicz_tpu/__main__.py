@@ -9,6 +9,12 @@ Examples::
     python -m znicz_tpu samples/mnist.py --snapshot snap.pickle
     python -m znicz_tpu mnist --testing
     python -m znicz_tpu --list
+    python -m znicz_tpu serve --latest wine --port 8899
+
+The ``serve`` subcommand hands off to the online inference tier
+(:mod:`znicz_tpu.serving`): a snapshot or deployment package served
+over HTTP with dynamic micro-batching — see ``serve --help`` and
+docs/serving.md.
 """
 
 import argparse
@@ -153,10 +159,17 @@ def run_genetics(module, spec, fused=None):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # the serving tier has its own flag set — dispatch before the
+        # training parser can reject them
+        from znicz_tpu.serving.server import main as serve_main
+        return serve_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m znicz_tpu",
         description="Run a znicz_tpu workflow (module path, file, or "
-                    "sample name).")
+                    "sample name); 'python -m znicz_tpu serve ...' "
+                    "starts the inference server instead.")
     parser.add_argument("workflow", nargs="?",
                         help="dotted module, .py file, or sample name")
     parser.add_argument("--config", action="append", default=[],

@@ -132,6 +132,17 @@ root.common.update({
     # Unit.sync_timings; config-backed so tests can't leak
     # blocking-sync mode into the rest of the suite)
     "timings": {"sync_each_run": False},
+    # online inference serving defaults (znicz_tpu/serving/ — see
+    # docs/serving.md for every knob's meaning)
+    "serving": {
+        "host": "127.0.0.1",
+        "port": 8899,
+        "max_batch": 64,        # micro-batch ceiling = largest bucket
+        "max_delay_ms": 5.0,    # batching window after first request
+        "queue_limit": 256,     # queued ROWS before 429 backpressure
+        "timeout_ms": 1000.0,   # per-request deadline in the queue
+        "warmup": True,         # compile every bucket before ready
+    },
 })
 
 

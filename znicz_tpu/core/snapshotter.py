@@ -149,6 +149,9 @@ class SnapshotterToFile(SnapshotterBase):
             "suffix": self.suffix,
             "time": time.time(),
         }
+        topology = self._forward_topology()
+        if topology is not None:
+            payload["topology"] = topology
         ext = "" if not self.compression else "." + self.compression
         name = "%s_%s.%d.pickle%s" % (
             self.prefix, self.suffix or "current", os.getpid(), ext)
@@ -163,6 +166,24 @@ class SnapshotterToFile(SnapshotterBase):
         os.replace(tmp, self.destination)
         self.info("snapshot -> %s", self.destination)
         return self.destination
+
+    def _forward_topology(self):
+        """Typed layer list describing the workflow's forward stack
+        (export.forward_topology) — the sidecar that lets the serving
+        engine reconstruct a jitted forward straight from the snapshot.
+        None (with a warning) when the workflow's forwards are not
+        package-describable; a snapshot must never fail over serving
+        metadata."""
+        wf = self.workflow
+        if not getattr(wf, "forwards", None):
+            return None
+        try:
+            from znicz_tpu.export import forward_topology
+            topology = forward_topology(wf)
+        except Exception as e:  # noqa: BLE001 - serving is optional
+            self.warning("snapshot carries no serving topology (%s)", e)
+            return None
+        return topology if topology["layers"] else None
 
     @staticmethod
     def import_(file_name):

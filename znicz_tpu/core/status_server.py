@@ -10,6 +10,11 @@ a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
   exposition format (core/telemetry.py; scrape it),
 * ``/plots/``      — the pngs the plotters render into <cache>/plots.
 
+The HTTP plumbing (handler ``_send`` helpers, daemon-thread lifecycle,
+idempotent ``stop()``) lives in :class:`HttpServerBase` /
+:class:`HandlerBase`, shared with the serving front end
+(:mod:`znicz_tpu.serving.server`).
+
 Usage::
 
     server = StatusServer(workflow, port=8080).start()
@@ -35,16 +40,122 @@ _PAGE = """<html><head><title>znicz_tpu status</title>
 </body></html>"""
 
 
-class StatusServer(Logger):
-    """Serves one workflow's live status over HTTP."""
+class HandlerBase(BaseHTTPRequestHandler):
+    """Shared request-handler plumbing.  Subclasses (closed over their
+    owning server) implement ``do_GET``/``do_POST`` with the ``_send*``
+    helpers; ``owner`` is the :class:`HttpServerBase` that built the
+    handler class."""
 
-    def __init__(self, workflow=None, port=0, host="127.0.0.1"):
-        super(StatusServer, self).__init__(logger_name="StatusServer")
-        self.workflow = workflow
+    owner = None
+    #: served HTTP version — keep-alive for request streams
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: route to the logger
+        if self.owner is not None:
+            self.owner.debug(fmt, *args)
+
+    def _send(self, code, ctype, body):
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            if self.close_connection:
+                # tell keep-alive clients the truth before we drop the
+                # socket (set e.g. when an unreadable body is refused)
+                self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+        except BrokenPipeError:  # client went away mid-reply
+            pass
+
+    def _send_json(self, code, obj):
+        self._send(code, "application/json",
+                   json.dumps(obj, default=str).encode())
+
+    def _read_body(self):
+        if self.headers.get("Transfer-Encoding"):
+            # only Content-Length bodies are spoken here; close the
+            # connection so an UNREAD chunked payload cannot desync the
+            # next request on a keep-alive socket
+            self.close_connection = True
+            raise ValueError("Transfer-Encoding is not supported — "
+                             "send a Content-Length body")
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length > 0 else b""
+
+    def _drain_body(self):
+        """Consume (and discard) the request body before an early
+        reply — replying with unread Content-Length bytes on the
+        socket desyncs every later request of a keep-alive
+        connection."""
+        try:
+            self._read_body()
+        except ValueError:
+            pass  # Transfer-Encoding: close_connection is already set
+
+    def _send_metrics(self):
+        """The Prometheus exposition endpoint — one definition shared
+        by the status dashboard and the serving front end."""
+        self._send(200, "text/plain; version=0.0.4; charset=utf-8",
+                   telemetry.prometheus_text().encode())
+
+
+class HttpServerBase(Logger):
+    """Daemon-thread stdlib HTTP server lifecycle.
+
+    Subclasses implement :meth:`make_handler` returning a
+    :class:`HandlerBase` subclass.  ``stop()`` is idempotent and
+    thread-safe: any number of calls (including concurrent ones) shut
+    the socket down exactly once and never raise on an already-stopped
+    server.
+    """
+
+    def __init__(self, port=0, host="127.0.0.1", logger_name=None):
+        super(HttpServerBase, self).__init__(
+            logger_name=logger_name or type(self).__name__)
         self.host = host
         self.port = port
         self._httpd = None
         self._thread = None
+        self._lifecycle_lock = threading.Lock()
+
+    def make_handler(self):
+        """Return the request-handler class for this server."""
+        raise NotImplementedError
+
+    def start(self):
+        with self._lifecycle_lock:
+            if self._httpd is not None:
+                return self
+            self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                              self.make_handler())
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=type(self).__name__.lower(), daemon=True)
+            self._thread.start()
+        self.info("%s on http://%s:%d/", type(self).__name__,
+                  self.host, self.port)
+        return self
+
+    def stop(self):
+        with self._lifecycle_lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+
+class StatusServer(HttpServerBase):
+    """Serves one workflow's live status over HTTP."""
+
+    def __init__(self, workflow=None, port=0, host="127.0.0.1"):
+        super(StatusServer, self).__init__(port=port, host=host,
+                                           logger_name="StatusServer")
+        self.workflow = workflow
 
     # -- status payload -----------------------------------------------------
     def status(self):
@@ -104,56 +215,33 @@ class StatusServer(Logger):
         return sorted(glob.glob(os.path.join(
             root.common.dirs.cache, "plots", "*.png")))
 
-    # -- lifecycle ----------------------------------------------------------
-    def start(self):
+    def make_handler(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):  # quiet
-                server.debug(fmt, *args)
+        class Handler(HandlerBase):
+            owner = server
 
             def do_GET(self):
-                try:
-                    if self.path in ("/", "/index.html"):
-                        self._send(200, "text/html",
-                                   server._render_page().encode())
-                    elif self.path == "/status.json":
-                        self._send(200, "application/json", json.dumps(
-                            server.status(), default=str).encode())
-                    elif self.path == "/metrics":
-                        self._send(
-                            200,
-                            "text/plain; version=0.0.4; charset=utf-8",
-                            telemetry.prometheus_text().encode())
-                    elif self.path.startswith("/plots/"):
-                        name = os.path.basename(self.path)
-                        path = os.path.join(root.common.dirs.cache,
-                                            "plots", name)
-                        if os.path.exists(path):
-                            with open(path, "rb") as f:
-                                self._send(200, "image/png", f.read())
-                        else:
-                            self._send(404, "text/plain", b"not found")
+                if self.path in ("/", "/index.html"):
+                    self._send(200, "text/html",
+                               server._render_page().encode())
+                elif self.path == "/status.json":
+                    self._send_json(200, server.status())
+                elif self.path == "/metrics":
+                    self._send_metrics()
+                elif self.path.startswith("/plots/"):
+                    name = os.path.basename(self.path)
+                    path = os.path.join(root.common.dirs.cache,
+                                        "plots", name)
+                    if os.path.exists(path):
+                        with open(path, "rb") as f:
+                            self._send(200, "image/png", f.read())
                     else:
                         self._send(404, "text/plain", b"not found")
-                except BrokenPipeError:
-                    pass
+                else:
+                    self._send(404, "text/plain", b"not found")
 
-            def _send(self, code, ctype, body):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="status-server",
-            daemon=True)
-        self._thread.start()
-        self.info("status server on http://%s:%d/", self.host, self.port)
-        return self
+        return Handler
 
     def _render_page(self):
         st = self.status()
@@ -164,15 +252,6 @@ class StatusServer(Logger):
             "status": json.dumps(st, indent=2, default=str),
             "plots": plots,
         }
-
-    def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
 
 
 def _plain(obj):

@@ -524,6 +524,38 @@ def summary():
             "p50": steps.get("p50"),
             "p99": steps.get("p99"),
         }
+    serving = serving_summary(snap)
+    if serving is not None:
+        out["serving"] = serving
+    return out
+
+
+def serving_summary(snap=None):
+    """The serving-tier why-block (requests, rejections, latency
+    p50/p99, batch fill) — stamped by ``bench.py --serving`` and the
+    serving smoke; None when no serving series exist."""
+    snap = snap or snapshot()
+    c, h = snap["counters"], snap["histograms"]
+    lat = h.get("serving.request_seconds")
+    if not lat or not lat.get("count"):
+        return None
+    out = {
+        "requests": int(lat["count"]),
+        "latency_p50_ms": (round(lat["p50"] * 1e3, 3)
+                           if lat.get("p50") is not None else None),
+        "latency_p99_ms": (round(lat["p99"] * 1e3, 3)
+                           if lat.get("p99") is not None else None),
+        "rejected": int(c.get("serving.rejected", 0)),
+        "timeouts": int(c.get("serving.timeouts", 0)),
+        "batches": int(c.get("serving.batches", 0)),
+    }
+    fill = h.get("serving.batch_fill")
+    if fill and fill.get("count"):
+        out["batch_fill_p50"] = fill.get("p50")
+    compiles = {name: int(v) for name, v in c.items()
+                if name.startswith("serving.compiles.")}
+    if compiles:
+        out["bucket_compiles"] = compiles
     return out
 
 

@@ -23,6 +23,10 @@ import zipfile
 
 import numpy
 
+#: the one format version this writer emits and the readers accept;
+#: bump together with a manifest-schema change
+PACKAGE_FORMAT = 1
+
 
 def _layer_type(fwd):
     mapping = getattr(type(fwd), "MAPPING", None)
@@ -31,16 +35,37 @@ def _layer_type(fwd):
     return sorted(mapping)[0]
 
 
-def export_package(workflow, path):
-    """Write ``workflow``'s forward stack as a deployment package.
+def _plain_scalar(value):
+    if isinstance(value, (tuple, set, frozenset)):
+        return list(value)
+    return value
 
-    ``workflow`` needs a ``forwards`` list (StandardWorkflow / NNWorkflow
-    contract); returns the path written.
+
+def input_sample_shape(workflow):
+    """Per-sample input shape of the forward stack, when knowable (the
+    first forward's allocated input minus the batch axis); None before
+    initialize or for input-less stacks."""
+    forwards = list(getattr(workflow, "forwards", ()))
+    if not forwards:
+        return None
+    inp = getattr(forwards[0], "input", None)
+    if inp is None or not inp:
+        return None
+    return tuple(int(d) for d in inp.shape[1:])
+
+
+def forward_manifest(workflow):
+    """``workflow``'s forward stack as (manifest dict, {fname: ndarray}).
+
+    The single source of the package schema — :func:`export_package`
+    writes exactly this, and the snapshot topology
+    (:func:`forward_topology`) is its array-free sibling.
     """
     forwards = list(workflow.forwards)
     layers = []
     files = {}
     pending_mask = None
+    pending_grouping = None
     for i, fwd in enumerate(forwards):
         tpe = _layer_type(fwd)
         if tpe == "zero_filter":
@@ -52,25 +77,42 @@ def export_package(workflow, path):
             # grouping formula).
             fwd._ensure_mask()
             pending_mask = numpy.array(fwd.mask.mem)
+            pending_grouping = int(fwd.grouping)
             continue
         entry = {"type": tpe, "name": fwd.name, "arrays": {}}
         data = fwd.package_export()
         if pending_mask is not None:
             w = data.get("weights")
-            if w is not None:
-                data = dict(data, weights=(
-                    w.reshape(pending_mask.shape) *
-                    pending_mask.astype(w.dtype)).reshape(w.shape))
-            pending_mask = None
+            if w is None:
+                # silently dropping the mask would make the package
+                # lossy (and the served forward wrong for weights the
+                # runtime re-randomizes) — refuse instead
+                raise ValueError(
+                    "zero_filter precedes %r which exports no weights "
+                    "to fold the grouping mask into" % entry["name"])
+            if w.size != pending_mask.size:
+                raise ValueError(
+                    "zero_filter mask size %d does not match %r "
+                    "weights size %d" % (pending_mask.size,
+                                         entry["name"], w.size))
+            data = dict(data, weights=(
+                w.reshape(pending_mask.shape) *
+                pending_mask.astype(w.dtype)).reshape(w.shape))
+            # keep the mask itself so the fold round-trips losslessly:
+            # import_package recovers grouping + mask instead of only
+            # the (already masked) product
+            fname = "layer%d_zero_filter_mask.npy" % i
+            files[fname] = pending_mask
+            entry["arrays"]["zero_filter_mask"] = fname
+            entry["zero_filter_grouping"] = pending_grouping
+            pending_mask = pending_grouping = None
         for attr, value in data.items():
             if isinstance(value, numpy.ndarray):
                 fname = "layer%d_%s.npy" % (i, attr)
                 files[fname] = value
                 entry["arrays"][attr] = fname
             else:
-                if isinstance(value, (tuple, set, frozenset)):
-                    value = list(value)
-                entry[attr] = value
+                entry[attr] = _plain_scalar(value)
         if entry["type"] == "activation_mul" and \
                 entry.get("factor") is None:
             # exporting before the first minibatch auto-sets the factor
@@ -81,22 +123,83 @@ def export_package(workflow, path):
                 "minibatch (or pass factor=) before exporting"
                 % entry["name"])
         layers.append(entry)
+    if pending_mask is not None:
+        raise ValueError(
+            "zero_filter is the last forward — no next layer to fold "
+            "its grouping mask into")
     manifest = {
-        "format": 1,
+        "format": PACKAGE_FORMAT,
         "workflow": type(workflow).__name__,
         "layers": layers,
     }
+    shape = input_sample_shape(workflow)
+    if shape is not None:
+        manifest["input_sample_shape"] = list(shape)
+    return manifest, files
+
+
+def forward_topology(workflow):
+    """Array-free manifest of the forward stack for snapshot payloads:
+    each entry carries the layer type string, the owning unit's name
+    (whose snapshot state holds the arrays), the array attribute names,
+    and the scalar hyperparameters.  ``zero_filter`` units are skipped —
+    they mask the next layer's weights in place on every step, so the
+    snapshotted weights are already masked.
+
+    Runs on EVERY snapshot, so unlike ``package_export()`` it never
+    touches array contents — recording the attr names must not pull a
+    full host copy of the weights per checkpoint."""
+    from znicz_tpu.core.memory import Array
+    layers = []
+    for fwd in getattr(workflow, "forwards", ()):
+        tpe = _layer_type(fwd)
+        if tpe == "zero_filter":
+            continue
+        entry = {"type": tpe, "unit": fwd.name, "arrays": []}
+        for attr in getattr(fwd, "exports", ()):
+            value = getattr(fwd, attr, None)
+            if value is None:
+                continue
+            if isinstance(value, Array):
+                if value:  # allocated — snapshot state will carry it
+                    entry["arrays"].append(attr)
+            elif isinstance(value, numpy.ndarray):
+                entry["arrays"].append(attr)
+            else:
+                entry[attr] = _plain_scalar(value)
+        layers.append(entry)
+    topology = {"layers": layers}
+    shape = input_sample_shape(workflow)
+    if shape is not None:
+        topology["input_sample_shape"] = list(shape)
+    return topology
+
+
+def export_package(workflow, path):
+    """Write ``workflow``'s forward stack as a deployment package.
+
+    ``workflow`` needs a ``forwards`` list (StandardWorkflow / NNWorkflow
+    contract); returns the path written.
+    """
+    manifest, files = forward_manifest(workflow)
+    layers = manifest["layers"]
 
     lines = []
     for i, entry in enumerate(layers):
         parts = ["type=%s" % entry["type"]]
         for attr, fname in sorted(entry["arrays"].items()):
+            if attr.startswith("zero_filter"):
+                # python-side provenance only; the C++ runtime consumes
+                # the already-masked weights and its flat parser must
+                # not see unknown array attrs
+                continue
             parts.append("%s=%s" % (attr, fname))
         # scalar / tuple hyperparameters (conv & pooling geometry, LRN
         # constants, ...) serialize as key=value / key=a,b,c for the
         # C++ runtime's flat parser
         for attr in sorted(entry):
-            if attr in ("type", "name", "arrays"):
+            if attr in ("type", "name", "arrays") or \
+                    attr.startswith("zero_filter"):
                 continue
             value = entry[attr]
             if isinstance(value, bool):
@@ -129,6 +232,36 @@ def load_package(path):
             if info.filename.endswith(".npy"):
                 arrays[info.filename] = numpy.load(
                     io.BytesIO(zf.read(info.filename)))
+    return manifest, arrays
+
+
+def import_package(path):
+    """The validating counterpart of :func:`export_package` — what the
+    Python side (the serving engine, tooling) loads packages through.
+
+    Checks the manifest format version and that every referenced array
+    file is present, so a truncated or future-format package fails here
+    with a clear message instead of deep inside the first forward.
+    Returns ``(manifest, arrays)`` like :func:`load_package`.
+    """
+    manifest, arrays = load_package(path)
+    version = manifest.get("format")
+    if version != PACKAGE_FORMAT:
+        raise ValueError(
+            "%s: unknown package format version %r (this build reads "
+            "format %d) — re-export the package with a matching "
+            "znicz_tpu version" % (path, version, PACKAGE_FORMAT))
+    if not isinstance(manifest.get("layers"), list):
+        raise ValueError("%s: manifest.json has no layers list" % path)
+    for entry in manifest["layers"]:
+        if "type" not in entry:
+            raise ValueError("%s: manifest layer without type: %r"
+                             % (path, entry))
+        for attr, fname in entry.get("arrays", {}).items():
+            if fname not in arrays:
+                raise ValueError(
+                    "%s: layer %r references missing array file %r"
+                    % (path, entry.get("name", entry["type"]), fname))
     return manifest, arrays
 
 

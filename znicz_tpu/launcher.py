@@ -232,14 +232,7 @@ class Launcher(Logger):
         if snap is None:
             self.warning("--auto-resume: workflow has no snapshotter")
             return None
-        directory = snap.directory
-        if not os.path.isdir(directory):
-            return None
-        cands = [os.path.join(directory, f) for f in os.listdir(directory)
-                 if f.startswith(snap.prefix + "_")
-                 and ".pickle" in f and not f.endswith(".part")]
-        cands.sort(key=os.path.getmtime, reverse=True)
-        for path in cands:
+        for path in snapshot_candidates(snap.directory, snap.prefix):
             try:
                 state = SnapshotterToFile.import_(path)
             except Exception as e:  # noqa: BLE001 - corrupt snapshot
@@ -273,6 +266,26 @@ class Launcher(Logger):
         if not self.dry_run:
             wf.run()
         return wf
+
+
+def snapshot_candidates(directory, prefix):
+    """Snapshot paths under ``directory`` matching the snapshotter
+    naming scheme for ``prefix``, newest first — the one listing shared
+    by ``--auto-resume`` (Launcher) and ``serve --latest``
+    (znicz_tpu.serving).  In-flight ``.part`` files are excluded."""
+    if not directory or not os.path.isdir(directory):
+        return []
+    cands = [os.path.join(directory, f) for f in os.listdir(directory)
+             if f.startswith(prefix + "_")
+             and ".pickle" in f and not f.endswith(".part")]
+    cands.sort(key=os.path.getmtime, reverse=True)
+    return cands
+
+
+def newest_snapshot(directory, prefix):
+    """The newest snapshot for ``prefix`` (None when there is none)."""
+    cands = snapshot_candidates(directory, prefix)
+    return cands[0] if cands else None
 
 
 def resolve_workflow_module(spec):

@@ -1,0 +1,27 @@
+"""Online inference serving — the traffic-carrying consumer of the
+train → snapshot/export → serve loop.
+
+Three pillars (docs/serving.md):
+
+* :class:`znicz_tpu.serving.engine.InferenceEngine` — loads a training
+  snapshot or a deployment package, reconstructs the forward stack as
+  ONE jitted pure function, and keeps a shape-bucketed compile cache
+  (pad-to-bucket batches, eager warmup) so steady-state traffic never
+  recompiles;
+* :class:`znicz_tpu.serving.batcher.MicroBatcher` — dynamic
+  micro-batching with a bounded queue (429-style backpressure), a
+  size-or-deadline batching window, and per-request deadlines;
+* :class:`znicz_tpu.serving.server.ServingServer` — the stdlib HTTP
+  front end (``POST /predict``, ``GET /healthz``, ``POST /reload``,
+  ``GET /metrics``), fully instrumented through
+  :mod:`znicz_tpu.core.telemetry`.
+"""
+
+from znicz_tpu.serving.engine import (  # noqa: F401 - re-export
+    InferenceEngine, default_buckets)
+from znicz_tpu.serving.batcher import (  # noqa: F401 - re-export
+    MicroBatcher, QueueFullError, RequestTimeoutError)
+from znicz_tpu.serving.server import ServingServer  # noqa: F401
+
+__all__ = ["InferenceEngine", "MicroBatcher", "ServingServer",
+           "QueueFullError", "RequestTimeoutError", "default_buckets"]

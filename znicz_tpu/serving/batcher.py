@@ -1,0 +1,300 @@
+"""Dynamic micro-batcher — the concurrency heart of the serving tier.
+
+Requests (each a batch-first array of 1..max_batch rows) enter a
+bounded queue; one worker thread coalesces them into micro-batches.  A
+batching window closes when either
+
+* ``max_batch`` rows are pending (size close), or
+* ``max_delay_ms`` elapsed since the OLDEST pending request arrived
+  (deadline close — bounded latency under trickle traffic).
+
+The coalesced rows run through the engine in one dispatch (which pads
+to the enclosing shape bucket), and the result rows are scattered back
+to each caller's future.  Overload shows up as *fast failure*, not
+collapse:
+
+* a full queue rejects new work with :class:`QueueFullError`
+  (the HTTP front end maps it to 429),
+* a request whose per-request deadline expires while queued fails with
+  :class:`RequestTimeoutError` (mapped to 504) without wasting a
+  dispatch on it.
+
+Telemetry series (when enabled): ``serving.queue_depth`` gauge (rows),
+``serving.batch_rows`` / ``serving.batch_fill`` /
+``serving.request_seconds`` histograms, ``serving.batches`` /
+``serving.rejected`` / ``serving.timeouts`` / ``serving.errors``
+counters.
+"""
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import telemetry
+
+
+#: extra seconds predict() waits past the request deadline before
+#: giving up on the future — covers a dispatch (possibly a warmup
+#: compile) that started just before the deadline
+_DISPATCH_GRACE = 60.0
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the bounded request queue is full (HTTP 429)."""
+
+
+class RequestTimeoutError(TimeoutError):
+    """The request's deadline expired while it waited (HTTP 504)."""
+
+
+class _Request(object):
+    __slots__ = ("arr", "rows", "future", "arrived", "deadline")
+
+    def __init__(self, arr, rows, future, arrived, deadline):
+        self.arr = arr
+        self.rows = rows
+        self.future = future
+        self.arrived = arrived
+        self.deadline = deadline
+
+
+class MicroBatcher(Logger):
+    """Coalesces concurrent predict requests into micro-batches.
+
+    ``engine`` is an :class:`~znicz_tpu.serving.engine.InferenceEngine`
+    or any ``callable(batch) -> batch`` (tests use plain functions).
+    Unset knobs come from ``root.common.serving``.  ``timeout_ms`` is
+    the default per-request queue deadline (0/None disables).
+    """
+
+    def __init__(self, engine, max_batch=None, max_delay_ms=None,
+                 queue_limit=None, timeout_ms=None):
+        super(MicroBatcher, self).__init__(logger_name="MicroBatcher")
+        cfg = root.common.serving
+        self._engine = engine if hasattr(engine, "predict") else None
+        self._predict = (engine.predict if self._engine is not None
+                         else engine)
+        self._bucket_for = getattr(engine, "bucket_for", None)
+        self.max_batch = int(max_batch if max_batch is not None
+                             else getattr(engine, "max_batch", None)
+                             or cfg.get("max_batch", 64))
+        self.max_delay = float(
+            max_delay_ms if max_delay_ms is not None
+            else cfg.get("max_delay_ms", 5.0)) / 1e3
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else cfg.get("queue_limit", 256))
+        timeout_ms = (timeout_ms if timeout_ms is not None
+                      else cfg.get("timeout_ms", 1000.0))
+        self.timeout = float(timeout_ms) / 1e3 if timeout_ms else None
+        self._queue = collections.deque()
+        self._rows_queued = 0
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._worker, name="micro-batcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, flush=True):
+        """Stop the worker.  ``flush=True`` serves what is already
+        queued first; ``flush=False`` fails pending futures."""
+        with self._cond:
+            if not self._running and self._thread is None:
+                return
+            self._running = False
+            if not flush:
+                while self._queue:
+                    r = self._queue.popleft()
+                    r.future.set_exception(
+                        RuntimeError("batcher stopped"))
+                self._rows_queued = 0
+            self._cond.notify_all()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=30)
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, x, timeout_ms=None):
+        """Enqueue a request; returns a ``concurrent.futures.Future``
+        resolving to the output rows for ``x``.
+
+        Raises :class:`QueueFullError` when the queue is at capacity
+        and ``ValueError`` for empty/oversized requests.
+        """
+        x = numpy.asarray(x)
+        # ONE batch-axis rule shared with the engine
+        # (engine.matches_sample_shape): an array matching the model's
+        # per-sample shape is a single sample — a rank-2 spatial
+        # sample must not be counted as H rows, which would coalesce
+        # into a garbage concatenation
+        sample = (getattr(self._engine, "sample_shape", None)
+                  if self._engine is not None else None)
+        if sample is not None:
+            from znicz_tpu.serving.engine import matches_sample_shape
+            if matches_sample_shape(x.shape, sample):
+                x = x[None]
+        if x.ndim < 2:
+            x = numpy.atleast_2d(x)
+        rows = x.shape[0]
+        if rows == 0:
+            raise ValueError("empty request")
+        if rows > self.max_batch:
+            raise ValueError(
+                "request of %d rows exceeds max_batch %d — split it "
+                "client-side" % (rows, self.max_batch))
+        now = time.monotonic()
+        timeout = (self.timeout if timeout_ms is None
+                   else (float(timeout_ms) / 1e3 or None))
+        deadline = now + timeout if timeout else None
+        future = Future()
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("batcher is not running")
+            if self._rows_queued + rows > self.queue_limit:
+                if telemetry.enabled():
+                    telemetry.counter("serving.rejected").inc()
+                raise QueueFullError(
+                    "queue full (%d rows queued, limit %d)"
+                    % (self._rows_queued, self.queue_limit))
+            self._queue.append(_Request(x, rows, future, now, deadline))
+            self._rows_queued += rows
+            if telemetry.enabled():
+                telemetry.gauge("serving.queue_depth").set(
+                    self._rows_queued)
+            self._cond.notify_all()
+        return future
+
+    def predict(self, x, timeout_ms=None):
+        """Blocking submit: returns the output rows (or raises what the
+        worker raised).
+
+        When the request carries a deadline, the wait is BOUNDED too
+        (deadline + a dispatch grace) — a wedged dispatch must not
+        strand the caller forever; the queue-expiry check alone only
+        covers time spent queued."""
+        import concurrent.futures
+        timeout = (self.timeout if timeout_ms is None
+                   else (float(timeout_ms) / 1e3 or None))
+        future = self.submit(x, timeout_ms=timeout_ms)
+        if timeout is None:
+            return future.result()
+        try:
+            return future.result(timeout=timeout + _DISPATCH_GRACE)
+        except concurrent.futures.TimeoutError:
+            raise RequestTimeoutError(
+                "request did not complete within %.1f s (deadline "
+                "%.1f s + %.0f s dispatch grace)"
+                % (timeout + _DISPATCH_GRACE, timeout,
+                   _DISPATCH_GRACE))
+
+    @property
+    def queued_rows(self):
+        return self._rows_queued
+
+    # -- the worker ---------------------------------------------------------
+    def _worker(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    def _take_batch(self):
+        """Block until a window closes; pop FIFO requests totalling at
+        most ``max_batch`` rows.  None = stopped and drained."""
+        with self._cond:
+            while not self._queue and self._running:
+                self._cond.wait()
+            if not self._queue:
+                return None  # stopped, nothing left to flush
+            window_close = self._queue[0].arrived + self.max_delay
+            while self._running and \
+                    self._rows_queued < self.max_batch:
+                remaining = window_close - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            if not self._queue:
+                # stop(flush=False) drained the queue while we waited
+                # out the batching window
+                return None
+            batch, rows = [], 0
+            # coalesce FIFO, same trailing (sample) shape only — rows
+            # of different widths cannot share a concatenated dispatch;
+            # a mismatched request simply heads the next batch
+            sample_shape = self._queue[0].arr.shape[1:]
+            while self._queue and \
+                    rows + self._queue[0].rows <= self.max_batch and \
+                    self._queue[0].arr.shape[1:] == sample_shape:
+                r = self._queue.popleft()
+                batch.append(r)
+                rows += r.rows
+            self._rows_queued -= rows
+            if telemetry.enabled():
+                telemetry.gauge("serving.queue_depth").set(
+                    self._rows_queued)
+            return batch
+
+    def _run_batch(self, batch):
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                if telemetry.enabled():
+                    telemetry.counter("serving.timeouts").inc()
+                r.future.set_exception(RequestTimeoutError(
+                    "request expired after %.1f ms in queue"
+                    % ((now - r.arrived) * 1e3)))
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        try:
+            # EVERYTHING from here — telemetry (bucket_for can raise on
+            # an engine/batcher max_batch mismatch), batch assembly
+            # (dtype clash, bad buffer), dispatch — is inside the
+            # guard: any surprise must fail this batch's futures, never
+            # kill the worker thread, which would strand every future
+            # request forever
+            if telemetry.enabled():
+                telemetry.counter("serving.batches").inc()
+                telemetry.histogram("serving.batch_rows").observe(rows)
+                bucket = (self._bucket_for(rows) if self._bucket_for
+                          else self.max_batch)
+                telemetry.histogram("serving.batch_fill").observe(
+                    rows / float(bucket))
+            x = (live[0].arr if len(live) == 1 else
+                 numpy.concatenate([r.arr for r in live], axis=0))
+            with telemetry.span("serving.batch", rows=rows,
+                                requests=len(live)):
+                y = self._predict(x)
+        except Exception as e:  # noqa: BLE001 - fail the batch, not us
+            if telemetry.enabled():
+                telemetry.counter("serving.errors").inc()
+            self.warning("batch of %d rows failed: %r", rows, e)
+            for r in live:
+                r.future.set_exception(e)
+            return
+        done = time.monotonic()
+        offset = 0
+        latency = (telemetry.histogram("serving.request_seconds")
+                   if telemetry.enabled() else None)
+        for r in live:
+            r.future.set_result(numpy.asarray(y)[offset:offset + r.rows])
+            offset += r.rows
+            if latency is not None:
+                latency.observe(done - r.arrived)

@@ -1,0 +1,580 @@
+"""Inference engine — snapshot/package-backed jitted forward with a
+shape-bucketed compile cache.
+
+The engine closes the gap between the paper's deployment story (a zip
+package consumed by the C++ runtime — ``export.py``) and online
+serving: it loads either
+
+* a **training snapshot** (``core/snapshotter.py`` pickle) through the
+  ``topology`` sidecar the snapshotter records (the array-free manifest
+  of the forward stack; arrays come from the per-unit snapshot state),
+  or
+* a **deployment package** (``export.import_package``: ``manifest.json``
+  + ``.npy`` layers — the same zip libZnicz consumes),
+
+normalizes both into one internal form (typed layer entries + a params
+pytree) and builds ONE ``jax.jit``-compiled pure function
+``forward(params, x)``.  Params are an *argument*, not a closure, so a
+hot reload with an unchanged topology reuses every compiled
+executable — zero recompiles across model version bumps.
+
+**Shape buckets.** jit compiles per input shape, so free-form batch
+sizes would recompile constantly.  ``predict`` pads every batch up to
+the next bucket (powers of two up to ``max_batch`` by default) and
+slices the padding back off; :meth:`warmup` eagerly compiles every
+bucket so steady-state requests NEVER trigger a compile (asserted by
+``tools/serving_smoke.py`` via the ``jax.backend_compiles`` telemetry
+counter).
+
+Telemetry (when enabled): per-bucket compile counters
+(``serving.compiles.<bucket>``), a ``serving.predict`` span per
+dispatch, and a ``serving.model_version`` gauge.
+"""
+
+import json
+import os
+import threading
+import zipfile
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import telemetry
+
+
+def default_buckets(max_batch):
+    """Powers of two up to (and always including) ``max_batch``."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1, got %d" % max_batch)
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+#: fused-layer activation epilogues by package type string (the same
+#: tables run_package_numpy pins the numpy/C++ runners to)
+_FC_ACT = {"all2all": "linear", "all2all_tanh": "tanh",
+           "all2all_relu": "relu", "all2all_str": "strict_relu",
+           "all2all_sigmoid": "sigmoid"}
+_CONV_ACT = {"conv": "linear", "conv_tanh": "tanh", "conv_relu": "relu",
+             "conv_str": "strict_relu", "conv_sigmoid": "sigmoid"}
+_STANDALONE_ACT = {"activation_tanh": "tanh",
+                   "activation_sigmoid": "sigmoid",
+                   "activation_relu": "relu",
+                   "activation_str": "strict_relu"}
+
+
+def _nhwc(y):
+    """The implicit single-channel NHWC convention every spatial unit
+    shares (nn_units.as_nhwc): 3-D (B, H, W) batches gain a channel
+    axis; 4-D pass through."""
+    if y.ndim == 3:
+        return y.reshape(y.shape + (1,))
+    return y
+
+
+def _apply_layer(entry, params, y):
+    """One manifest layer as a pure jax computation (the jax twin of
+    ``export.run_package_numpy`` — same layer scope, same semantics)."""
+    from znicz_tpu.ops import activations, dense
+    from znicz_tpu.ops import conv as conv_ops
+    from znicz_tpu.ops import normalization as norm_ops
+    from znicz_tpu.ops import pooling as pool_ops
+
+    tpe = entry["type"]
+    if tpe == "softmax" or tpe.startswith("all2all"):
+        w = params["weights"]
+        b = params.get("bias")
+        include_bias = bool(entry.get("include_bias", True)) and \
+            b is not None
+        transposed = bool(entry.get("weights_transposed", False))
+        y = y.reshape(y.shape[0], -1)
+        act = "linear" if tpe == "softmax" else _FC_ACT[tpe]
+        y = dense.forward_jax(y, w, b, activation=act,
+                              weights_transposed=transposed,
+                              include_bias=include_bias)
+        if tpe == "softmax":
+            y, _ = dense.softmax_jax(y)
+        return y
+    if tpe.startswith("conv"):
+        w = params["weights"]
+        b = params.get("bias")
+        include_bias = bool(entry.get("include_bias", True)) and \
+            b is not None
+        if entry.get("weights_transposed"):
+            w = w.T
+        return conv_ops.forward_jax(
+            _nhwc(y), w, b, int(entry["ky"]), int(entry["kx"]),
+            tuple(int(v) for v in entry["padding"]),
+            tuple(int(v) for v in entry["sliding"]),
+            activation=_CONV_ACT[tpe], include_bias=include_bias)
+    if tpe in ("max_pooling", "avg_pooling"):
+        return pool_ops.pooling_fwd_jax(
+            _nhwc(y), int(entry["ky"]), int(entry["kx"]),
+            tuple(int(v) for v in entry["sliding"]),
+            mode=("max" if tpe == "max_pooling" else "avg"))
+    if tpe == "norm":
+        return norm_ops.lrn_forward_jax(
+            y, alpha=float(entry["alpha"]), beta=float(entry["beta"]),
+            k=float(entry["k"]), n=int(entry["n"]))
+    if tpe == "activation_mul":
+        return y * float(entry["factor"])
+    if tpe.startswith("activation_"):
+        act = _STANDALONE_ACT.get(tpe)
+        if act is not None:
+            return activations.apply_jax(act, y)
+        return activations.ext_apply_jax(tpe[len("activation_"):], y)
+    if tpe == "dropout":
+        return y  # inference identity
+    raise ValueError("serving engine: unsupported layer type %r" % tpe)
+
+
+_EXT_ACT = ("log", "tanhlog", "sincos")
+
+
+def _validate_layers(layers):
+    """Fail at LOAD time for anything _apply_layer would reject at
+    trace time — a bad model must never take the first request down."""
+    for entry in layers:
+        tpe = entry["type"]
+        name = entry.get("name", tpe)
+        if tpe == "activation_mul":
+            if entry.get("factor") is None:
+                raise ValueError(
+                    "layer %r: activation_mul factor is unset — the "
+                    "snapshot/package was written before the first "
+                    "minibatch auto-set it" % name)
+            continue
+        if tpe == "softmax" or tpe in _FC_ACT or tpe in _CONV_ACT or \
+                tpe in ("max_pooling", "avg_pooling", "norm", "dropout"):
+            continue
+        if tpe.startswith("activation_") and (
+                tpe in _STANDALONE_ACT or
+                tpe[len("activation_"):] in _EXT_ACT):
+            continue
+        raise ValueError("serving engine: unsupported layer type %r "
+                         "(layer %r)" % (tpe, name))
+
+
+class _Model(object):
+    """One loaded model generation — swapped atomically on reload.
+
+    ``warm`` (the compiled-bucket set) lives HERE, not on the engine:
+    an in-flight predict on the outgoing model during a topology-
+    changing reload must mark the OLD generation's buckets, never the
+    new one's (which would make warmup skip a bucket that was never
+    compiled for the new function)."""
+
+    __slots__ = ("layers", "params", "fn", "key", "dtype",
+                 "sample_shape", "source", "version", "warm")
+
+    def __init__(self, layers, params, fn, key, dtype, sample_shape,
+                 source, version, warm):
+        self.layers = layers
+        self.params = params
+        self.fn = fn
+        self.key = key
+        self.dtype = dtype
+        self.sample_shape = sample_shape
+        self.source = source
+        self.version = version
+        self.warm = warm
+
+
+def _build_forward(layers):
+    """Compose the layer chain into one jitted ``forward(params, x)``.
+
+    ``layers`` is static (closed over); ``params`` is a pytree argument
+    so param-only reloads hit the existing executable.
+    """
+    import jax
+
+    def forward(params, x):
+        y = x
+        for entry, p in zip(layers, params):
+            y = _apply_layer(entry, p, y)
+        return y
+
+    return jax.jit(forward)
+
+
+class InferenceEngine(Logger):
+    """Serves a trained forward stack as a pure jitted function.
+
+    ``source`` is a snapshot pickle path, a package zip path, or a
+    ``(manifest, arrays)`` pair (``export.import_package`` output — the
+    in-memory path ``bench.py --serving`` uses).  ``max_batch`` caps the
+    largest bucket; ``buckets`` overrides the power-of-two ladder.
+    ``sample_shape`` overrides the per-sample input shape when the
+    source does not record one (old packages).
+    """
+
+    def __init__(self, source=None, max_batch=None, buckets=None,
+                 sample_shape=None, warmup=None):
+        super(InferenceEngine, self).__init__(
+            logger_name="InferenceEngine")
+        cfg = root.common.serving
+        if buckets:
+            self.buckets = tuple(sorted(int(b) for b in buckets))
+            if max_batch is not None and \
+                    int(max_batch) != self.buckets[-1]:
+                raise ValueError(
+                    "max_batch %r contradicts buckets %r"
+                    % (max_batch, buckets))
+        else:
+            self.buckets = default_buckets(
+                max_batch if max_batch is not None
+                else cfg.get("max_batch", 64))
+        self.max_batch = self.buckets[-1]
+        self._warmup_wanted = (bool(cfg.get("warmup", True))
+                               if warmup is None else bool(warmup))
+        self._sample_shape_override = (
+            tuple(sample_shape) if sample_shape is not None else None)
+        self._model = None
+        self._load_lock = threading.Lock()
+        self._version = 0
+        self._ready = threading.Event()
+        if source is not None:
+            self.load(source)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def ready(self):
+        """True once a model is loaded AND warmup (when wanted) ran."""
+        return self._ready.is_set()
+
+    @property
+    def version(self):
+        return self._version
+
+    @property
+    def source(self):
+        m = self._model
+        return m.source if m is not None else None
+
+    @property
+    def sample_shape(self):
+        m = self._model
+        return m.sample_shape if m is not None else None
+
+    @property
+    def dtype(self):
+        """The loaded model's compute dtype (None before a load) — the
+        HTTP front end parses request bodies straight into it."""
+        m = self._model
+        return m.dtype if m is not None else None
+
+    @property
+    def warm_buckets(self):
+        m = self._model
+        return tuple(sorted(m.warm)) if m is not None else ()
+
+    def stats(self):
+        """healthz payload: what is loaded, how warm, how big."""
+        m = self._model
+        return {
+            "ready": self.ready,
+            "model_version": self._version,
+            "source": m.source if m else None,
+            "layers": [e["type"] for e in m.layers] if m else None,
+            "sample_shape": (list(m.sample_shape)
+                             if m and m.sample_shape else None),
+            "dtype": str(numpy.dtype(m.dtype)) if m else None,
+            "buckets": list(self.buckets),
+            "warm_buckets": list(self.warm_buckets),
+        }
+
+    # -- loading ------------------------------------------------------------
+    def load(self, source, sample_shape=None):
+        """Load (or hot-reload) a model; returns the new version.
+
+        Serving continues on the old model until the new one is swapped
+        in; with an unchanged topology the compiled executables (and
+        the warm-bucket set) carry over, so a reload costs zero
+        recompiles.
+        """
+        layers, arrays_list, label, src_shape = \
+            self._load_source(source)
+        _validate_layers(layers)
+        params = []
+        dtype = None
+        for arrs in arrays_list:
+            p = {}
+            for attr, value in arrs.items():
+                value = numpy.asarray(value)
+                if dtype is None and \
+                        numpy.issubdtype(value.dtype, numpy.floating):
+                    dtype = value.dtype
+                p[attr] = value
+            params.append(p)
+        dtype = dtype or numpy.float32
+        # pin the params device-resident ONCE — dispatches must not pay
+        # a host->device upload per request (jit's cache key only sees
+        # shape/dtype, so this changes nothing else)
+        import jax
+        params = jax.device_put(params)
+        if sample_shape is not None:
+            shape = tuple(sample_shape)
+        else:
+            shape = src_shape or self._sample_shape_override or \
+                _derived_sample_shape(layers, params)
+        # the compile-cache key: topology + array shapes/dtypes — any
+        # difference means the old executables cannot be reused
+        key = json.dumps(
+            [layers, [{a: [str(v.dtype)] + list(v.shape)
+                       for a, v in p.items()} for p in params]],
+            sort_keys=True, default=str)
+        with self._load_lock:
+            old = self._model
+            if old is not None and old.key == key:
+                # unchanged topology: the compiled executables AND the
+                # warm-bucket set carry over to the new generation
+                fn, warm = old.fn, old.warm
+            else:
+                fn, warm = _build_forward(layers), set()
+                self._ready.clear()
+            self._version += 1
+            model = _Model(layers, params, fn, key, dtype, shape,
+                           label, self._version, warm)
+            self._model = model
+            if telemetry.enabled():
+                telemetry.gauge("serving.model_version").set(
+                    self._version)
+        self.info("model v%d <- %s (%d layers, dtype %s, "
+                  "sample shape %s)", self._version, label,
+                  len(layers), numpy.dtype(dtype).name, shape)
+        if not self._warmup_wanted:
+            self._ready.set()
+            return self._version
+        try:
+            self.warmup()
+        except Exception:
+            # a model that passed structural validation but fails at
+            # trace/compile time must not brick a healthy server: roll
+            # the swap back so serving continues on the old generation
+            with self._load_lock:
+                if self._model is model:
+                    self._model = old
+                    self._version = old.version if old else 0
+                    if telemetry.enabled():
+                        # keep the gauge on the version that SERVES
+                        telemetry.gauge("serving.model_version").set(
+                            self._version)
+            if old is not None:
+                self._ready.set()
+                self.warning("reload of %s failed at warmup; still "
+                             "serving v%d", label, old.version)
+            raise
+        return self._version
+
+    def _load_source(self, source):
+        """Normalize any source into
+        (layers, per-layer arrays, label, sample_shape)."""
+        if isinstance(source, tuple) and len(source) == 2:
+            manifest, arrays = source
+            return self._from_manifest(manifest, arrays, "<in-memory>")
+        path = os.fspath(source)
+        if zipfile.is_zipfile(path):
+            from znicz_tpu.export import import_package
+            manifest, arrays = import_package(path)
+            return self._from_manifest(manifest, arrays, path)
+        from znicz_tpu.core.snapshotter import SnapshotterToFile
+        state = SnapshotterToFile.import_(path)
+        return self._from_snapshot(state, path)
+
+    def _from_manifest(self, manifest, arrays, label):
+        layers, arrays_list = [], []
+        for entry in manifest["layers"]:
+            norm = {k: v for k, v in entry.items() if k != "arrays"}
+            p = {}
+            for attr, fname in entry.get("arrays", {}).items():
+                if attr.startswith("zero_filter"):
+                    continue  # provenance; weights arrive pre-masked
+                p[attr] = arrays[fname]
+            layers.append(norm)
+            arrays_list.append(p)
+        shape = manifest.get("input_sample_shape")
+        shape = tuple(int(d) for d in shape) if shape else None
+        return layers, arrays_list, label, shape
+
+    def _from_snapshot(self, state, label):
+        topology = state.get("topology")
+        if not topology or not topology.get("layers"):
+            raise ValueError(
+                "%s: snapshot carries no serving topology (written by "
+                "an older snapshotter, or the workflow has no typed "
+                "forwards) — re-snapshot with this version or serve a "
+                "deployment package (export.export_package)" % label)
+        units = state.get("units", {})
+        layers, arrays_list = [], []
+        for entry in topology["layers"]:
+            norm = {k: v for k, v in entry.items()
+                    if k not in ("arrays", "unit")}
+            ustate = units.get(entry["unit"], {})
+            p = {}
+            for attr in entry.get("arrays", ()):
+                value = ustate.get(attr)
+                if value is not None:
+                    p[attr] = numpy.asarray(value)
+            layers.append(norm)
+            arrays_list.append(p)
+        _fill_from_fused_state(state, topology, layers, arrays_list,
+                               label)
+        shape = topology.get("input_sample_shape")
+        shape = tuple(int(d) for d in shape) if shape else None
+        return layers, arrays_list, label, shape
+
+    # -- buckets / prediction ----------------------------------------------
+    def bucket_for(self, n):
+        """Smallest bucket >= n rows; raises for n over max_batch."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("batch of %d rows" % n)
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError("batch of %d rows exceeds max_batch %d"
+                         % (n, self.max_batch))
+
+    def predict(self, x):
+        """Forward ``x`` (batch-first) through the loaded model.
+
+        Pads to the enclosing bucket, dispatches the jitted function,
+        slices the padding back off, returns a numpy array.
+        """
+        m = self._model
+        if m is None:
+            raise RuntimeError("no model loaded")
+        x = numpy.asarray(x, dtype=m.dtype)
+        if m.sample_shape is not None:
+            sample = tuple(m.sample_shape)
+            if matches_sample_shape(x.shape, sample):
+                # single-sample convenience — shape-matched, never
+                # rank-matched (a rank-only test would swallow e.g. a
+                # 3-D (B, H, W) batch under a 3-D NHWC sample shape)
+                x = x[None]
+            _check_sample_shape(x.shape[1:], sample)
+            if x.shape[1:] != sample:
+                # normalize the accepted NHWC-equivalent convention to
+                # the recorded shape — the jit cache keys on concrete
+                # shapes, so the variant must share the warmed
+                # executables, not silently compile its own
+                x = x.reshape((x.shape[0],) + sample)
+        n = x.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket > n:
+            padded = numpy.zeros((bucket,) + x.shape[1:], dtype=m.dtype)
+            padded[:n] = x
+            x = padded
+        # the one place a compile can happen: the first dispatch of
+        # this (bucket, model-generation) pair.  Marked warm only AFTER
+        # the dispatch succeeds — a failed trace must not make
+        # warmup()/the counters believe the bucket compiled.
+        first = bucket not in m.warm
+        if not telemetry.enabled():
+            y = numpy.asarray(m.fn(m.params, x))[:n]
+        else:
+            with telemetry.span("serving.predict", rows=n,
+                                bucket=bucket):
+                y = numpy.asarray(m.fn(m.params, x))[:n]
+        if first:
+            m.warm.add(bucket)
+            if telemetry.enabled():
+                telemetry.counter("serving.compiles.%d" % bucket).inc()
+        return y
+
+    def warmup(self):
+        """Eagerly compile every bucket; flips :attr:`ready`.
+
+        Needs a known per-sample shape (recorded by snapshots/packages
+        of initialized workflows, derivable for FC stacks, or passed as
+        ``sample_shape=``); without one the engine stays lazy —
+        readiness then means "first request compiles".
+        """
+        m = self._model
+        if m is None:
+            raise RuntimeError("no model loaded")
+        if m.sample_shape is None:
+            self.warning("cannot warm up: per-sample input shape "
+                         "unknown — pass sample_shape=")
+            self._ready.set()
+            return
+        for bucket in self.buckets:
+            if bucket in m.warm:
+                continue
+            self.predict(numpy.zeros((bucket,) + m.sample_shape,
+                                     dtype=m.dtype))
+        self._ready.set()
+        self.info("warm: buckets %s", list(self.buckets))
+
+
+def matches_sample_shape(shape, sample):
+    """True when ``shape`` is ONE sample of a model whose per-sample
+    shape is ``sample``: exact, or the implicit-single-channel NHWC
+    equivalences every spatial unit honors (``(H, W)`` <->
+    ``(H, W, 1)``).  The one batch-axis rule, shared by the engine and
+    the micro-batcher."""
+    shape, sample = tuple(shape), tuple(sample)
+    return shape == sample or shape == sample + (1,) or \
+        (sample[-1:] == (1,) and shape == sample[:-1])
+
+
+def _check_sample_shape(trailing, sample):
+    """Reject client batches whose per-sample shape the model was not
+    warmed for — a novel trailing shape would silently compile a fresh
+    executable per bucket on the serving hot path (unbounded compile
+    cache, p99 collapse)."""
+    if not matches_sample_shape(trailing, sample):
+        raise ValueError(
+            "per-sample shape %s does not match the model's input "
+            "shape %s" % (tuple(trailing), tuple(sample)))
+
+
+def _derived_sample_shape(layers, params):
+    """Per-sample input shape when the first layer pins it (FC family:
+    weights are (neurons, sample_size)); None for spatial stacks."""
+    for entry, p in zip(layers, params):
+        tpe = entry["type"]
+        if tpe == "softmax" or tpe.startswith("all2all"):
+            w = p.get("weights")
+            if w is None:
+                return None
+            size = (w.shape[0] if entry.get("weights_transposed")
+                    else w.shape[1])
+            return (int(size),)
+        return None  # spatial/standalone head: shape not derivable
+    return None
+
+
+def _fill_from_fused_state(state, topology, layers, arrays_list, label):
+    """Fused-mode snapshots keep params in the trainer's pytree, not in
+    per-forward units — map them positionally onto the topology (the
+    fused layer list and the forwards align 1:1 when both exist)."""
+    missing = [i for i, (entry, p) in enumerate(zip(layers, arrays_list))
+               if "weights" in topology["layers"][i].get("arrays", ())
+               and "weights" not in p]
+    if not missing:
+        return
+    fused = state.get("units", {}).get("fused_trainer", {}) \
+        .get("fused_state")
+    fused_params = list(fused.get("params", ())) if fused else None
+    if not fused_params or len(fused_params) != len(layers):
+        raise ValueError(
+            "%s: layers %s have no weights in the snapshot (and no "
+            "matching fused trainer state) — snapshot a trained "
+            "workflow or export a package instead"
+            % (label, [layers[i]["type"] for i in missing]))
+    for i in missing:
+        p = fused_params[i] or {}
+        if p.get("w") is None:
+            raise ValueError(
+                "%s: fused state carries no weights for layer %d (%s)"
+                % (label, i, layers[i]["type"]))
+        arrays_list[i]["weights"] = numpy.asarray(p["w"])
+        if p.get("b") is not None:
+            arrays_list[i]["bias"] = numpy.asarray(p["b"])

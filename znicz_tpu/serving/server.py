@@ -1,0 +1,257 @@
+"""Production HTTP front end for the inference engine.
+
+Built on the shared stdlib HTTP plumbing of
+:mod:`znicz_tpu.core.status_server` (``HttpServerBase``/``HandlerBase``
+— one ``ThreadingHTTPServer`` on a daemon thread).  Every request
+thread submits to the :class:`~znicz_tpu.serving.batcher.MicroBatcher`
+and blocks on its future, so concurrent HTTP clients coalesce into
+micro-batches without any extra machinery.
+
+Endpoints:
+
+* ``POST /predict`` — JSON body ``{"inputs": [[...], ...]}`` (or a bare
+  JSON array), or a raw ``.npy`` payload with
+  ``Content-Type: application/octet-stream``.  Replies in kind: JSON
+  ``{"outputs": ..., "argmax": ..., "model_version": ...}`` or raw
+  ``.npy`` bytes.  Status codes: 400 malformed, 429 queue full
+  (backpressure), 503 not warmed up, 504 deadline expired.
+* ``GET /healthz`` — readiness probe: 200 once warmup finished, 503
+  while compiling; body is the engine's stats dict.
+* ``POST /reload`` — ``{"path": "..."}`` hot-swaps the model from a new
+  snapshot/package path.  Unchanged topology reuses every compiled
+  bucket (zero recompiles); a changed one re-warms before flipping
+  readiness back.
+* ``GET /metrics`` — the telemetry registry in Prometheus text format.
+* ``GET /statusz`` (and ``/``) — JSON serving stats.
+
+CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
+
+    python -m znicz_tpu serve wine_current.0.pickle --port 8899
+    python -m znicz_tpu serve --latest wine          # newest snapshot
+    python -m znicz_tpu serve model.zip --max-batch 32 --max-delay-ms 2
+"""
+
+import argparse
+import io
+import json
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.status_server import HandlerBase, HttpServerBase
+from znicz_tpu.core import telemetry
+from znicz_tpu.serving.batcher import (MicroBatcher, QueueFullError,
+                                       RequestTimeoutError)
+from znicz_tpu.serving.engine import InferenceEngine
+
+
+class ServingServer(HttpServerBase):
+    """HTTP front end over an engine + micro-batcher.
+
+    When ``batcher`` is None one is created (and owned: ``stop()``
+    stops it too) with the ``root.common.serving`` defaults.
+    """
+
+    def __init__(self, engine, batcher=None, port=0, host=None):
+        cfg = root.common.serving
+        super(ServingServer, self).__init__(
+            port=port, host=host or cfg.get("host", "127.0.0.1"),
+            logger_name="ServingServer")
+        self.engine = engine
+        self._owns_batcher = batcher is None
+        self.batcher = batcher or MicroBatcher(engine).start()
+
+    def stop(self):
+        super(ServingServer, self).stop()
+        if self._owns_batcher:
+            self.batcher.stop()
+
+    def statusz(self):
+        payload = dict(self.engine.stats())
+        payload["queued_rows"] = self.batcher.queued_rows
+        if telemetry.enabled():
+            serving = telemetry.serving_summary()
+            if serving is not None:
+                payload["serving"] = serving
+        return payload
+
+    # -- request plumbing ---------------------------------------------------
+    def _parse_predict(self, handler):
+        """(array, timeout_ms, raw_reply) from the request body."""
+        body = handler._read_body()
+        ctype = (handler.headers.get("Content-Type") or "").split(";")[0]
+        if ctype == "application/octet-stream" or \
+                body[:6] == b"\x93NUMPY":
+            return numpy.load(io.BytesIO(body)), None, True
+        doc = json.loads(body.decode() or "null")
+        if isinstance(doc, dict):
+            inputs = doc.get("inputs")
+            timeout_ms = doc.get("timeout_ms")
+        else:
+            inputs, timeout_ms = doc, None
+        if inputs is None:
+            raise ValueError('body needs {"inputs": [[...], ...]} '
+                             "(or a raw .npy payload)")
+        # parse straight into the model's compute dtype — a float64
+        # intermediate would cost a second full-batch copy per dispatch
+        dtype = self.engine.dtype or numpy.float32
+        return numpy.asarray(inputs, dtype=dtype), timeout_ms, False
+
+    def _predict(self, handler):
+        if not self.engine.ready:
+            handler._drain_body()  # keep-alive: no unread bytes behind
+            handler._send_json(503, {"error": "model warming up",
+                                     "ready": False})
+            return
+        try:
+            x, timeout_ms, raw = self._parse_predict(handler)
+        except Exception as e:  # noqa: BLE001 - client error
+            handler._send_json(400, {"error": repr(e)})
+            return
+        try:
+            y = self.batcher.predict(x, timeout_ms=timeout_ms)
+        except QueueFullError as e:
+            handler._send_json(429, {"error": str(e)})
+            return
+        except RequestTimeoutError as e:
+            handler._send_json(504, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            # shape/dtype mismatches surface at trace time as
+            # ValueError/TypeError — the client's fault, not ours
+            handler._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - always answer HTTP
+            self.warning("predict failed: %r", e)
+            handler._send_json(500, {"error": repr(e)})
+            return
+        if raw:
+            buf = io.BytesIO()
+            numpy.save(buf, numpy.ascontiguousarray(y))
+            handler._send(200, "application/octet-stream",
+                          buf.getvalue())
+        else:
+            payload = {"outputs": y.tolist(),
+                       "model_version": self.engine.version}
+            if y.ndim == 2:
+                payload["argmax"] = [int(i) for i in y.argmax(axis=1)]
+            handler._send_json(200, payload)
+
+    def _reload(self, handler):
+        try:
+            doc = json.loads(handler._read_body().decode() or "{}")
+            path = doc["path"]
+        except Exception as e:  # noqa: BLE001 - client error
+            handler._send_json(400, {"error": 'body needs {"path": '
+                                              '"..."} (%r)' % e})
+            return
+        try:
+            version = self.engine.load(path)
+        except Exception as e:  # noqa: BLE001 - bad model file
+            handler._send_json(400, {"error": repr(e)})
+            return
+        handler._send_json(200, {"model_version": version,
+                                 "source": path,
+                                 "ready": self.engine.ready})
+
+    def make_handler(self):
+        server = self
+
+        class Handler(HandlerBase):
+            owner = server
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    stats = server.engine.stats()
+                    self._send_json(200 if stats["ready"] else 503,
+                                    stats)
+                elif self.path == "/metrics":
+                    self._send_metrics()
+                elif self.path in ("/", "/statusz"):
+                    self._send_json(200, server.statusz())
+                else:
+                    self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path == "/predict":
+                    server._predict(self)
+                elif self.path == "/reload":
+                    server._reload(self)
+                else:
+                    self._drain_body()  # keep-alive hygiene
+                    self._send_json(404, {"error": "not found"})
+
+        return Handler
+
+
+def main(argv=None):
+    """The ``python -m znicz_tpu serve`` entry point."""
+    cfg = root.common.serving
+    parser = argparse.ArgumentParser(
+        prog="python -m znicz_tpu serve",
+        description="Serve a trained model (snapshot pickle or "
+                    "deployment package zip) over HTTP with dynamic "
+                    "micro-batching.")
+    parser.add_argument("model",
+                        help="snapshot/.zip path — or, with --latest, "
+                             "a snapshot prefix (e.g. 'wine')")
+    parser.add_argument("--latest", action="store_true",
+                        help="treat MODEL as a snapshotter prefix and "
+                             "serve the newest matching snapshot")
+    parser.add_argument("--directory", default=None,
+                        help="snapshot directory for --latest "
+                             "(default: root.common.dirs.snapshots)")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--max-delay-ms", type=float, default=None)
+    parser.add_argument("--queue-limit", type=int, default=None)
+    parser.add_argument("--timeout-ms", type=float, default=None)
+    parser.add_argument("--sample-shape", default=None,
+                        help="per-sample input shape override, e.g. "
+                             "'28,28,1' (spatial packages without a "
+                             "recorded shape)")
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="serve immediately; first request per "
+                             "bucket pays the compile")
+    args = parser.parse_args(argv)
+
+    telemetry.enable()  # /metrics should work out of the box
+    model = args.model
+    if args.latest:
+        from znicz_tpu.launcher import newest_snapshot
+        directory = args.directory or root.common.dirs.snapshots
+        model = newest_snapshot(directory, args.model)
+        if model is None:
+            raise SystemExit("no snapshot with prefix %r under %s"
+                             % (args.model, directory))
+    sample_shape = None
+    if args.sample_shape:
+        sample_shape = tuple(int(d) for d in
+                             args.sample_shape.split(","))
+    engine = InferenceEngine(model, max_batch=args.max_batch,
+                             sample_shape=sample_shape,
+                             warmup=not args.no_warmup)
+    batcher = MicroBatcher(engine, max_delay_ms=args.max_delay_ms,
+                           queue_limit=args.queue_limit,
+                           timeout_ms=args.timeout_ms).start()
+    server = ServingServer(engine, batcher,
+                           port=(args.port if args.port is not None
+                                 else cfg.get("port", 8899)),
+                           host=args.host).start()
+    print("serving %s on http://%s:%d/  (predict: POST /predict; "  # noqa
+          "health: GET /healthz; metrics: GET /metrics)"
+          % (model, server.host, server.port))
+    try:
+        while True:
+            server._thread.join(3600)
+    except KeyboardInterrupt:
+        print("shutting down")  # noqa: T201 - CLI feedback
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
