@@ -98,7 +98,10 @@ def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
     # per-attempt isolation: a failed larger-batch attempt (_try_measure
     # falls back on OOM/worker crash) must not leave its compiles and
     # transfer bytes in the registry the surviving run's summary reads
+    # (nor its check counts in the health monitor)
     telemetry.reset()
+    from znicz_tpu.core import health
+    health.reset()
     prng.get(1).seed(1234)
     prng.get(2).seed(5678)
     wf = StandardWorkflow(
@@ -162,6 +165,16 @@ def _spread_pct(windows):
     return round(100.0 * (max(windows) - min(windows)) / max(windows), 2)
 
 
+def _outlier_ratio(telemetry_summary):
+    """Step-time p99/p50 from the stamped telemetry block — the
+    straggler signal BENCH_*.json tracks over time."""
+    steps = (telemetry_summary or {}).get("step_seconds") or {}
+    p50, p99 = steps.get("p50"), steps.get("p99")
+    if not p50 or p99 is None:
+        return None
+    return round(p99 / p50, 3)
+
+
 def _measure_rtt(n=5):
     """Host<->device round-trip latency (median of ``n`` 1-element
     readbacks) — the tunnel-day quality signal.  The axon tunnel's RTT
@@ -201,6 +214,12 @@ def main(profile_dir=None):
     # (_measure resets the registry per attempt, so the summary below
     # reflects exactly the surviving flagship run.)
     root.common.telemetry.enabled = True
+    # the health monitor rides too (policy=warn, interval=1): the
+    # stamped `health` block tracks its overhead round over round —
+    # window mode means one fused check per dispatched window
+    from znicz_tpu.core import health as health_mod
+    health_mod.reset()
+    health_mod.enable(policy="warn", interval=1)
 
     # primary: MNIST conv flagship, bf16 GEMMs + f32 master weights,
     # through the workflow control plane
@@ -211,6 +230,7 @@ def main(profile_dir=None):
     # flagship-attributed telemetry, captured before the other models
     # pollute the counters
     flagship_telemetry = telemetry.summary()
+    flagship_health = health_mod.summary()
     # secondary reference point; never let its failure kill the primary
     # metric (f32 needs ~2x the bf16 run's memory on the same batch)
     try:
@@ -272,6 +292,12 @@ def main(profile_dir=None):
         # the why-block: compile count, host<->device bytes, step-time
         # p50/p99 of the flagship run (core/telemetry.py summary())
         "telemetry": flagship_telemetry,
+        # monitoring overhead pin: checks run, violations seen, fused
+        # health-check p50 (core/health.py summary())
+        "health": flagship_health,
+        # steady-state jitter pin: a growing p99/p50 ratio means
+        # stragglers (retrace, GC, tunnel hiccups), not a slower median
+        "step_time_p99_over_p50": _outlier_ratio(flagship_telemetry),
     }
     if peak:
         out["mfu_pct"] = mfu(eff)
@@ -361,6 +387,8 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
         "rows_per_sec": round(sum(rows) / elapsed, 1),
         "latency_p50_ms": serving.get("latency_p50_ms"),
         "latency_p99_ms": serving.get("latency_p99_ms"),
+        "queue_wait_p50_ms": serving.get("queue_wait_p50_ms"),
+        "device_p50_ms": serving.get("device_p50_ms"),
         "requests": sum(done),
         "clients": clients,
         "max_batch": max_batch,

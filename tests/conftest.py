@@ -49,12 +49,19 @@ def _snapshots_to_tmp(tmp_path, monkeypatch):
 def _engine_flags_isolated():
     """One test must not leak engine-mode flags into the rest of the
     suite: blocking-sync timing (``root.common.timings.sync_each_run``,
-    formerly the mutable class global ``Unit.sync_timings``) and the
-    telemetry gate are snapshotted and restored around every test."""
+    formerly the mutable class global ``Unit.sync_timings``), the
+    telemetry gate and the health-monitor gate/policy are snapshotted
+    and restored around every test."""
     from znicz_tpu.core.config import root
     sync = root.common.timings.get("sync_each_run", False)
     tel = root.common.telemetry.get("enabled", False)
+    hen = root.common.health.get("enabled", False)
+    hpolicy = root.common.health.get("policy", "warn")
+    hinterval = root.common.health.get("interval", 1)
     yield
     root.common.timings.sync_each_run = sync
     root.common.telemetry.enabled = tel
+    root.common.health.enabled = hen
+    root.common.health.policy = hpolicy
+    root.common.health.interval = hinterval
 

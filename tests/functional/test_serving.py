@@ -465,6 +465,110 @@ def test_server_maps_backpressure_and_not_ready(trained):
         empty.stop()
 
 
+def test_request_tracing_and_debug_endpoints(trained):
+    """PR 3 serving-trace contract: the request id is echoed (the
+    client's own when sent, a generated one otherwise — header AND
+    JSON body, on errors too), the per-request breakdown histograms
+    populate, compile-cache coverage is visible as gauges/counters,
+    and ``/debug/health`` + ``/debug/events`` answer on the serving
+    front end."""
+    telemetry.enable()
+    telemetry.reset()
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    server = ServingServer(engine, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d" % server.port
+        x = numpy.random.RandomState(1).uniform(
+            -1, 1, (3, 13)).astype(numpy.float32)
+
+        # client-supplied id: echoed in the header and the body
+        req = urllib.request.Request(
+            url + "/predict",
+            json.dumps({"inputs": x.tolist()}).encode(),
+            {"Content-Type": "application/json",
+             "X-Request-Id": "cli-42"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers["X-Request-Id"] == "cli-42"
+            doc = json.loads(r.read())
+        assert doc["request_id"] == "cli-42"
+
+        # no client id: one is generated (and echoed)
+        status, doc2 = _post_json(url + "/predict",
+                                  {"inputs": x.tolist()})
+        assert status == 200
+        assert doc2["request_id"] and doc2["request_id"] != "cli-42"
+
+        # error replies carry the id too (a client can quote it)
+        req = urllib.request.Request(
+            url + "/predict",
+            json.dumps({"inputs": [[1.0, 2.0]]}).encode(),
+            {"Content-Type": "application/json",
+             "X-Request-Id": "cli-bad"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+        assert e.value.headers["X-Request-Id"] == "cli-bad"
+        assert json.loads(e.value.read())["request_id"] == "cli-bad"
+
+        # the per-request breakdown histograms populated
+        for series in ("serving.request_seconds",
+                       "serving.queue_wait_seconds",
+                       "serving.device_seconds",
+                       "serving.assembly_seconds",
+                       "serving.pad_overhead"):
+            assert telemetry.histogram(series).count > 0, series
+        summary = telemetry.serving_summary()
+        assert summary["queue_wait_p50_ms"] is not None
+        assert summary["device_p50_ms"] is not None
+
+        # compile-cache coverage at a glance: warm-bucket gauge and
+        # per-bucket prediction counters
+        assert telemetry.gauge("serving.warm_buckets").value == \
+            len(engine.buckets)
+        bucket = engine.bucket_for(len(x))
+        assert telemetry.counter(telemetry.labeled(
+            "serving.predictions", bucket=bucket)).value >= 2
+
+        # debug endpoints on the SERVING server (shared HandlerBase)
+        with urllib.request.urlopen(url + "/debug/health",
+                                    timeout=10) as r:
+            hdoc = json.loads(r.read())
+        assert hdoc["ok"] is True and "violations" in hdoc
+        with urllib.request.urlopen(url + "/debug/events",
+                                    timeout=10) as r:
+            edoc = json.loads(r.read())
+        kinds = [ev["kind"] for ev in edoc["events"]]
+        assert "serving.reload" in kinds  # the engine load journaled
+    finally:
+        server.stop()
+
+
+def test_slow_request_logging(trained, caplog):
+    """A request slower than ``slow_request_ms`` lands in the log and
+    the flight recorder with its queue/assembly/device breakdown."""
+    from znicz_tpu.core.config import root
+    telemetry.enable()
+    telemetry.reset()
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    old_thr = root.common.serving.get("slow_request_ms", 1000.0)
+    root.common.serving.slow_request_ms = 0.001  # everything is slow
+    batcher = MicroBatcher(engine, max_delay_ms=1.0).start()
+    try:
+        x = numpy.random.RandomState(2).uniform(
+            -1, 1, (2, 13)).astype(numpy.float32)
+        y = batcher.predict(x, request_id="slow-1")
+        assert y.shape == (2, 3)
+        events = [ev for ev in telemetry.journal_events()
+                  if ev["kind"] == "serving.slow_request"]
+        assert events and events[0]["rid"] == "slow-1"
+        for key in ("total_ms", "queue_ms", "assembly_ms",
+                    "device_ms", "bucket"):
+            assert key in events[0], key
+    finally:
+        root.common.serving.slow_request_ms = old_thr
+        batcher.stop()
+
+
 def test_malformed_inputs_get_http_errors_not_disconnects(trained):
     """Bad feature widths and over-nested inputs come back as 400s —
     never as a dropped connection or a surprise recompile (review

@@ -1,0 +1,356 @@
+"""Unit tests for the numeric training-health monitor (ISSUE 3):
+
+* the fused pytree kernel flags NaN / Inf / norms exactly,
+* the DISABLED path does no jax work at all (zero device syncs, no
+  kernel build, no monitor allocation),
+* the loss-divergence detector on synthetic curves,
+* the warn / snapshot / halt policies (halt raises the typed error and
+  writes a crash report),
+* the flight-recorder journal + ``telemetry.reset()`` isolation and
+  the ``--journal`` pretty-printer,
+* the ``/debug/health`` + ``/debug/events`` endpoints.
+"""
+
+import json
+import math
+import os
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import health, telemetry
+from znicz_tpu.core.memory import Array
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor(tmp_path):
+    """Every test starts with a clean monitor, journal and registry,
+    and crash reports land in the test's tmp dir (config gates are
+    restored by the session conftest fixture)."""
+    root.common.health.crash_dir = str(tmp_path / "crash")
+    root.common.health.policy = "warn"
+    root.common.health.interval = 1
+    root.common.health.grad_norm_limit = 0.0
+    root.common.health.param_norm_limit = 0.0
+    root.common.health.update_norm_limit = 0.0
+    health.reset()
+    telemetry.reset()
+    yield
+    health.reset()
+    telemetry.reset()
+    root.common.health.crash_dir = None
+
+
+# -- the fused kernel --------------------------------------------------------
+
+def test_kernel_clean_pytree_reports_norms_exactly():
+    report = health.pytree_health(
+        params=[{"w": numpy.array([3.0, 4.0])}],
+        grads=[numpy.array([0.5])])
+    assert report["nan"] is False and report["inf"] is False
+    assert report["non_finite"] == []
+    assert report["norms"]["params"] == pytest.approx(5.0)
+    assert report["norms"]["grads"] == pytest.approx(0.5)
+
+
+def test_kernel_flags_nan_and_names_the_tree():
+    report = health.pytree_health(
+        params=[numpy.array([1.0, 2.0])],
+        grads={"w": numpy.array([numpy.nan, 1.0])})
+    assert report["nan"] is True and report["inf"] is False
+    assert report["non_finite"] == ["grads"]
+    assert math.isnan(report["norms"]["grads"])
+    assert report["norms"]["params"] == pytest.approx(math.sqrt(5.0))
+
+
+def test_kernel_flags_inf():
+    report = health.pytree_health(
+        updates=[numpy.array([numpy.inf, 0.0])])
+    assert report["inf"] is True and report["nan"] is False
+    assert report["non_finite"] == ["updates"]
+
+
+def test_kernel_empty_and_none_trees():
+    assert health.pytree_health() == {
+        "nan": False, "inf": False, "norms": {}, "non_finite": []}
+    report = health.pytree_health(params=None,
+                                  grads=[numpy.zeros(2)])
+    assert list(report["norms"]) == ["grads"]
+
+
+def test_kernel_accepts_device_arrays():
+    import jax.numpy as jnp
+    report = health.pytree_health(params=[jnp.asarray([2.0, 0.0]),
+                                          jnp.asarray([0.0, 1.0])])
+    assert report["norms"]["params"] == pytest.approx(math.sqrt(5.0))
+
+
+# -- the disabled fast path --------------------------------------------------
+
+def test_disabled_path_does_no_work(monkeypatch):
+    health.disable()
+    telemetry.enable()
+    telemetry.reset()
+    # any attempt to build or run the kernel would blow up
+    monkeypatch.setattr(health, "_get_kernel",
+                        lambda: (_ for _ in ()).throw(
+                            AssertionError("kernel touched")))
+    assert health.check_training_step(
+        None, steps=1, params=[numpy.array([numpy.nan])]) is None
+    assert health.check_gd_unit(object()) is None
+    assert health.observe_loss(float("nan")) is None
+    # no monitor was allocated, no metrics were created, no transfers
+    assert health._monitor is None
+    snap = telemetry.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+
+
+def test_disabled_status_is_safe():
+    health.disable()
+    st = health.status()
+    assert st["enabled"] is False and st["ok"] is True
+    assert st["checks"] == 0 and st["violations"] == 0
+
+
+# -- interval gating ---------------------------------------------------------
+
+def test_interval_gates_checks():
+    health.enable(interval=3)
+    p = [numpy.ones(4)]
+    for _ in range(6):
+        health.check_training_step(None, steps=1, params=p)
+    assert health.monitor().checks == 2  # steps 1 and 4
+
+
+def test_window_steps_advance_interval_at_once():
+    health.enable(interval=1)
+    p = [numpy.ones(4)]
+    # a K-minibatch scan window advances K steps but runs ONE check
+    for _ in range(3):
+        health.check_training_step(None, steps=8, params=p)
+    assert health.monitor().checks == 3
+
+
+# -- divergence detector -----------------------------------------------------
+
+def test_detector_quiet_on_decreasing_loss():
+    d = health.DivergenceDetector(window=5, factor=3.0, rise=0.1)
+    assert all(d.observe(v) is None
+               for v in (1.0, 0.8, 0.6, 0.5, 0.45, 0.41, 0.40))
+
+
+def test_detector_trips_on_explosion_and_nan():
+    d = health.DivergenceDetector(window=8, ema_alpha=0.5, factor=2.0)
+    for v in (1.0, 0.9, 0.8):
+        assert d.observe(v) is None
+    assert "exploded" in d.observe(50.0)
+    assert "non-finite" in health.DivergenceDetector().observe(
+        float("nan"))
+
+
+def test_detector_trips_on_sustained_rise():
+    d = health.DivergenceDetector(window=4, factor=100.0, rise=0.1)
+    out = [d.observe(v) for v in (1.0, 1.2, 1.4, 1.6)]
+    assert out[:3] == [None, None, None]
+    assert "rising" in out[3]
+
+
+def test_detector_quiet_on_flat_noise():
+    d = health.DivergenceDetector(window=4, factor=100.0, rise=0.1)
+    assert all(d.observe(v) is None
+               for v in (1.0, 1.01, 0.99, 1.02, 1.0, 1.01))
+
+
+# -- policies ----------------------------------------------------------------
+
+def test_warn_policy_counts_and_journals(caplog):
+    telemetry.enable()
+    telemetry.reset()
+    health.enable(policy="warn")
+    report = health.check_training_step(
+        None, steps=1, params=[numpy.array([numpy.nan])])
+    assert report["nan"] is True
+    assert telemetry.counter("health.violations").value == 1
+    kinds = [ev["kind"] for ev in telemetry.journal_events()]
+    assert "health.violation" in kinds
+    st = health.status()
+    assert st["ok"] is False and "NaN" in st["last_violation"]["reason"]
+
+
+def test_halt_policy_raises_typed_error_with_crash_report(tmp_path):
+    telemetry.enable()
+    health.enable(policy="halt")
+    with pytest.raises(health.HealthViolationError) as e:
+        health.check_training_step(
+            None, steps=1, grads=[numpy.array([numpy.inf])])
+    crash = e.value.crash_report
+    assert crash and os.path.isdir(crash)
+    assert str(tmp_path) in crash  # honored the configured crash_dir
+    for fname in ("events.jsonl", "metrics.json", "report.json"):
+        assert os.path.isfile(os.path.join(crash, fname)), fname
+    with open(os.path.join(crash, "metrics.json")) as f:
+        metrics = json.load(f)
+    assert metrics["counters"]["health.violations"] == 1
+
+
+def test_snapshot_policy_exports_through_the_workflow():
+    calls = []
+
+    class Snapshotter(object):
+        def export(self):
+            calls.append(1)
+            return "/tmp/snap"
+
+    class WF(object):
+        snapshotter = Snapshotter()
+
+    class U(object):
+        name = "trainer"
+        workflow = WF()
+
+    health.enable(policy="snapshot")
+    health.check_training_step(U(), steps=1,
+                               params=[numpy.array([numpy.nan])])
+    assert calls == [1]
+    # no snapshotter reachable: still just a warning, never a crash
+    U2 = type("U2", (), {"name": "x", "workflow": None})
+    health.check_training_step(U2(), steps=1,
+                               params=[numpy.array([numpy.nan])])
+    assert health.monitor().violation_count == 2
+
+
+def test_norm_limits_fire_policy():
+    health.enable(policy="warn", grad_norm_limit=1.0)
+    report = health.check_training_step(
+        None, steps=1, grads=[numpy.full(4, 10.0)])
+    assert report["norms"]["grads"] == pytest.approx(20.0)
+    assert health.monitor().violation_count == 1
+    assert "exceeds limit" in \
+        health.monitor().last_violation["reason"]
+
+
+def test_observe_loss_fires_policy_on_divergence():
+    health.enable(policy="warn")
+    assert health.observe_loss(1.0) is None
+    assert health.observe_loss(float("inf")) is not None
+    assert health.monitor().violation_count == 1
+
+
+# -- GD-unit checks ----------------------------------------------------------
+
+class _FakeGD(object):
+    name = "gd_fake"
+    workflow = None
+
+    def __init__(self, grad):
+        self.gradient_weights = Array(grad)
+        self.weights = Array(numpy.ones((2, 2)))
+        self.gradient_weights_with_moment = Array(numpy.zeros((2, 2)))
+        self.gradient_bias = None
+        self.bias = None
+        self.gradient_bias_with_moment = None
+
+
+def test_check_gd_unit_flags_nan_gradients():
+    telemetry.enable()
+    telemetry.reset()
+    health.enable(policy="warn")
+    bad = numpy.array([[numpy.nan, 0.0], [0.0, 0.0]])
+    report = health.check_gd_unit(_FakeGD(bad))
+    assert report["nan"] is True and "grads" in report["non_finite"]
+    assert health.monitor().violation_count == 1
+    clean = health.check_gd_unit(_FakeGD(numpy.ones((2, 2))))
+    assert clean["nan"] is False
+    assert telemetry.gauge("health.grads_norm").value == \
+        pytest.approx(2.0)
+    assert telemetry.gauge("health.params_norm").value == \
+        pytest.approx(2.0)
+
+
+def test_check_gd_unit_reads_device_side_without_transfer():
+    telemetry.enable()
+    telemetry.reset()
+    health.enable(policy="warn")
+    unit = _FakeGD(numpy.ones((2, 2)))
+    unit.gradient_weights.unmap()  # device-authoritative now
+    d2h0 = telemetry.counter("transfer.d2h_bytes").value
+    health.check_gd_unit(unit)
+    # the check read the device buffer directly — memory.Array never
+    # downloaded it (the kernel's own tiny (n,3) readback is not an
+    # Array transfer)
+    assert telemetry.counter("transfer.d2h_bytes").value == d2h0
+
+
+# -- journal + helpers -------------------------------------------------------
+
+def test_labeled_naming_convention():
+    assert telemetry.labeled("serving.predictions", bucket=8) == \
+        "serving.predictions.bucket_8"
+    assert telemetry.labeled("a.b", route="predict", code=200) == \
+        "a.b.code_200.route_predict"  # sorted keys
+    assert telemetry.labeled("bare") == "bare"
+
+
+def test_reset_clears_journal():
+    telemetry.enable()
+    telemetry.record_event("x", n=1)
+    assert telemetry.journal_events()
+    telemetry.reset()
+    assert telemetry.journal_events() == []
+
+
+def test_journal_gated_on_telemetry_or_health():
+    telemetry.disable()
+    health.disable()
+    assert telemetry.record_event("nope") is None
+    assert telemetry.journal_events() == []
+    health.enable()  # health alone is enough for the black box
+    assert telemetry.record_event("yes", k=1) is not None
+    assert telemetry.journal_events()[0]["kind"] == "yes"
+
+
+def test_export_journal_and_pretty_printer(tmp_path):
+    telemetry.enable()
+    telemetry.record_event("train.epoch", epoch=1)
+    telemetry.record_event("health.violation", reason="NaN values")
+    path = telemetry.export_journal(str(tmp_path / "events.jsonl"))
+    with open(path) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    assert [ev["kind"] for ev in lines] == ["train.epoch",
+                                            "health.violation"]
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "profile_summary", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "tools", "profile_summary.py"))
+    ps = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ps)
+    table = ps.summarize_journal(path)
+    assert "!!" in table and "health.violation" in table
+    assert "train.epoch" in table
+
+
+# -- debug endpoints ---------------------------------------------------------
+
+def test_debug_endpoints_on_status_server():
+    import urllib.request
+    from znicz_tpu.core.status_server import StatusServer
+    telemetry.enable()
+    telemetry.reset()
+    health.enable(policy="warn")
+    telemetry.record_event("train.epoch", epoch=0)
+    server = StatusServer(None, port=0).start()
+    try:
+        base = "http://127.0.0.1:%d" % server.port
+        with urllib.request.urlopen(base + "/debug/health",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["enabled"] is True and doc["ok"] is True
+        with urllib.request.urlopen(base + "/debug/events",
+                                    timeout=10) as r:
+            events = json.loads(r.read())
+        assert events["events"][0]["kind"] == "train.epoch"
+    finally:
+        server.stop()

@@ -229,7 +229,11 @@ def test_single_sample_matching_model_shape_is_one_row():
 
     model = SpatialModel(max_batch=8)
     model.release.clear()
-    b = MicroBatcher(model, max_batch=8, max_delay_ms=1.0,
+    # 25 ms window: both submits below MUST coalesce, and under a
+    # loaded test machine the second submit can trail the first by
+    # more than 1 ms (observed flake) — dispatch is gated on
+    # model.release regardless, so this adds no meaningful wall time
+    b = MicroBatcher(model, max_batch=8, max_delay_ms=25.0,
                      queue_limit=64, timeout_ms=0).start()
     try:
         one = numpy.arange(9.0).reshape(3, 3)

@@ -1,0 +1,149 @@
+"""CI smoke: the active-observability layer end to end — train a tiny
+wine model with the numeric health monitor armed (``policy=halt``,
+``interval=1``), inject NaN weights after the first epoch, and assert
+the acceptance contract of the health subsystem:
+
+* the monitor trips on the first training step that produces NaN
+  gradients and raises the typed :class:`HealthViolationError`,
+* a crash report exists on disk with the last journal events
+  (``events.jsonl``), a metrics snapshot (``metrics.json``) and the
+  report metadata,
+* the journal records the violation (``health.violation`` event) and
+  ``tools/profile_summary.py --journal`` renders the timeline with the
+  violation highlighted,
+* ``GET /debug/health`` on the status server reports the violation
+  (healthz-style 503).
+
+Run by ``tools/ci.sh`` (fast lane).  Exit code 0 = pass.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy  # noqa: E402
+
+from znicz_tpu.core.config import root  # noqa: E402
+from znicz_tpu.core import health, prng, telemetry  # noqa: E402
+from znicz_tpu.core.status_server import StatusServer  # noqa: E402
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="health_smoke_")
+    root.common.dirs.snapshots = os.path.join(tmp, "snapshots")
+    root.common.health.crash_dir = os.path.join(tmp, "crash")
+    telemetry.enable()
+    telemetry.reset()
+    health.reset()
+    health.enable(policy="halt", interval=1)
+
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    prng.get(1).seed(1024)
+    prng.get(2).seed(1025)
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.1}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 5, "fail_iterations": 20},
+        snapshotter_config={"prefix": "hsmoke", "interval": 10 ** 9,
+                            "time_interval": 1e9, "compression": ""})
+    wf.initialize()
+
+    # poison the first layer's weights at the end of train epoch 1 —
+    # the NEXT train step's gradients go NaN and the monitor must trip
+    # on that step (policy=halt raises the typed error)
+    orig_hook = wf.decision.on_training_finished
+    poisoned = []
+
+    def poison():
+        orig_hook()
+        if not poisoned:
+            poisoned.append(int(wf.decision.epoch_number))
+            wf.forwards[0].weights.map_write()
+            wf.forwards[0].weights.mem[0, 0] = numpy.nan
+
+    wf.decision.on_training_finished = poison
+
+    try:
+        wf.run()
+    except health.HealthViolationError as e:
+        violation = e
+    else:
+        raise AssertionError("health monitor never tripped on NaN")
+
+    assert "NaN" in str(violation), violation
+    assert violation.crash_report and \
+        os.path.isdir(violation.crash_report), violation.crash_report
+    for fname in ("events.jsonl", "metrics.json", "report.json"):
+        path = os.path.join(violation.crash_report, fname)
+        assert os.path.isfile(path), "crash report missing %s" % fname
+
+    # the journal recorded the violation and the crash report holds it
+    kinds = [ev["kind"] for ev in telemetry.journal_events()]
+    assert "health.violation" in kinds, kinds
+    assert "config" in kinds and "train.epoch" in kinds, kinds
+    events_path = os.path.join(violation.crash_report, "events.jsonl")
+    with open(events_path) as f:
+        dumped = [json.loads(line) for line in f if line.strip()]
+    assert any(ev["kind"] == "health.violation" for ev in dumped)
+
+    # metrics snapshot carries the health counters
+    with open(os.path.join(violation.crash_report, "metrics.json")) as f:
+        metrics = json.load(f)
+    assert metrics["counters"].get("health.violations", 0) >= 1
+    assert metrics["counters"].get("health.checks", 0) >= 1
+
+    # --journal timeline renders, violation highlighted
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import profile_summary
+    table = profile_summary.summarize_journal(events_path)
+    assert "!!" in table and "health.violation" in table
+
+    # /debug/health answers healthz-style: 503 with the violation
+    server = StatusServer(wf, port=0).start()
+    try:
+        url = "http://127.0.0.1:%d/debug/health" % server.port
+        try:
+            urllib.request.urlopen(url, timeout=10)
+            raise AssertionError("/debug/health returned 200 after a "
+                                 "violation")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            doc = json.loads(e.read())
+        assert doc["violations"] >= 1 and not doc["ok"]
+        assert doc["last_violation"]["reason"] == str(violation)
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/debug/events" % server.port,
+                timeout=10) as r:
+            events_doc = json.loads(r.read())
+        assert any(ev["kind"] == "health.violation"
+                   for ev in events_doc["events"])
+    finally:
+        server.stop()
+
+    status = health.status()
+    print("health smoke OK: tripped on epoch %d (%s), crash report "
+          "%s (%d journal events, %d checks)"
+          % (poisoned[0] + 1, violation, violation.crash_report,
+             len(dumped), status["checks"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,8 +4,9 @@ Usage::
 
     python tools/profile_summary.py <trace_dir> [top_n]      # XLA xplane
     python tools/profile_summary.py <trace.json> [top_n]     # telemetry
+    python tools/profile_summary.py --journal <events.jsonl> # black box
 
-Two input kinds, dispatched on the argument:
+Three input kinds, dispatched on the argument:
 
 * a DIRECTORY is what ``jax.profiler.trace`` (or ``bench.py
   --profile``) wrote; the tool finds the ``*.xplane.pb`` planes,
@@ -22,6 +23,13 @@ Two input kinds, dispatched on the argument:
   names by SELF time (wall time minus the time spent in nested child
   spans on the same thread) — where the host-side control plane
   actually spends its time.
+
+* ``--journal <file>`` is a flight-recorder JSONL
+  (``telemetry.export_journal``, or the ``events.jsonl`` of a crash
+  report): the tool prints the event timeline with timestamps relative
+  to the first event, health violations and slow serving requests
+  highlighted with a ``!!`` marker, and a per-kind count summary —
+  the first thing to read after a crash.
 """
 
 import collections
@@ -211,9 +219,69 @@ def summarize_chrome_trace(path, top_n=25):
     return "\n".join(lines)
 
 
+# -- flight-recorder journal timelines ---------------------------------------
+
+#: event kinds that get the "!!" attention marker in the timeline
+_ALARM_KINDS = ("health.violation", "serving.slow_request")
+
+
+def _format_event(ev, t0):
+    """One timeline line: +relative-seconds, marker, kind, fields."""
+    t = float(ev.get("t", t0))
+    kind = str(ev.get("kind", "?"))
+    mark = "!!" if kind in _ALARM_KINDS else "  "
+    fields = []
+    for k in sorted(ev):
+        if k in ("t", "elapsed", "kind"):
+            continue
+        v = ev[k]
+        if isinstance(v, dict):
+            v = "{%d keys}" % len(v)
+        elif isinstance(v, list) and len(v) > 6:
+            v = "[%d items]" % len(v)
+        fields.append("%s=%s" % (k, v))
+    return "%+12.3fs %s %-22s %s" % (t - t0, mark, kind,
+                                     " ".join(fields))
+
+
+def summarize_journal(path):
+    """Pretty-print a flight-recorder JSONL: relative-time event
+    timeline (violations highlighted) + per-kind counts."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    if not events:
+        raise SystemExit("no events in %s" % path)
+    t0 = float(events[0].get("t", 0.0))
+    counts = collections.Counter(str(e.get("kind", "?"))
+                                 for e in events)
+    alarms = sum(counts[k] for k in _ALARM_KINDS if k in counts)
+    lines = ["journal: %s  (%d events, %d kinds, %d alarm%s)"
+             % (path, len(events), len(counts), alarms,
+                "" if alarms == 1 else "s"), ""]
+    lines += [_format_event(ev, t0) for ev in events]
+    lines.append("")
+    lines.append("| kind | count |")
+    lines.append("|---|---|")
+    for kind, n in counts.most_common():
+        lines.append("| %s%s | %d |"
+                     % ("**" if kind in _ALARM_KINDS else "",
+                        kind + ("**" if kind in _ALARM_KINDS else ""),
+                        n))
+    return "\n".join(lines)
+
+
 if __name__ == "__main__":
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
+    if sys.argv[1] == "--journal":
+        if len(sys.argv) < 3:
+            raise SystemExit(__doc__)
+        print(summarize_journal(sys.argv[2]))
+        sys.exit(0)
     target = sys.argv[1]
     top = int(sys.argv[2]) if len(sys.argv) > 2 else 25
     if os.path.isfile(target) and target.endswith(".json"):

@@ -127,6 +127,23 @@ root.common.update({
         "enabled": False,
         "trace_capacity": 65536,    # span ring-buffer size (events)
         "histogram_window": 2048,   # percentile reservoir per series
+        "journal_capacity": 4096,   # flight-recorder ring (events)
+    },
+    # numeric training-health monitor (core/health.py) — off by default;
+    # when off every check site is a single predicate with ZERO device
+    # syncs.  See docs/observability.md for each knob.
+    "health": {
+        "enabled": False,
+        "interval": 1,            # check every N train steps/minibatches
+        "policy": "warn",        # "warn" | "snapshot" | "halt"
+        "grad_norm_limit": 0.0,   # 0 disables the explosion check
+        "param_norm_limit": 0.0,
+        "update_norm_limit": 0.0,
+        "loss_window": 8,         # divergence detector window (epochs)
+        "loss_ema_alpha": 0.3,    # EMA smoothing for the explosion test
+        "divergence_factor": 3.0,  # loss > factor*EMA => explosion
+        "loss_rise": 0.1,         # net rise across a full window => slope
+        "crash_dir": None,        # default: <cache>/crash_reports
     },
     # engine timing behavior (was the mutable class global
     # Unit.sync_timings; config-backed so tests can't leak
@@ -142,6 +159,7 @@ root.common.update({
         "queue_limit": 256,     # queued ROWS before 429 backpressure
         "timeout_ms": 1000.0,   # per-request deadline in the queue
         "warmup": True,         # compile every bucket before ready
+        "slow_request_ms": 1000.0,  # log requests slower than this
     },
 })
 

@@ -165,6 +165,8 @@ class SnapshotterToFile(SnapshotterBase):
             pickle.dump(payload, f, protocol=4)
         os.replace(tmp, self.destination)
         self.info("snapshot -> %s", self.destination)
+        telemetry.record_event("snapshot", path=self.destination,
+                               suffix=self.suffix)
         return self.destination
 
     def _forward_topology(self):

@@ -8,7 +8,10 @@ a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
 * ``/status.json`` — workflow status (units, metrics, timings),
 * ``/metrics``     — the telemetry registry in Prometheus text
   exposition format (core/telemetry.py; scrape it),
-* ``/plots/``      — the pngs the plotters render into <cache>/plots.
+* ``/plots/``      — the pngs the plotters render into <cache>/plots,
+* ``/debug/health`` — the numeric health monitor's status
+  (core/health.py; 503 once a violation was recorded),
+* ``/debug/events`` — the flight-recorder journal (core/telemetry.py).
 
 The HTTP plumbing (handler ``_send`` helpers, daemon-thread lifecycle,
 idempotent ``stop()``) lives in :class:`HttpServerBase` /
@@ -54,11 +57,13 @@ class HandlerBase(BaseHTTPRequestHandler):
         if self.owner is not None:
             self.owner.debug(fmt, *args)
 
-    def _send(self, code, ctype, body):
+    def _send(self, code, ctype, body, headers=None):
         try:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             if self.close_connection:
                 # tell keep-alive clients the truth before we drop the
                 # socket (set e.g. when an unreadable body is refused)
@@ -68,9 +73,10 @@ class HandlerBase(BaseHTTPRequestHandler):
         except BrokenPipeError:  # client went away mid-reply
             pass
 
-    def _send_json(self, code, obj):
+    def _send_json(self, code, obj, headers=None):
         self._send(code, "application/json",
-                   json.dumps(obj, default=str).encode())
+                   json.dumps(obj, default=str).encode(),
+                   headers=headers)
 
     def _read_body(self):
         if self.headers.get("Transfer-Encoding"):
@@ -98,6 +104,27 @@ class HandlerBase(BaseHTTPRequestHandler):
         by the status dashboard and the serving front end."""
         self._send(200, "text/plain; version=0.0.4; charset=utf-8",
                    telemetry.prometheus_text().encode())
+
+    def _handle_debug(self):
+        """The diagnostics endpoints every server built on this base
+        exposes (status dashboard AND serving front end):
+
+        * ``GET /debug/health`` — the health monitor's status JSON
+          (healthz-style: 503 once a violation has been recorded),
+        * ``GET /debug/events`` — the flight-recorder journal.
+
+        Returns True when the request was handled."""
+        if self.path == "/debug/health":
+            from znicz_tpu.core import health
+            st = health.status()
+            self._send_json(200 if st.get("ok", True) else 503, st)
+            return True
+        if self.path == "/debug/events":
+            self._send_json(200,
+                            {"events": telemetry.journal_events(),
+                             "dropped": telemetry.journal_dropped()})
+            return True
+        return False
 
 
 class HttpServerBase(Logger):
@@ -238,6 +265,8 @@ class StatusServer(HttpServerBase):
                             self._send(200, "image/png", f.read())
                     else:
                         self._send(404, "text/plain", b"not found")
+                elif self._handle_debug():
+                    pass
                 else:
                     self._send(404, "text/plain", b"not found")
 

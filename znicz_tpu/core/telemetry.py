@@ -17,6 +17,15 @@ snapshotter, ``bench.py`` and the status server.  Three pillars:
   :class:`znicz_tpu.core.status_server.StatusServer`);
   :func:`snapshot` returns the JSON view merged into Publisher
   reports and ``bench.py`` output.
+* **Flight recorder** — a bounded structured-event journal
+  (:func:`record_event` / :func:`journal_events` /
+  :func:`export_journal`): config at start, epoch milestones,
+  snapshot/reload events, health violations, slow serving requests.
+  On an unhandled exception or SIGTERM (:func:`install_crash_handler`)
+  — or explicitly via :func:`write_crash_report` — the last-N events,
+  a metrics snapshot and the traceback land in a crash-report
+  directory.  Records when telemetry OR the health monitor
+  (:mod:`znicz_tpu.core.health`) is enabled.
 * **JAX-aware counters** — ``jax.monitoring`` listeners count backend
   compiles (`jax.backend_compiles` + `jax.compile_seconds`), jaxpr
   traces (`jax.traces` — a re-trace on every dispatch means the jit
@@ -107,15 +116,19 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Ring(object):
-    """Bounded trace-event buffer (oldest events drop first)."""
+    """Bounded event buffer (oldest events drop first).  Capacity is
+    read lazily from ``root.common.telemetry.<cap_key>`` so tests can
+    shrink a ring before its first append."""
 
-    def __init__(self):
+    def __init__(self, cap_key="trace_capacity", default=65536):
+        self._cap_key = cap_key
+        self._default = default
         self._events = None
         self.dropped = 0
 
     def _buf(self):
         if self._events is None:
-            cap = int(_cfg.get("trace_capacity", 65536))
+            cap = int(_cfg.get(self._cap_key, self._default))
             self._events = collections.deque(maxlen=cap)
         return self._events
 
@@ -137,6 +150,11 @@ class _Ring(object):
 
 
 _ring = _Ring()
+
+#: flight-recorder journal — structured milestone events (config at
+#: start, epochs, snapshots, reloads, health violations, slow serving
+#: requests), dumped as JSONL by export_journal/write_crash_report
+_journal = _Ring("journal_capacity", 4096)
 
 
 class _Span(object):
@@ -222,6 +240,152 @@ def export_trace(path):
     with open(path, "w") as f:
         json.dump(payload, f, default=str)
     return path
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder — the black-box journal
+# ---------------------------------------------------------------------------
+
+def journal_enabled():
+    """The flight recorder records when EITHER telemetry or the health
+    monitor is on — a health-only run still wants its black box."""
+    if _cfg.get("enabled", False):
+        return True
+    return bool(root.common.health.get("enabled", False))
+
+
+def record_event(kind, **fields):
+    """Append one structured event to the bounded journal.  Events are
+    plain dicts stamped with wall time and seconds-since-import; the
+    ring drops oldest first, so after a crash the journal holds the
+    LAST N milestones — what a black box is for.  No-op (and ``None``)
+    when neither telemetry nor health is enabled."""
+    if not journal_enabled():
+        return None
+    ev = {"t": round(time.time(), 6),
+          "elapsed": round(time.perf_counter() - _T0, 6),
+          "kind": kind}
+    ev.update(fields)
+    _journal.append(ev)
+    return ev
+
+
+def journal_events():
+    """The buffered journal events (oldest first), as plain dicts."""
+    return _journal.events()
+
+
+def journal_dropped():
+    return _journal.dropped
+
+
+def export_journal(path):
+    """Write the journal as JSONL (one event per line — the format
+    ``tools/profile_summary.py --journal`` pretty-prints) and return
+    the path.  Writes whatever is buffered even when recording is
+    currently off (a crash dump must not depend on live config)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        for ev in _journal.events():
+            f.write(json.dumps(ev, default=str) + "\n")
+    return path
+
+
+def write_crash_report(reason="unhandled-exception", exc_info=None,
+                       directory=None):
+    """Dump the black box to a fresh crash-report directory and return
+    its path:
+
+    * ``events.jsonl``  — the last-N journal events,
+    * ``metrics.json``  — a full metrics snapshot,
+    * ``traceback.txt`` — the active exception (``exc_info`` or
+      ``sys.exc_info()``), when there is one,
+    * ``report.json``   — reason / time / pid / dropped-event count.
+
+    Called by the health monitor's ``halt`` policy, the launcher's
+    unhandled-exception path, and the fatal-signal handler."""
+    import sys
+    import traceback
+    base = (directory or root.common.health.get("crash_dir", None)
+            or os.path.join(root.common.dirs.cache, "crash_reports"))
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    path = os.path.join(base, "crash_%s_pid%d" % (stamp, os.getpid()))
+    n = 0
+    while os.path.exists(path):  # same second, same pid: keep both
+        n += 1
+        path = os.path.join(base, "crash_%s_pid%d_%d"
+                            % (stamp, os.getpid(), n))
+    os.makedirs(path, exist_ok=True)
+    export_journal(os.path.join(path, "events.jsonl"))
+    with open(os.path.join(path, "metrics.json"), "w") as f:
+        json.dump(snapshot(), f, indent=2, default=str)
+    exc_info = exc_info or sys.exc_info()
+    if exc_info and exc_info[0] is not None:
+        with open(os.path.join(path, "traceback.txt"), "w") as f:
+            f.write("".join(traceback.format_exception(*exc_info)))
+    with open(os.path.join(path, "report.json"), "w") as f:
+        json.dump({"reason": str(reason), "time": time.time(),
+                   "pid": os.getpid(),
+                   "journal_events": len(_journal),
+                   "journal_dropped": _journal.dropped}, f, indent=2)
+    logger.error("crash report -> %s (%s)", path, reason)
+    return path
+
+
+_crash_handler_installed = False
+
+
+def install_crash_handler():
+    """Chain a crash-dumping ``sys.excepthook`` and a SIGTERM handler
+    (idempotent).  Both dump only when :func:`journal_enabled` — an
+    instrumentation-free run must not grow a crash directory.  The
+    SIGTERM handler re-raises the signal with the previous disposition
+    restored, so default termination semantics are preserved."""
+    global _crash_handler_installed
+    if _crash_handler_installed:
+        return True
+    import sys
+    prev_hook = sys.excepthook
+
+    def hook(tp, val, tb):
+        try:
+            # skip when a report for THIS exception already exists
+            # (health halt / the launcher tag the exception)
+            if journal_enabled() and \
+                    getattr(val, "crash_report", None) is None:
+                write_crash_report(reason=repr(val),
+                                   exc_info=(tp, val, tb))
+        except Exception:  # noqa: BLE001 - never mask the real crash
+            pass
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = hook
+    try:
+        import signal
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def on_term(signum, frame):
+            try:
+                if journal_enabled():
+                    write_crash_report(reason="fatal signal SIGTERM")
+            except Exception:  # noqa: BLE001 - still die properly
+                pass
+            if prev_term == signal.SIG_IGN:
+                # the process was IGNORING SIGTERM before we hooked it
+                # — dump the black box but preserve that disposition
+                # (do not turn an ignored signal into a death)
+                return
+            signal.signal(signal.SIGTERM,
+                          prev_term if prev_term is not None
+                          else signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, on_term)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+    _crash_handler_installed = True
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -399,6 +563,21 @@ def histogram(name, buckets=DEFAULT_BUCKETS):
     return _get_metric(name, lambda n: Histogram(n, buckets))
 
 
+def labeled(name, **labels):
+    """THE naming convention for per-key series: labels become sorted
+    ``key_value`` dotted suffixes — ``labeled("serving.predictions",
+    bucket=8)`` -> ``"serving.predictions.bucket_8"``.  Prometheus
+    exposition then sanitizes dots to underscores, so dashboards see
+    one family prefix per logical series.  Used by the serving tier's
+    per-bucket/per-route counters; use it for any bounded label set
+    (never for unbounded values like request ids — each distinct name
+    is a registry entry)."""
+    if not labels:
+        return name
+    return name + "." + ".".join(
+        "%s_%s" % (k, labels[k]) for k in sorted(labels))
+
+
 def add_bytes(direction, nbytes):
     """Host↔device transfer meter (``direction`` is "d2h" or "h2d").
     Call sites guard with :func:`enabled` so the disabled path never
@@ -408,10 +587,13 @@ def add_bytes(direction, nbytes):
 
 
 def reset():
-    """Drop all metrics and trace events (tests, bench isolation)."""
+    """Drop all metrics, trace events AND the flight-recorder journal
+    (tests, bench isolation — a test's health violations must not leak
+    into the next test's crash report)."""
     with _lock:
         _metrics.clear()
         _ring.clear()
+        _journal.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -552,6 +734,13 @@ def serving_summary(snap=None):
     fill = h.get("serving.batch_fill")
     if fill and fill.get("count"):
         out["batch_fill_p50"] = fill.get("p50")
+    # request-trace breakdown (PR 3): where a request's latency went
+    for series, key in (("serving.queue_wait_seconds",
+                         "queue_wait_p50_ms"),
+                        ("serving.device_seconds", "device_p50_ms")):
+        part = h.get(series)
+        if part and part.get("count") and part.get("p50") is not None:
+            out[key] = round(part["p50"] * 1e3, 3)
     compiles = {name: int(v) for name, v in c.items()
                 if name.startswith("serving.compiles.")}
     if compiles:

@@ -125,6 +125,13 @@ class Workflow(Unit):
         """
         super(Workflow, self).initialize(device=device, **kwargs)
         self.device = device
+        if telemetry.journal_enabled():
+            # the black box's first entry: which workflow, which config
+            # (export_journal serializes with default=str, so arbitrary
+            # config values are fine)
+            from znicz_tpu.core.config import root
+            telemetry.record_event("config", workflow=self.name,
+                                   config=root.as_dict())
         pending = [u for u in self._units if not u.initialized]
         order = self._graph_order()
         pending.sort(key=lambda u: order.get(u, len(order)))
@@ -181,6 +188,7 @@ class Workflow(Unit):
         self._schedule(self.start_point)
         if telemetry.enabled():
             telemetry.counter("workflow.runs").inc()
+        telemetry.record_event("workflow.run", workflow=self.name)
         try:
             with telemetry.span("workflow.run", workflow=self.name):
                 while self._queue and self._running:

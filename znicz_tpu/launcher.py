@@ -264,7 +264,29 @@ class Launcher(Logger):
                 if hasattr(unit, "testing"):
                     unit.testing = True
         if not self.dry_run:
-            wf.run()
+            from znicz_tpu.core import telemetry
+            # black-box the run: SIGTERM and unhandled exceptions dump
+            # the flight recorder + metrics + traceback to a crash
+            # directory (only when telemetry/health journaling is on)
+            telemetry.install_crash_handler()
+            try:
+                wf.run()
+            except Exception as e:
+                if telemetry.journal_enabled() and \
+                        getattr(e, "crash_report", None) is None:
+                    # the health halt policy already wrote its own
+                    import sys
+                    path = telemetry.write_crash_report(
+                        reason="workflow run failed: %r" % e,
+                        exc_info=sys.exc_info())
+                    try:
+                        # tag it so the sys.excepthook crash handler
+                        # does not write a SECOND report for the same
+                        # exception on its way out
+                        e.crash_report = path
+                    except AttributeError:  # __slots__ exception type
+                        pass
+                raise
         return wf
 
 

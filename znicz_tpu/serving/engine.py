@@ -27,8 +27,12 @@ bucket so steady-state requests NEVER trigger a compile (asserted by
 counter).
 
 Telemetry (when enabled): per-bucket compile counters
-(``serving.compiles.<bucket>``), a ``serving.predict`` span per
-dispatch, and a ``serving.model_version`` gauge.
+(``serving.compiles.<bucket>``) and prediction counters
+(``serving.predictions.bucket_<n>``), a ``serving.warm_buckets`` gauge
+(compile-cache coverage at a glance on ``/metrics``), a
+``serving.predict`` span per dispatch (carrying the request ids it
+served), and a ``serving.model_version`` gauge.  Model swaps land in
+the flight recorder as ``serving.reload`` events.
 """
 
 import json
@@ -331,7 +335,8 @@ class InferenceEngine(Logger):
             sort_keys=True, default=str)
         with self._load_lock:
             old = self._model
-            if old is not None and old.key == key:
+            reused = old is not None and old.key == key
+            if reused:
                 # unchanged topology: the compiled executables AND the
                 # warm-bucket set carry over to the new generation
                 fn, warm = old.fn, old.warm
@@ -345,6 +350,11 @@ class InferenceEngine(Logger):
             if telemetry.enabled():
                 telemetry.gauge("serving.model_version").set(
                     self._version)
+                telemetry.gauge("serving.warm_buckets").set(
+                    len(model.warm))
+        telemetry.record_event("serving.reload", version=self._version,
+                               source=label,
+                               topology_changed=not reused)
         self.info("model v%d <- %s (%d layers, dtype %s, "
                   "sample shape %s)", self._version, label,
                   len(layers), numpy.dtype(dtype).name, shape)
@@ -441,11 +451,14 @@ class InferenceEngine(Logger):
         raise ValueError("batch of %d rows exceeds max_batch %d"
                          % (n, self.max_batch))
 
-    def predict(self, x):
+    def predict(self, x, request_ids=None):
         """Forward ``x`` (batch-first) through the loaded model.
 
         Pads to the enclosing bucket, dispatches the jitted function,
         slices the padding back off, returns a numpy array.
+        ``request_ids`` (propagated by the micro-batcher from the HTTP
+        front end) rides into the ``serving.predict`` span so a trace
+        ties each device dispatch back to the requests it served.
         """
         m = self._model
         if m is None:
@@ -479,13 +492,20 @@ class InferenceEngine(Logger):
         if not telemetry.enabled():
             y = numpy.asarray(m.fn(m.params, x))[:n]
         else:
-            with telemetry.span("serving.predict", rows=n,
-                                bucket=bucket):
+            attrs = {"rows": n, "bucket": bucket}
+            if request_ids:
+                attrs["request_ids"] = list(request_ids)
+            with telemetry.span("serving.predict", **attrs):
                 y = numpy.asarray(m.fn(m.params, x))[:n]
+            # per-bucket traffic: which compiled executables earn their
+            # keep (read next to serving.compiles.<bucket> on /metrics)
+            telemetry.counter(telemetry.labeled(
+                "serving.predictions", bucket=bucket)).inc()
         if first:
             m.warm.add(bucket)
             if telemetry.enabled():
                 telemetry.counter("serving.compiles.%d" % bucket).inc()
+                telemetry.gauge("serving.warm_buckets").set(len(m.warm))
         return y
 
     def warmup(self):

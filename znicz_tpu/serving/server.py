@@ -12,9 +12,15 @@ Endpoints:
 * ``POST /predict`` — JSON body ``{"inputs": [[...], ...]}`` (or a bare
   JSON array), or a raw ``.npy`` payload with
   ``Content-Type: application/octet-stream``.  Replies in kind: JSON
-  ``{"outputs": ..., "argmax": ..., "model_version": ...}`` or raw
-  ``.npy`` bytes.  Status codes: 400 malformed, 429 queue full
-  (backpressure), 503 not warmed up, 504 deadline expired.
+  ``{"outputs": ..., "argmax": ..., "model_version": ...,
+  "request_id": ...}`` or raw ``.npy`` bytes.  Status codes: 400
+  malformed, 429 queue full (backpressure), 503 not warmed up, 504
+  deadline expired.  Every reply (success or error) echoes the
+  request's tracing id in the ``X-Request-Id`` header — the client's
+  own id when it sent one, a generated one otherwise; the id
+  propagates through the micro-batcher into the engine's dispatch
+  span, and requests over ``root.common.serving.slow_request_ms`` are
+  logged with their queue/assembly/device breakdown.
 * ``GET /healthz`` — readiness probe: 200 once warmup finished, 503
   while compiling; body is the engine's stats dict.
 * ``POST /reload`` — ``{"path": "..."}`` hot-swaps the model from a new
@@ -23,6 +29,9 @@ Endpoints:
   readiness back.
 * ``GET /metrics`` — the telemetry registry in Prometheus text format.
 * ``GET /statusz`` (and ``/``) — JSON serving stats.
+* ``GET /debug/health`` / ``GET /debug/events`` — the health monitor
+  status and the flight-recorder journal (shared ``HandlerBase``
+  endpoints — same contract as the training status server).
 
 CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
 
@@ -34,6 +43,7 @@ CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
 import argparse
 import io
 import json
+import uuid
 
 import numpy
 
@@ -97,45 +107,64 @@ class ServingServer(HttpServerBase):
         dtype = self.engine.dtype or numpy.float32
         return numpy.asarray(inputs, dtype=dtype), timeout_ms, False
 
+    @staticmethod
+    def _request_id(handler):
+        """The request's tracing id: the client's ``X-Request-Id``
+        (truncated — it rides through logs and span attrs) or a fresh
+        one.  Echoed on EVERY reply, success or error, so a client can
+        quote it when reporting a failure."""
+        rid = (handler.headers.get("X-Request-Id") or "").strip()
+        return rid[:64] if rid else uuid.uuid4().hex[:12]
+
     def _predict(self, handler):
+        rid = self._request_id(handler)
+        echo = {"X-Request-Id": rid}
         if not self.engine.ready:
             handler._drain_body()  # keep-alive: no unread bytes behind
             handler._send_json(503, {"error": "model warming up",
-                                     "ready": False})
+                                     "ready": False,
+                                     "request_id": rid}, headers=echo)
             return
         try:
             x, timeout_ms, raw = self._parse_predict(handler)
         except Exception as e:  # noqa: BLE001 - client error
-            handler._send_json(400, {"error": repr(e)})
+            handler._send_json(400, {"error": repr(e),
+                                     "request_id": rid}, headers=echo)
             return
         try:
-            y = self.batcher.predict(x, timeout_ms=timeout_ms)
+            y = self.batcher.predict(x, timeout_ms=timeout_ms,
+                                     request_id=rid)
         except QueueFullError as e:
-            handler._send_json(429, {"error": str(e)})
+            handler._send_json(429, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
             return
         except RequestTimeoutError as e:
-            handler._send_json(504, {"error": str(e)})
+            handler._send_json(504, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
             return
         except (ValueError, TypeError) as e:
             # shape/dtype mismatches surface at trace time as
             # ValueError/TypeError — the client's fault, not ours
-            handler._send_json(400, {"error": str(e)})
+            handler._send_json(400, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
             return
         except Exception as e:  # noqa: BLE001 - always answer HTTP
-            self.warning("predict failed: %r", e)
-            handler._send_json(500, {"error": repr(e)})
+            self.warning("predict %s failed: %r", rid, e)
+            handler._send_json(500, {"error": repr(e),
+                                     "request_id": rid}, headers=echo)
             return
         if raw:
             buf = io.BytesIO()
             numpy.save(buf, numpy.ascontiguousarray(y))
             handler._send(200, "application/octet-stream",
-                          buf.getvalue())
+                          buf.getvalue(), headers=echo)
         else:
             payload = {"outputs": y.tolist(),
-                       "model_version": self.engine.version}
+                       "model_version": self.engine.version,
+                       "request_id": rid}
             if y.ndim == 2:
                 payload["argmax"] = [int(i) for i in y.argmax(axis=1)]
-            handler._send_json(200, payload)
+            handler._send_json(200, payload, headers=echo)
 
     def _reload(self, handler):
         try:
@@ -169,6 +198,8 @@ class ServingServer(HttpServerBase):
                     self._send_metrics()
                 elif self.path in ("/", "/statusz"):
                     self._send_json(200, server.statusz())
+                elif self._handle_debug():
+                    pass
                 else:
                     self._send_json(404, {"error": "not found"})
 

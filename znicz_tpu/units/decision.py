@@ -14,6 +14,8 @@ import numpy
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.workflow import NoMoreJobs
+from znicz_tpu.core import health
+from znicz_tpu.core import telemetry
 from znicz_tpu.loader.base import TEST, VALID, TRAIN, CLASS_NAME
 
 
@@ -94,8 +96,21 @@ class DecisionBase(Unit, IDecision, metaclass=DecisionsRegistry):
             self.fill_snapshot_suffixes(suffixes)
             self.snapshot_suffix = "_".join(suffixes)
             self.complete <<= self._stop_condition()
+            # flight-recorder milestone (no-op unless telemetry/health
+            # is on): the last-N of these are what a crash report shows
+            telemetry.record_event(
+                "train.epoch", epoch=int(self.epoch_number),
+                improved=bool(self.improved),
+                suffix=self.snapshot_suffix)
         if self.minibatch_class == TRAIN:
             self.on_training_finished()
+            if health.enabled():
+                metric = self.health_metric()
+                if metric is not None:
+                    # per-epoch train metric feeds the rolling
+                    # loss-divergence detector (EMA + window slope)
+                    health.observe_loss(metric, unit=self,
+                                        source="epoch_train")
         self._print_statistics()
 
     def _stop_condition(self):
@@ -138,6 +153,11 @@ class DecisionBase(Unit, IDecision, metaclass=DecisionsRegistry):
 
     def fill_snapshot_suffixes(self, suffixes):
         pass
+
+    def health_metric(self):
+        """Scalar the divergence detector watches, one per TRAIN-epoch
+        end (subclass hook; None = nothing to observe)."""
+        return None
 
     # -- master-slave protocol (reference decision.py:213-241) --------------
     def generate_data_for_slave(self, slave=None):
@@ -286,6 +306,9 @@ class DecisionGD(DecisionBase):
                     CLASS_NAME[clazz],
                     pt_str(self.epoch_n_err_pt[clazz], False)))
 
+    def health_metric(self):
+        return self.epoch_n_err_pt[TRAIN]
+
     def reset_statistics(self):
         for vec in (self.minibatch_n_err, self.minibatch_max_err_y_sum,
                     self.minibatch_confusion_matrix):
@@ -401,6 +424,10 @@ class DecisionMSE(DecisionGD):
             if self.epoch_metrics[clazz] is not None:
                 suffixes.append("%s_%.6f" % (CLASS_NAME[clazz],
                                              self.epoch_metrics[clazz][0]))
+
+    def health_metric(self):
+        m = self.epoch_metrics[TRAIN]
+        return m[0] if m is not None else None
 
     def reset_statistics(self):
         super(DecisionMSE, self).reset_statistics()

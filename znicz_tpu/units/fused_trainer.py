@@ -37,6 +37,7 @@ from znicz_tpu.core.units import Unit
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.config import root
+from znicz_tpu.core import health
 from znicz_tpu.core import prng
 from znicz_tpu.core import telemetry
 from znicz_tpu.loader.base import TRAIN
@@ -415,17 +416,25 @@ class FusedForwardBackward(Unit):
         so `trainer.step_seconds` percentiles read as per-minibatch
         time across windows) plus the minibatch counter."""
         if not telemetry.enabled():
-            self._run_train_window_inner()
-            return
-        t0 = time.perf_counter()
-        with telemetry.span("fused.window", sliced=self._use_sliced,
-                            device_data=self._use_device_data):
             n = self._run_train_window_inner()
-        dt = time.perf_counter() - t0
-        telemetry.counter("trainer.minibatches").inc(n)
-        telemetry.counter("trainer.windows").inc()
-        telemetry.histogram("trainer.step_seconds").observe(
-            dt / max(n, 1), count=n)
+        else:
+            t0 = time.perf_counter()
+            with telemetry.span("fused.window", sliced=self._use_sliced,
+                                device_data=self._use_device_data):
+                n = self._run_train_window_inner()
+            dt = time.perf_counter() - t0
+            telemetry.counter("trainer.minibatches").inc(n)
+            telemetry.counter("trainer.windows").inc()
+            telemetry.histogram("trainer.step_seconds").observe(
+                dt / max(n, 1), count=n)
+        if health.enabled():
+            # one fused device reduction per due check — params and
+            # optimizer slots (vel carries the last update) already sit
+            # on device; NaN grads poison the params on the same step,
+            # so interval=1 detects on the step that produced them
+            health.check_training_step(
+                self, steps=n, params=self.net.params,
+                updates=self.net.state, context="fused_window")
 
     def _run_train_window_inner(self):
         """Collect up to ``window`` TRAIN minibatches (driving the loader
@@ -624,6 +633,10 @@ class FusedForwardBackward(Unit):
                 telemetry.counter("trainer.minibatches").inc()
                 telemetry.histogram("trainer.step_seconds").observe(
                     time.perf_counter() - t0)
+            if health.enabled():
+                health.check_training_step(
+                    self, steps=1, params=self.net.params,
+                    updates=self.net.state, context="fused_step")
 
     # -- snapshot / resume ---------------------------------------------------
     @property

@@ -26,6 +26,7 @@ from znicz_tpu.core.accelerated_units import (
     AcceleratedUnit, AcceleratedWorkflow)
 from znicz_tpu.core.distributable import IDistributable
 from znicz_tpu.core.memory import Array
+from znicz_tpu.core import health
 from znicz_tpu.core import prng
 from znicz_tpu.core.snapshotter import SnapshotterToFile
 from znicz_tpu.core.workflow import Repeater
@@ -494,6 +495,12 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
     def run(self):
         self.gradient_changed = True
         super(GradientDescentBase, self).run()
+        if health.enabled():
+            # per-update numeric check (interval-gated inside): reads
+            # whichever side of each Array is authoritative, so the jax
+            # path stays device-resident and pays only the tiny flag
+            # readback
+            health.check_gd_unit(self)
 
 
 class NNWorkflow(AcceleratedWorkflow):
@@ -554,6 +561,10 @@ def load_snapshot_into_workflow(state, workflow):
     if "prng" in state:
         from znicz_tpu.core import prng
         prng.restore(state["prng"])
+    from znicz_tpu.core import telemetry
+    telemetry.record_event("snapshot.restore",
+                           workflow=getattr(workflow, "name", None),
+                           suffix=state.get("suffix"))
     units = {u.name: u for u in workflow.units}
     for uname, ustate in state["units"].items():
         u = units.get(uname)
