@@ -38,15 +38,19 @@ import numpy
 
 METRIC = "mnist_conv_fused_train_images_per_sec"
 
-#: peak dense-matmul FLOP/s by device kind substring (bf16 for TPU).
-PEAK_FLOPS = (
-    ("v5 lite", 197e12),   # v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v6", 918e12),        # Trillium
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
+#: (device-kind substring, peak dense-matmul FLOP/s, HBM bandwidth
+#: bytes/s) — bf16 peaks for TPU.  The "cpu" row is a NOMINAL host
+#: fallback so roofline math stays defined on the CPU backend (MFU
+#: against it is not a hardware claim; the JSON marks it nominal).
+PEAK_TABLE = (
+    ("v5 lite", 197e12, 819e9),   # v5e
+    ("v5e", 197e12, 819e9),
+    ("v5p", 459e12, 2765e9),
+    ("v6", 918e12, 1640e9),       # Trillium
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 46e12, 700e9),
+    ("cpu", 2e11, 50e9),          # nominal host row
 )
 
 #: chip-filling wide conv model — MXU-aligned channel counts
@@ -66,12 +70,21 @@ WIDE_LAYERS = [
 ]
 
 
-def _peak_flops(device_kind):
+def _device_peaks(device_kind):
+    """{"flops", "hbm_bytes_per_sec", "nominal"} for the device kind,
+    or None when no row matches (the caller stamps the mfu keys null
+    with a ``peak_flops_unknown`` note instead of omitting them)."""
     kind = device_kind.lower()
-    for sub, peak in PEAK_FLOPS:
+    for sub, peak, bw in PEAK_TABLE:
         if sub in kind:
-            return peak
+            return {"flops": peak, "hbm_bytes_per_sec": bw,
+                    "nominal": sub == "cpu"}
     return None
+
+
+def _peak_flops(device_kind):
+    peaks = _device_peaks(device_kind)
+    return peaks["flops"] if peaks else None
 
 
 def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
@@ -98,10 +111,13 @@ def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
     # per-attempt isolation: a failed larger-batch attempt (_try_measure
     # falls back on OOM/worker crash) must not leave its compiles and
     # transfer bytes in the registry the surviving run's summary reads
-    # (nor its check counts in the health monitor)
+    # (nor its check counts in the health monitor, nor its executables
+    # in the profiler's cost registry)
     telemetry.reset()
     from znicz_tpu.core import health
+    from znicz_tpu.core import profiler
     health.reset()
+    profiler.reset()
     prng.get(1).seed(1234)
     prng.get(2).seed(5678)
     wf = StandardWorkflow(
@@ -175,6 +191,57 @@ def _outlier_ratio(telemetry_summary):
     return round(p99 / p50, 3)
 
 
+def _roofline_block(prof_snap, peaks, ips, device_kind):
+    """The measured-cost why-block stamped into BENCH_*.json: the
+    flagship window executable's XLA ``cost_analysis`` FLOPs / bytes
+    accessed / operational intensity against the analytic
+    ``flops_per_image`` estimate (tolerance band documented in
+    BENCH_NOTES.md), plus measured MFU and the roofline ridge-point
+    verdict for the device."""
+    entries = (prof_snap or {}).get("cost_registry") or []
+    win = next((e for e in entries
+                if e["name"].startswith("fused.window")
+                and e.get("flops")), None)
+    out = {
+        "device_kind": device_kind,
+        "peak_flops": peaks["flops"] if peaks else None,
+        "hbm_bytes_per_sec": (peaks["hbm_bytes_per_sec"]
+                              if peaks else None),
+        "executables": entries,
+    }
+    if peaks and peaks.get("nominal"):
+        out["peak_nominal"] = True
+    if win is None:
+        out["note"] = "no fused.window executable registered"
+        return out
+    meta = win.get("meta") or {}
+    images = max(int(meta.get("steps") or 1)
+                 * int(meta.get("batch") or 1), 1)
+    measured_fpi = win["flops"] / images
+    out.update({
+        "window_executable": win["name"],
+        "measured_flops": win["flops"],
+        "bytes_accessed": win.get("bytes_accessed"),
+        "operational_intensity": win.get("operational_intensity"),
+        "measured_flops_per_image": round(measured_fpi, 1),
+        "analytic_flops_per_image": meta.get(
+            "analytic_flops_per_image"),
+        "flops_ratio_measured_vs_analytic": win.get(
+            "flops_ratio_measured_vs_analytic"),
+        "agreement": win.get("agreement"),
+    })
+    if peaks:
+        out["mfu_pct_measured"] = round(
+            100.0 * ips * measured_fpi / peaks["flops"], 2)
+        ridge = peaks["flops"] / peaks["hbm_bytes_per_sec"]
+        out["ridge_intensity_flops_per_byte"] = round(ridge, 1)
+        oi = win.get("operational_intensity")
+        if oi is not None:
+            out["roofline_bound"] = ("memory" if oi < ridge
+                                     else "compute")
+    return out
+
+
 def _measure_rtt(n=5):
     """Host<->device round-trip latency (median of ``n`` 1-element
     readbacks) — the tunnel-day quality signal.  The axon tunnel's RTT
@@ -201,7 +268,9 @@ def main(profile_dir=None):
     import jax
     import jax.numpy as jnp
 
-    peak = _peak_flops(jax.devices()[0].device_kind)
+    device_kind = jax.devices()[0].device_kind
+    peaks = _device_peaks(device_kind)
+    peak = peaks["flops"] if peaks else None
     rtt_before = _measure_rtt()
 
     def mfu(eff):
@@ -220,6 +289,16 @@ def main(profile_dir=None):
     from znicz_tpu.core import health as health_mod
     health_mod.reset()
     health_mod.enable(policy="warn", interval=1)
+    # ... and the performance profiler: the flagship's window
+    # executable registers its XLA cost_analysis FLOPs (one extra
+    # lowering, zero extra compiles) and each window's wall time is
+    # partitioned into data/dispatch/device/readback — the `roofline`
+    # and `step_breakdown` blocks below.  Overhead: one trace at first
+    # dispatch plus one block_until_ready per window, right where
+    # host_fetch would block anyway.
+    from znicz_tpu.core import profiler as profiler_mod
+    profiler_mod.reset()
+    profiler_mod.enable()
 
     # primary: MNIST conv flagship, bf16 GEMMs + f32 master weights,
     # through the workflow control plane
@@ -231,6 +310,7 @@ def main(profile_dir=None):
     # pollute the counters
     flagship_telemetry = telemetry.summary()
     flagship_health = health_mod.summary()
+    flagship_profiler = profiler_mod.snapshot()
     # secondary reference point; never let its failure kill the primary
     # metric (f32 needs ~2x the bf16 run's memory on the same batch)
     try:
@@ -298,11 +378,27 @@ def main(profile_dir=None):
         # steady-state jitter pin: a growing p99/p50 ratio means
         # stragglers (retrace, GC, tunnel hiccups), not a slower median
         "step_time_p99_over_p50": _outlier_ratio(flagship_telemetry),
+        # measured (XLA cost_analysis) FLOPs/bytes of the flagship
+        # window vs the analytic estimate + roofline verdict
+        # (core/profiler.py cost registry; tolerance in BENCH_NOTES.md)
+        "roofline": _roofline_block(flagship_profiler, peaks, ips,
+                                    device_kind),
+        # where the flagship window's wall time went (data-wait /
+        # dispatch / device / readback) + the bound verdict
+        "step_breakdown": flagship_profiler.get("breakdown"),
+        # device-memory accounting of the flagship run
+        "memory_ledger": flagship_profiler.get("ledger"),
     }
-    if peak:
-        out["mfu_pct"] = mfu(eff)
-        out["cifar_caffe_mfu_pct"] = mfu(cifar_ips * cifar_fpi)
-        out["wide_conv_mfu_pct"] = mfu(wide_ips * wide_fpi)
+    # mfu keys are ALWAYS stamped: null (with a visible note + a trace
+    # instant) when the device kind has no PEAK_TABLE row — an unknown
+    # accelerator must not silently drop the metric from BENCH_*.json
+    out["mfu_pct"] = mfu(eff)
+    out["cifar_caffe_mfu_pct"] = mfu(cifar_ips * cifar_fpi)
+    out["wide_conv_mfu_pct"] = mfu(wide_ips * wide_fpi)
+    if peak is None:
+        out["peak_flops_unknown"] = device_kind
+        telemetry.instant("bench.peak_flops_unknown",
+                          device_kind=device_kind)
     print(json.dumps(out))
 
 

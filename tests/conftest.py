@@ -58,10 +58,12 @@ def _engine_flags_isolated():
     hen = root.common.health.get("enabled", False)
     hpolicy = root.common.health.get("policy", "warn")
     hinterval = root.common.health.get("interval", 1)
+    pen = root.common.profiler.get("enabled", False)
     yield
     root.common.timings.sync_each_run = sync
     root.common.telemetry.enabled = tel
     root.common.health.enabled = hen
     root.common.health.policy = hpolicy
     root.common.health.interval = hinterval
+    root.common.profiler.enabled = pen
 

@@ -17,6 +17,8 @@ echo "== telemetry smoke (2-epoch wine, trace + /metrics)"
 JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 echo "== health smoke (NaN injection -> halt + crash report)"
 JAX_PLATFORMS=cpu python tools/health_smoke.py
+echo "== profiler smoke (fused wine, cost registry + ledger + breakdown)"
+JAX_PLATFORMS=cpu python tools/profiler_smoke.py
 echo "== serving smoke (wine snapshot over HTTP, 64 concurrent, 0 recompiles)"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 if [ "$1" = "full" ]; then

@@ -5,8 +5,10 @@ Usage::
     python tools/profile_summary.py <trace_dir> [top_n]      # XLA xplane
     python tools/profile_summary.py <trace.json> [top_n]     # telemetry
     python tools/profile_summary.py --journal <events.jsonl> # black box
+    python tools/profile_summary.py --roofline <report.json> # cost registry
+    python tools/profile_summary.py --ledger <report.json>   # memory ledger
 
-Three input kinds, dispatched on the argument:
+Input kinds, dispatched on the argument:
 
 * a DIRECTORY is what ``jax.profiler.trace`` (or ``bench.py
   --profile``) wrote; the tool finds the ``*.xplane.pb`` planes,
@@ -30,6 +32,15 @@ Three input kinds, dispatched on the argument:
   to the first event, health violations and slow serving requests
   highlighted with a ``!!`` marker, and a per-kind count summary —
   the first thing to read after a crash.
+
+* ``--roofline <file.json>`` renders the executable cost registry
+  (``profiler.export_report`` output, or a BENCH_*.json carrying a
+  ``roofline`` block): per-executable XLA-measured FLOPs, bytes
+  accessed, operational intensity and the measured-vs-analytic ratio.
+
+* ``--ledger <file.json>`` renders the device-memory ledger from the
+  same inputs: live/high-water bytes, alloc/free counts, the balance
+  invariant, and the per-Array-name attribution table.
 """
 
 import collections
@@ -274,13 +285,104 @@ def summarize_journal(path):
     return "\n".join(lines)
 
 
+# -- profiler report tables (cost registry / memory ledger) ------------------
+
+def _load_report(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def summarize_roofline(path):
+    """Markdown table of the executable cost registry — from a
+    ``profiler.export_report`` JSON or a BENCH_*.json ``roofline``
+    block."""
+    doc = _load_report(path)
+    roof = doc.get("roofline") if isinstance(doc.get("roofline"), dict) \
+        else None
+    entries = doc.get("cost_registry")
+    if entries is None and roof is not None:
+        entries = roof.get("executables")
+    if not entries:
+        raise SystemExit("no cost-registry entries in %s" % path)
+    lines = ["cost registry: %s  (%d executables)" % (path, len(entries))]
+    if roof:
+        hdr = []
+        if roof.get("peak_flops"):
+            hdr.append("peak %.0f TFLOP/s%s"
+                       % (roof["peak_flops"] / 1e12,
+                          " (nominal)" if roof.get("peak_nominal")
+                          else ""))
+        if roof.get("ridge_intensity_flops_per_byte"):
+            hdr.append("ridge %.0f FLOP/B"
+                       % roof["ridge_intensity_flops_per_byte"])
+        if roof.get("mfu_pct_measured") is not None:
+            hdr.append("measured MFU %.2f%%" % roof["mfu_pct_measured"])
+        if roof.get("roofline_bound"):
+            hdr.append("%s-bound" % roof["roofline_bound"])
+        if hdr:
+            lines.append("  ".join(hdr))
+    lines.append("")
+    lines.append("| executable | GFLOP/dispatch | MB accessed "
+                 "| FLOP/B | measured/analytic | agree |")
+    lines.append("|---|---|---|---|---|---|")
+    for e in entries:
+        flops = e.get("flops")
+        nbytes = e.get("bytes_accessed")
+        oi = e.get("operational_intensity")
+        ratio = e.get("flops_ratio_measured_vs_analytic")
+        agree = e.get("agreement")
+        lines.append("| `%s` | %s | %s | %s | %s | %s |" % (
+            e["name"][:48],
+            "%.3f" % (flops / 1e9) if flops else
+            (e.get("error", "-")[:24] if e.get("error") else "-"),
+            "%.2f" % (nbytes / 1e6) if nbytes else "-",
+            "%.1f" % oi if oi is not None else "-",
+            "%.3f" % ratio if ratio is not None else "-",
+            {True: "yes", False: "NO", None: "-"}[agree]))
+    return "\n".join(lines)
+
+
+def summarize_ledger(path):
+    """Markdown view of the device-memory ledger — totals, the balance
+    invariant, and the per-Array-name attribution."""
+    doc = _load_report(path)
+    led = doc.get("ledger") or doc.get("memory_ledger") \
+        or (doc if "by_name" in doc else None)
+    if not led:
+        raise SystemExit("no ledger block in %s" % path)
+    lines = ["device-memory ledger: %s" % path, ""]
+    lines.append("live %.3f MiB   high water %.3f MiB   "
+                 "allocs %d   frees %d   balanced=%s"
+                 % (led.get("live_bytes", 0) / 2 ** 20,
+                    led.get("high_water_bytes", 0) / 2 ** 20,
+                    led.get("allocs", 0), led.get("frees", 0),
+                    led.get("balanced")))
+    suspects = doc.get("leak_suspects")
+    if suspects:
+        lines.append("!! %d leak suspect%s flagged — see the journal "
+                     "profiler.leak_suspect events"
+                     % (suspects, "" if suspects == 1 else "s"))
+    by_name = led.get("by_name") or {}
+    if by_name:
+        lines.append("")
+        lines.append("| array | live bytes |")
+        lines.append("|---|---|")
+        for name, nbytes in sorted(by_name.items(),
+                                   key=lambda kv: -kv[1]):
+            lines.append("| `%s` | %d |" % (str(name)[:48], nbytes))
+    return "\n".join(lines)
+
+
 if __name__ == "__main__":
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
-    if sys.argv[1] == "--journal":
+    if sys.argv[1] in ("--journal", "--roofline", "--ledger"):
         if len(sys.argv) < 3:
             raise SystemExit(__doc__)
-        print(summarize_journal(sys.argv[2]))
+        mode = {"--journal": summarize_journal,
+                "--roofline": summarize_roofline,
+                "--ledger": summarize_ledger}[sys.argv[1]]
+        print(mode(sys.argv[2]))
         sys.exit(0)
     target = sys.argv[1]
     top = int(sys.argv[2]) if len(sys.argv) > 2 else 25

@@ -10,11 +10,16 @@ Examples::
     python -m znicz_tpu mnist --testing
     python -m znicz_tpu --list
     python -m znicz_tpu serve --latest wine --port 8899
+    python -m znicz_tpu profile wine --out /tmp/trace
+    python -m znicz_tpu profile http://127.0.0.1:8899 --seconds 5
 
 The ``serve`` subcommand hands off to the online inference tier
 (:mod:`znicz_tpu.serving`): a snapshot or deployment package served
 over HTTP with dynamic micro-batching — see ``serve --help`` and
-docs/serving.md.
+docs/serving.md.  The ``profile`` subcommand drives the performance
+introspection layer (:mod:`znicz_tpu.core.profiler`): run a workflow
+under the profiler, or hit a running server's
+``GET /debug/profile?seconds=N`` — see docs/observability.md.
 """
 
 import argparse
@@ -165,6 +170,12 @@ def main(argv=None):
         # training parser can reject them
         from znicz_tpu.serving.server import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "profile":
+        # performance introspection: capture a device trace from a
+        # running server (URL target) or run a workflow under the full
+        # profiler stack (core/profiler.py)
+        from znicz_tpu.core.profiler import cli_main as profile_main
+        return profile_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m znicz_tpu",
         description="Run a znicz_tpu workflow (module path, file, or "

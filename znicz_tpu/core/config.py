@@ -145,6 +145,19 @@ root.common.update({
         "loss_rise": 0.1,         # net rise across a full window => slope
         "crash_dir": None,        # default: <cache>/crash_reports
     },
+    # performance introspection (core/profiler.py) — off by default;
+    # when off every hook site is a single predicate with ZERO device
+    # syncs and zero compiles.  See docs/observability.md for each knob.
+    "profiler": {
+        "enabled": False,
+        "cost_rtol": 0.5,         # measured/analytic FLOPs agreement
+                                  # band: [1-rtol, 1+rtol]
+        "leak_epochs": 3,         # consecutive growing epochs before
+                                  # the ledger flags a leak suspect
+        "leak_min_bytes": 1 << 20,  # ignore sub-MiB epoch growth
+        "capture_seconds_cap": 60.0,  # /debug/profile?seconds= ceiling
+        "capture_dir": None,      # default: <cache>/profiles
+    },
     # engine timing behavior (was the mutable class global
     # Unit.sync_timings; config-backed so tests can't leak
     # blocking-sync mode into the rest of the suite)

@@ -16,6 +16,7 @@ States:
 
 import numpy
 
+from znicz_tpu.core import profiler
 from znicz_tpu.core import telemetry
 
 HOST, DEV, SYNC = "host", "dev", "sync"
@@ -31,15 +32,27 @@ def roundup(n, m):
 class Array(object):
     """A tensor mirrored between host numpy and device jax.Array."""
 
-    __slots__ = ("_host", "_dev", "_state", "name")
+    __slots__ = ("_host", "_dev", "_state", "name", "_dev_nbytes")
 
     def __init__(self, data=None, name=None):
         self._host = None
         self._dev = None
         self._state = HOST
         self.name = name
+        #: device bytes this Array has accounted in the profiler's
+        #: memory ledger (stays 0 while the profiler is disabled)
+        self._dev_nbytes = 0
         if data is not None:
             self.mem = data
+
+    def _ledger_swap(self, new_dev):
+        """Device-memory ledger hook — called ONLY when the profiler is
+        enabled, at the three points ``_dev`` changes (upload, set_dev,
+        reset)."""
+        nbytes = int(getattr(new_dev, "nbytes", 0) or 0) \
+            if new_dev is not None else 0
+        profiler.ledger_swap(self.name, self._dev_nbytes, nbytes)
+        self._dev_nbytes = nbytes
 
     # -- allocation / reset -------------------------------------------------
     def reset(self, arr=None):
@@ -47,6 +60,8 @@ class Array(object):
 
         Reference: ``Array.reset`` (used by unit initialize to realloc).
         """
+        if self._dev is not None and profiler.enabled():
+            self._ledger_swap(None)
         self._host = None if arr is None else numpy.asarray(arr)
         self._dev = None
         self._state = HOST
@@ -128,10 +143,14 @@ class Array(object):
             self._state = SYNC
             if telemetry.enabled():
                 telemetry.add_bytes("h2d", host.nbytes)
+            if profiler.enabled():
+                self._ledger_swap(self._dev)
         return self._dev
 
     def set_dev(self, arr):
         """Adopt a new device array as authoritative (a device 'write')."""
+        if profiler.enabled():
+            self._ledger_swap(arr)
         self._dev = arr
         self._state = DEV
         return self

@@ -11,7 +11,11 @@ a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
 * ``/plots/``      — the pngs the plotters render into <cache>/plots,
 * ``/debug/health`` — the numeric health monitor's status
   (core/health.py; 503 once a violation was recorded),
-* ``/debug/events`` — the flight-recorder journal (core/telemetry.py).
+* ``/debug/events`` — the flight-recorder journal (core/telemetry.py),
+* ``/debug/profile?seconds=N`` — on-demand ``jax.profiler`` capture
+  (core/profiler.py; returns the trace directory),
+* ``/debug/profiler`` — the performance-introspection report (cost
+  registry, device-memory ledger, step-time breakdown).
 
 The HTTP plumbing (handler ``_send`` helpers, daemon-thread lifecycle,
 idempotent ``stop()``) lives in :class:`HttpServerBase` /
@@ -111,18 +115,51 @@ class HandlerBase(BaseHTTPRequestHandler):
 
         * ``GET /debug/health`` — the health monitor's status JSON
           (healthz-style: 503 once a violation has been recorded),
-        * ``GET /debug/events`` — the flight-recorder journal.
+        * ``GET /debug/events`` — the flight-recorder journal,
+        * ``GET /debug/profile?seconds=N`` — capture a ``jax.profiler``
+          device trace for N seconds (capped by
+          ``root.common.profiler.capture_seconds_cap``) and reply with
+          the trace directory; 409 while another capture runs,
+        * ``GET /debug/profiler`` — the performance-introspection
+          report (cost registry, memory ledger, step breakdown).
 
         Returns True when the request was handled."""
-        if self.path == "/debug/health":
+        path, _, query = self.path.partition("?")
+        if path == "/debug/health":
             from znicz_tpu.core import health
             st = health.status()
             self._send_json(200 if st.get("ok", True) else 503, st)
             return True
-        if self.path == "/debug/events":
+        if path == "/debug/events":
             self._send_json(200,
                             {"events": telemetry.journal_events(),
                              "dropped": telemetry.journal_dropped()})
+            return True
+        if path == "/debug/profiler":
+            from znicz_tpu.core import profiler
+            self._send_json(200, profiler.snapshot())
+            return True
+        if path == "/debug/profile":
+            from urllib.parse import parse_qs
+            from znicz_tpu.core import profiler
+            try:
+                seconds = float(
+                    parse_qs(query).get("seconds", ["3"])[0])
+            except ValueError:
+                self._send_json(400, {"error": "seconds must be a "
+                                               "number"})
+                return True
+            try:
+                # blocks THIS handler thread for the capture window
+                # (the server is threaded; other requests keep flowing)
+                result = profiler.capture_trace(seconds)
+            except RuntimeError as e:  # a capture is already running
+                self._send_json(409, {"error": str(e)})
+                return True
+            except Exception as e:  # noqa: BLE001 - always answer HTTP
+                self._send_json(500, {"error": repr(e)})
+                return True
+            self._send_json(200, result)
             return True
         return False
 

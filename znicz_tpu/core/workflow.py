@@ -14,6 +14,7 @@ gds -> (back to repeater), with ``decision.complete`` gating the repeater
 from collections import deque
 
 from znicz_tpu.core.units import Unit
+from znicz_tpu.core import profiler
 from znicz_tpu.core import prng as random_generator
 from znicz_tpu.core import telemetry
 
@@ -197,6 +198,10 @@ class Workflow(Unit):
         except NoMoreJobs:
             pass
         self._running = False
+        if profiler.enabled():
+            # end-of-run device-memory gauge sample (TPU backends; a
+            # backend without memory_stats reports None entries)
+            profiler.sample_device_memory()
         for cb in self._finished_callbacks:
             cb()
         return self

@@ -26,11 +26,14 @@ Epoch semantics:
   consumers zero the padded tail (evaluator contract).
 """
 
+import time
+
 import numpy
 
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
 from znicz_tpu.core import telemetry
 from znicz_tpu.core.config import root
@@ -245,6 +248,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             self.shuffle_serial += 1
 
     def run(self):
+        # step-time breakdown: the whole serve (index walk + fill +
+        # epoch bookkeeping) is this minibatch's data-wait share
+        # (core/profiler.py; disabled cost is this one predicate)
+        prof_t0 = time.perf_counter() if profiler.enabled() else None
         order = self._serve_order()
         clazz = order[self._segment]
         length = self.class_lengths[clazz]
@@ -291,6 +298,9 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
                 telemetry.counter("loader.epochs").inc()
                 telemetry.instant("loader.epoch_end",
                                   epoch=self.epoch_number)
+            if prof_t0 is not None:
+                # epoch-boundary ledger leak check (core/profiler.py)
+                profiler.epoch_check(self.epoch_number)
             self._segment = 0
             self._offset_in_class = 0
             self._global_offset = 0
@@ -300,6 +310,8 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             self._offset_in_class = 0
         else:
             self._offset_in_class = off + n
+        if prof_t0 is not None:
+            profiler.note_data_wait(time.perf_counter() - prof_t0)
 
     # -- master-slave stubs (kept for protocol parity) ----------------------
     def generate_data_for_slave(self, slave=None):

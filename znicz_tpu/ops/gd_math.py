@@ -106,12 +106,34 @@ def _update_jax(w, grad, state, hyper, flags_key):
     return update(jnp, w, grad, state, hyper, flags)[:2] + (None,)
 
 
-def update_jax(w, grad, state, hyper, flags):
-    flags_key = tuple(sorted(
+def _flags_key(flags):
+    """Hashable static-arg form of a flags dict (jit cache key)."""
+    return tuple(sorted(
         (k, tuple(sorted(v)) if isinstance(v, (set, frozenset)) else v)
         for k, v in flags.items()))
-    new_w, new_state, _ = _update_jax(w, grad, state, hyper, flags_key)
+
+
+def update_jax(w, grad, state, hyper, flags):
+    new_w, new_state, _ = _update_jax(w, grad, state, hyper,
+                                      _flags_key(flags))
     return new_w, new_state
+
+
+def register_update_cost(name, w, grad, state, hyper, flags):
+    """Executable cost-registry hook for the jitted GD update kernel
+    (core/profiler.py): lower ``_update_jax`` with the exact dispatch
+    arguments BEFORE the first call, recording XLA's FLOPs and bytes
+    accessed.  Call sites guard with ``profiler.enabled()``; the
+    registered-name check FIRST keeps the armed steady state at one
+    dict lookup per update."""
+    from znicz_tpu.core import profiler
+    entry = profiler.cost_entry(name)
+    if entry is not None:
+        return entry
+    return profiler.register_jit_cost(
+        name, _update_jax, (w, grad, state, hyper),
+        kwargs={"flags_key": _flags_key(flags)},
+        param_elements=int(getattr(w, "size", 0) or 0))
 
 
 def update_numpy(w, grad, state, hyper, flags):

@@ -489,6 +489,14 @@ class InferenceEngine(Logger):
         # the dispatch succeeds — a failed trace must not make
         # warmup()/the counters believe the bucket compiled.
         first = bucket not in m.warm
+        if first:
+            from znicz_tpu.core import profiler
+            if profiler.enabled():
+                # cost registry: this bucket's forward executable
+                # (lowered pre-dispatch — the dispatch reuses the trace)
+                profiler.register_jit_cost(
+                    "serving.forward.b%d" % bucket, m.fn, (m.params, x),
+                    bucket=bucket, model_version=m.version)
         if not telemetry.enabled():
             y = numpy.asarray(m.fn(m.params, x))[:n]
         else:

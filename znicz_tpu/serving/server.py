@@ -29,9 +29,12 @@ Endpoints:
   readiness back.
 * ``GET /metrics`` — the telemetry registry in Prometheus text format.
 * ``GET /statusz`` (and ``/``) — JSON serving stats.
-* ``GET /debug/health`` / ``GET /debug/events`` — the health monitor
-  status and the flight-recorder journal (shared ``HandlerBase``
-  endpoints — same contract as the training status server).
+* ``GET /debug/health`` / ``GET /debug/events`` /
+  ``GET /debug/profile?seconds=N`` / ``GET /debug/profiler`` — the
+  health monitor status, the flight-recorder journal, on-demand
+  ``jax.profiler`` capture and the performance-introspection report
+  (shared ``HandlerBase`` endpoints — same contract as the training
+  status server).
 
 CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
 

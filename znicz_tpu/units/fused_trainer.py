@@ -38,6 +38,7 @@ from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.config import root
 from znicz_tpu.core import health
+from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
 from znicz_tpu.core import telemetry
 from znicz_tpu.loader.base import TRAIN
@@ -414,19 +415,33 @@ class FusedForwardBackward(Unit):
         the device-window path and reports per-step time (the window's
         wall time divided by its step count, weighted by that count —
         so `trainer.step_seconds` percentiles read as per-minibatch
-        time across windows) plus the minibatch counter."""
-        if not telemetry.enabled():
-            n = self._run_train_window_inner()
-        else:
-            t0 = time.perf_counter()
-            with telemetry.span("fused.window", sliced=self._use_sliced,
-                                device_data=self._use_device_data):
-                n = self._run_train_window_inner()
-            dt = time.perf_counter() - t0
-            telemetry.counter("trainer.minibatches").inc(n)
-            telemetry.counter("trainer.windows").inc()
-            telemetry.histogram("trainer.step_seconds").observe(
-                dt / max(n, 1), count=n)
+        time across windows) plus the minibatch counter.  When the
+        performance profiler is armed, a window probe additionally
+        partitions the wall time into data-wait / host / dispatch /
+        device / readback (core/profiler.py — the one place the probe
+        pays an explicit device sync)."""
+        probe = profiler.window_probe() if profiler.enabled() else None
+        n = 0
+        try:
+            if not telemetry.enabled():
+                n = self._run_train_window_inner(probe)
+            else:
+                t0 = time.perf_counter()
+                with telemetry.span("fused.window",
+                                    sliced=self._use_sliced,
+                                    device_data=self._use_device_data):
+                    n = self._run_train_window_inner(probe)
+                dt = time.perf_counter() - t0
+                telemetry.counter("trainer.minibatches").inc(n)
+                telemetry.counter("trainer.windows").inc()
+                telemetry.histogram("trainer.step_seconds").observe(
+                    dt / max(n, 1), count=n)
+        finally:
+            if probe is not None:
+                # close the probe even when the window dies mid-flight
+                # (a leaked probe would stop loader data-wait seconds
+                # from advancing the global wall)
+                probe.done(steps=n)
         if health.enabled():
             # one fused device reduction per due check — params and
             # optimizer slots (vel carries the last update) already sit
@@ -436,7 +451,7 @@ class FusedForwardBackward(Unit):
                 self, steps=n, params=self.net.params,
                 updates=self.net.state, context="fused_window")
 
-    def _run_train_window_inner(self):
+    def _run_train_window_inner(self, probe=None):
         """Collect up to ``window`` TRAIN minibatches (driving the loader
         directly; the LR adjuster ticks per minibatch via hyper_tick) and
         dispatch them as ONE compiled scan window.  The window never
@@ -444,7 +459,8 @@ class FusedForwardBackward(Unit):
         last_minibatch, so epoch/segment bookkeeping, snapshotter gating
         and decision semantics are untouched (reference decision.py only
         consumes segment aggregates + end-of-segment output).  Returns
-        the number of minibatches dispatched."""
+        the number of minibatches dispatched.  ``probe`` is the armed
+        profiler's window probe (None otherwise)."""
         loader = self.loader_unit
         if self._use_device_data and not self.net.has_dataset:
             data = numpy.asarray(loader.original_data.mem,
@@ -511,6 +527,8 @@ class FusedForwardBackward(Unit):
         hypers_s = jax.tree.map(
             lambda *leaves: numpy.asarray(leaves, dtype=self.net.dtype),
             *hyper_steps)
+        if probe is not None:
+            probe.collected()
         if self._use_device_data:
             if self.loss == "mse":
                 stats = self.net.run_window_mse_sliced(
@@ -529,6 +547,10 @@ class FusedForwardBackward(Unit):
             stats = self.net.run_window(
                 numpy.stack(x_steps), numpy.stack(lbl_steps), sizes,
                 hypers_s)
+        if probe is not None:
+            # blocks on the window's result tree: the wait IS the
+            # device-compute share of this window's wall time
+            probe.dispatched(stats)
         # ONE pipelined host readback per window (device_get issues all
         # async copies before waiting — per-leaf numpy.asarray would pay
         # one full round trip EACH, which dominates on tunneled devices).
@@ -594,32 +616,50 @@ class FusedForwardBackward(Unit):
             self._run_train_window()
             return
         t0 = time.perf_counter()
-        self.input.map_read()
-        x = self.input.mem
-        idx = None
-        if self.loss == "mse":
-            self.target.map_read()
-            if train:
-                metrics = self.net.step_mse(
-                    x, self.target.mem, int(self.minibatch_size),
-                    hypers=self._collect_hypers())
-                out = metrics["output"]
+        probe = (profiler.window_probe()
+                 if train and profiler.enabled() else None)
+        try:
+            self.input.map_read()
+            x = self.input.mem
+            idx = None
+            if self.loss == "mse":
+                self.target.map_read()
+                if train:
+                    if probe is not None:
+                        probe.collected()
+                    metrics = self.net.step_mse(
+                        x, self.target.mem, int(self.minibatch_size),
+                        hypers=self._collect_hypers())
+                    if probe is not None:
+                        probe.dispatched(metrics)
+                    out = metrics["output"]
+                else:
+                    out = self.net.predict(x)
             else:
-                out = self.net.predict(x)
-        else:
-            self.labels.map_read()
-            labels = numpy.asarray(self.labels.mem, dtype=numpy.int32)
-            if train:
-                metrics = self.net.step(x, labels,
-                                        hypers=self._collect_hypers())
-                out, idx = metrics["output"], metrics["max_idx"]
-            else:
-                out, idx = self.net.predict_with_idx(x)
-        # host copies: the downstream evaluator mixes these with
-        # single-device loader arrays — a mesh-committed jax.Array would
-        # clash there, and the per-minibatch pull is small.  device_get
-        # pipelines the transfers (one round trip, not one per array).
-        out, idx = self.net.host_fetch((out, idx))
+                self.labels.map_read()
+                labels = numpy.asarray(self.labels.mem,
+                                       dtype=numpy.int32)
+                if train:
+                    if probe is not None:
+                        probe.collected()
+                    metrics = self.net.step(
+                        x, labels, hypers=self._collect_hypers())
+                    if probe is not None:
+                        probe.dispatched(metrics)
+                    out, idx = metrics["output"], metrics["max_idx"]
+                else:
+                    out, idx = self.net.predict_with_idx(x)
+            # host copies: the downstream evaluator mixes these with
+            # single-device loader arrays — a mesh-committed jax.Array
+            # would clash there, and the per-minibatch pull is small.
+            # device_get pipelines the transfers (one round trip, not
+            # one per array).
+            out, idx = self.net.host_fetch((out, idx))
+        finally:
+            if probe is not None:
+                # idempotent close in a finally: an exception mid-step
+                # must not leak probes_active (see _run_train_window)
+                probe.done(steps=1)
         self.output.map_invalidate()
         self.output.mem[...] = numpy.asarray(out, dtype=self.output.dtype)
         if idx is not None:

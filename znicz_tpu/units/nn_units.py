@@ -20,6 +20,8 @@ Provides:
   on every snapshot (reference nn_units.py:808-854).
 """
 
+import time
+
 import numpy
 
 from znicz_tpu.core.accelerated_units import (
@@ -27,6 +29,7 @@ from znicz_tpu.core.accelerated_units import (
 from znicz_tpu.core.distributable import IDistributable
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core import health
+from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
 from znicz_tpu.core.snapshotter import SnapshotterToFile
 from znicz_tpu.core.workflow import Repeater
@@ -433,9 +436,16 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
                 import jax
                 state[k] = jax.device_put(v)
         hyper = self._hyper(bias=(which == "bias"))
+        flags = self._flags(bias=(which == "bias"))
+        if profiler.enabled():
+            # cost registry: the GD update kernel's lowered FLOPs/bytes
+            # (dedup'd by name — one lowering per unit+tensor, reusing
+            # the trace the dispatch below needs anyway)
+            gd_math.register_update_cost(
+                "gd.update.%s.%s" % (self.name, which),
+                vec.dev, grad_dev, state, hyper, flags)
         new_w, new_state = gd_math.update_jax(
-            vec.dev, grad_dev, state, hyper,
-            self._flags(bias=(which == "bias")))
+            vec.dev, grad_dev, state, hyper, flags)
         if self.apply_gradient:
             vec.set_dev(new_w)
         setattr(self, stash_attr, new_state)
@@ -494,7 +504,15 @@ class GradientDescentBase(AcceleratedUnit, IDistributable,
 
     def run(self):
         self.gradient_changed = True
-        super(GradientDescentBase, self).run()
+        if profiler.enabled():
+            # step-time breakdown (unit-graph mode): dispatch vs device
+            # share of this GD step — note_gd_step blocks on the unit's
+            # device-resident buffers, a sync paid only while armed
+            t0 = time.perf_counter()
+            super(GradientDescentBase, self).run()
+            profiler.note_gd_step(self, t0)
+        else:
+            super(GradientDescentBase, self).run()
         if health.enabled():
             # per-update numeric check (interval-gated inside): reads
             # whichever side of each Array is authoritative, so the jax
