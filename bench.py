@@ -92,13 +92,18 @@ def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
     """Steady-state throughput of the SHIPPED fused training loop.
 
     Builds a StandardWorkflow (synthetic full-batch dataset of
-    ``n_steps * batch`` train samples, no validation split) in fused mode
-    with ``window=n_steps``: each epoch is exactly one compiled scan
-    window dispatched by the fused trainer THROUGH the control plane
-    (loader / evaluator / decision / snapshotter all firing their
-    reference roles).  Per-epoch wall times come from the decision's
-    end-of-train hook; the first epoch (compile + dataset placement) is
-    discarded.  Returns (best_ips, [per-window ips...], train FLOPs/img).
+    ``n_steps * batch`` train samples, no validation split) in fused
+    mode with ``window = n_steps // 4``: each epoch is SEVERAL compiled
+    scan windows dispatched by the fused trainer THROUGH the control
+    plane (loader / evaluator / decision / snapshotter all firing their
+    reference roles), so the asynchronous steady state actually engages
+    — mid-epoch windows pipeline with zero readbacks and the epoch pays
+    ONE batched aggregate fetch (a single-window epoch would make every
+    window segment-final and the stamped ``readbacks_per_epoch`` could
+    never distinguish async from sync).  Per-epoch wall times come from
+    the decision's end-of-train hook; the first epoch (compile +
+    dataset placement) is discarded.  Returns (best_ips,
+    [per-epoch ips...], train FLOPs/img).
     """
     from znicz_tpu.core import prng
     from znicz_tpu.core import telemetry
@@ -130,7 +135,8 @@ def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
                          "fail_iterations": 10 ** 9},
         snapshotter_config={"interval": 10 ** 9, "time_interval": 1e9,
                             "compression": ""},
-        fused=dict({"window": n_steps, "compute_dtype": compute_dtype},
+        fused=dict({"window": max(2, n_steps // 4),
+                    "compute_dtype": compute_dtype},
                    **(fused_extra or {})))
     wf.initialize(device=JaxDevice())
     assert wf.fused_trainer._use_device_data, \
@@ -154,9 +160,13 @@ def _measure(layers, loader_name, batch, compute_dtype, n_steps=40,
     else:
         wf.run()
     dts = numpy.diff(times)
-    if len(dts) < 2:
-        raise RuntimeError("bench needs >= 2 epochs, got %d" % len(dts))
-    window_ips = [n_steps * batch / dt for dt in dts[1:]]  # drop compile
+    if len(dts) < 3:
+        raise RuntimeError("bench needs >= 3 epochs, got %d" % len(dts))
+    # dts[0] is the compile epoch; dts[1] is a WARMUP window (the first
+    # steady dispatch still pays allocator growth + async-pipeline
+    # priming and used to land as the low outlier in *_window_ips,
+    # making the spread read as tunnel noise).  Timing starts at dts[2].
+    window_ips = [n_steps * batch / dt for dt in dts[2:]]
     fpi = 3 * flops_per_image(wf.fused_trainer.net.specs)
     return max(window_ips), window_ips, fpi
 
@@ -303,9 +313,11 @@ def main(profile_dir=None):
     # primary: MNIST conv flagship, bf16 GEMMs + f32 master weights,
     # through the workflow control plane
     flagship_steps = 40
+    flagship_epochs = 5
     ips, windows, fpi, batch = _try_measure(
         ge.FLAGSHIP_LAYERS, "mnist_loader", (16384, 8192), jnp.bfloat16,
-        n_steps=flagship_steps, profile_dir=profile_dir)
+        n_steps=flagship_steps, n_epochs=flagship_epochs,
+        profile_dir=profile_dir)
     # flagship-attributed telemetry, captured before the other models
     # pollute the counters
     flagship_telemetry = telemetry.summary()
@@ -317,7 +329,7 @@ def main(profile_dir=None):
         ips_f32, _, _, _ = _try_measure(
             ge.FLAGSHIP_LAYERS, "mnist_loader",
             (batch, batch // 2, batch // 4), None,
-            n_steps=10, n_epochs=3)
+            n_steps=10, n_epochs=4)
     except Exception:  # noqa: BLE001 - tunneled worker crash
         ips_f32 = 0.0
     eff = ips * fpi
@@ -349,8 +361,9 @@ def main(profile_dir=None):
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
         "batch": batch,
-        "loop": "workflow-control-plane (scan window=%d, device dataset, "
-                "in-scan indexed gather)" % flagship_steps,
+        "loop": "workflow-control-plane (%d minibatches/epoch in async "
+                "scan windows of %d, device dataset, in-scan indexed "
+                "gather)" % (flagship_steps, max(2, flagship_steps // 4)),
         "window_ips": [round(w, 1) for w in windows],
         "window_spread_pct": _spread_pct(windows),
         # RTT swings over a multi-minute run — sample both ends so the
@@ -363,9 +376,25 @@ def main(profile_dir=None):
         "cifar_caffe_images_per_sec": round(cifar_ips, 1),
         "cifar_caffe_batch": cifar_batch,
         "cifar_caffe_window_ips": [round(w, 1) for w in cifar_windows],
+        # every model stamps its spread the way the flagship always has
+        # — with the warmup window discarded, a wide spread is now
+        # attributable (tunnel RTT swing vs a real regression) instead
+        # of the 181k-244k mystery noise of r5/r6
+        "cifar_caffe_window_spread_pct": _spread_pct(cifar_windows),
         "wide_conv_images_per_sec": round(wide_ips, 1),
         "wide_conv_batch": wide_batch,
         "wide_conv_window_ips": [round(w, 1) for w in wide_windows],
+        "wide_conv_window_spread_pct": _spread_pct(wide_windows),
+        # async-control-plane pins: batched decision-aggregate readbacks
+        # and d2h traffic per epoch (one readback per segment when fully
+        # asynchronous — RTT-insensitivity is measurable round over
+        # round against tunnel_rtt_ms)
+        "readbacks_per_epoch": round(
+            (flagship_telemetry or {}).get("readbacks", 0)
+            / flagship_epochs, 2),
+        "d2h_bytes_per_epoch": int(
+            (flagship_telemetry or {}).get("d2h_bytes", 0)
+            / flagship_epochs),
         "mfu_note": "flagship topologies are MXU-starved by design "
                     "(1..87ch convs); wide 128/256ch model shows the "
                     "framework ceiling; see BENCH_NOTES.md",

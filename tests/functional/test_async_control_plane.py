@@ -1,0 +1,297 @@
+"""Asynchronous training control plane pins (ISSUE 5).
+
+In windowed fused mode the decision aggregates (n_err / confusion /
+max_err_sum, MSE [sum,max,min] metrics) ride DEVICE-RESIDENT epoch
+accumulators carried by the window executables (fused.FusedNet
+``window_acc``), so mid-epoch windows issue ZERO synchronous d2h
+transfers: the host collects and dispatches window K+1 while window K
+is still in flight (bounded by ``pipeline_depth``) and fetches exactly
+ONE batched transfer per segment.  These tests pin:
+
+* async trajectory == synchronous per-window readback trajectory,
+  bit-identical (params, per-epoch error integers, confusion matrices,
+  the max_err_output_sum float, MSE epoch metrics) on a seed FC and a
+  conv topology — the device fold replays the host fold's exact op
+  order, so even f32 sums agree bitwise;
+* zero mid-epoch d2h (telemetry transfer meters: d2h calls per epoch ==
+  1 batched segment readback) and zero recompiles after the first epoch
+  (``jax.monitoring`` compile counters via telemetry's jax hooks);
+* the in-flight window bound: the pipeline really leaves windows in
+  flight and never exceeds ``pipeline_depth``.
+
+Fast lane (tier-1): small topologies, f32 — exactness needs no float64
+here because both modes run the same compiled window executables.
+"""
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import prng, telemetry
+from znicz_tpu.core.backends import JaxDevice
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+FC_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+     "<-": {"learning_rate": 0.1}},
+    {"type": "softmax", "->": {"output_sample_shape": 3},
+     "<-": {"learning_rate": 0.1}},
+]
+
+CONV_LAYERS = [
+    {"type": "conv_relu", "->": {"n_kernels": 4, "kx": 5, "ky": 5},
+     "<-": {"learning_rate": 0.03}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "softmax", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.03}},
+]
+
+
+def _seed():
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+
+
+def _run(tmp_path, layers, loader_name, loader_config, fused_cfg,
+         max_epochs=3, loss="softmax"):
+    import znicz_tpu.loader.loader_wine  # noqa: F401 (registry)
+    import znicz_tpu.loader.loader_mnist  # noqa: F401 (registry)
+    _seed()
+    wf = StandardWorkflow(
+        None, layers=[dict(l) for l in layers], loader_name=loader_name,
+        loader_config=dict(loader_config), loss_function=loss,
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": 100},
+        snapshotter_config={"prefix": "async", "interval": 10 ** 9,
+                            "time_interval": 1e9, "compression": "",
+                            "directory": str(tmp_path)},
+        fused=dict(fused_cfg))
+    wf.initialize(device=JaxDevice())
+    wf.run()
+    return wf
+
+
+def _assert_same_trajectory(wf_a, wf_b):
+    """Bit-identical decision aggregates AND parameters."""
+    assert list(wf_a.decision.epoch_n_err) == list(wf_b.decision.epoch_n_err)
+    assert wf_a.decision.epoch_n_evaluated_samples == \
+        wf_b.decision.epoch_n_evaluated_samples
+    for ca, cb in zip(wf_a.decision.confusion_matrixes,
+                      wf_b.decision.confusion_matrixes):
+        if ca is None or cb is None:
+            assert ca is None and cb is None
+            continue
+        numpy.testing.assert_array_equal(ca, cb)
+    for a, b in zip(wf_a.decision.max_err_y_sums,
+                    wf_b.decision.max_err_y_sums):
+        assert a == b, (wf_a.decision.max_err_y_sums,
+                        wf_b.decision.max_err_y_sums)
+    pa = wf_a.fused_trainer.host_params()
+    pb = wf_b.fused_trainer.host_params()
+    for i, (la, lb) in enumerate(zip(pa, pb)):
+        assert set(la) == set(lb)
+        for k in la:
+            numpy.testing.assert_array_equal(
+                la[k], lb[k], "layer %d %s" % (i, k))
+
+
+def test_async_equals_sync_fc(tmp_path):
+    """Seed FC topology (wine): async mode's one-readback-per-segment
+    aggregates are bit-identical to the synchronous per-window fold."""
+    wine_cfg = {"minibatch_size": 10}
+    wf_async = _run(tmp_path, FC_LAYERS, "wine_loader", wine_cfg,
+                    {"window": 4})
+    wf_sync = _run(tmp_path, FC_LAYERS, "wine_loader", wine_cfg,
+                   {"window": 4, "async_windows": False})
+    assert wf_async.fused_trainer.async_windows
+    assert not wf_sync.fused_trainer.async_windows
+    assert wf_async.fused_trainer._use_device_data
+    _assert_same_trajectory(wf_async, wf_sync)
+
+
+def test_async_equals_sync_conv(tmp_path):
+    """Conv topology with a VALID split: TRAIN segments run async
+    windows, VALID stays per-minibatch predict — both epochs'
+    aggregates and the params match the sync mode bitwise."""
+    loader_cfg = {"synthetic_train": 160, "synthetic_valid": 40,
+                  "synthetic": True, "minibatch_size": 20,
+                  "normalization_type": "none"}
+    wf_async = _run(tmp_path, CONV_LAYERS, "mnist_loader", loader_cfg,
+                    {"window": 4}, max_epochs=2)
+    wf_sync = _run(tmp_path, CONV_LAYERS, "mnist_loader", loader_cfg,
+                   {"window": 4, "async_windows": False}, max_epochs=2)
+    # 160/20 = 8 TRAIN minibatches -> 2 windows per segment
+    assert wf_async.fused_trainer._use_device_data
+    assert wf_async.decision.epoch_n_err[1] is not None  # VALID ran
+    _assert_same_trajectory(wf_async, wf_sync)
+
+
+def test_mse_async_equals_sync(tmp_path):
+    """MSE objective (approximator, sliced device path AND host-stacked
+    fallback): epoch [sum,max,min] metrics and params bit-identical
+    between async and sync modes."""
+    from znicz_tpu.samples import approximator
+
+    def run(fused_cfg):
+        _seed()
+        wf = approximator.build(
+            loader_config={"minibatch_size": 64},
+            decision_config={"max_epochs": 2, "fail_iterations": 100},
+            snapshotter_config={"prefix": "am", "interval": 10 ** 9,
+                                "time_interval": 1e9, "compression": "",
+                                "directory": str(tmp_path)},
+            fused=dict(fused_cfg))
+        wf.initialize(device=JaxDevice())
+        wf.run()
+        return wf
+
+    wf_async = run({"window": 4})
+    wf_sync = run({"window": 4, "async_windows": False})
+    wf_stacked = run({"window": 4, "device_data": False})
+    assert wf_async.fused_trainer._use_sliced
+    assert not wf_stacked.fused_trainer._use_device_data
+    for other in (wf_sync, wf_stacked):
+        for ma, mb in zip(wf_async.decision.epoch_metrics,
+                          other.decision.epoch_metrics):
+            if ma is None or mb is None:
+                assert ma is None and mb is None
+                continue
+            assert tuple(ma) == tuple(mb)
+        pa = wf_async.fused_trainer.host_params()
+        pb = other.fused_trainer.host_params()
+        for la, lb in zip(pa, pb):
+            for k in la:
+                numpy.testing.assert_array_equal(la[k], lb[k])
+
+
+def test_async_zero_mid_epoch_d2h_zero_recompiles(tmp_path):
+    """The acceptance pin: steady-state mid-epoch windows issue zero
+    synchronous d2h transfers (telemetry byte/call meters — exactly ONE
+    batched readback per segment) and zero recompiles after the first
+    epoch (jax.monitoring compile counters)."""
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    try:
+        import znicz_tpu.loader.loader_wine  # noqa: F401
+        _seed()
+        wf = StandardWorkflow(
+            None, layers=[dict(l) for l in FC_LAYERS],
+            loader_name="wine_loader",
+            loader_config={"minibatch_size": 10},
+            decision_config={"max_epochs": 3, "fail_iterations": 100},
+            snapshotter_config={"prefix": "zp", "interval": 10 ** 9,
+                                "time_interval": 1e9, "compression": "",
+                                "directory": str(tmp_path)},
+            fused={"window": 4})
+        wf.initialize(device=JaxDevice())
+        at_epoch = []  # (compiles, d2h_calls, d2h_bytes, readbacks)
+        orig_hook = wf.decision.on_training_finished
+
+        def hook():
+            at_epoch.append((
+                telemetry.counter("jax.backend_compiles").value,
+                telemetry.counter("transfer.d2h_calls").value,
+                telemetry.counter("transfer.d2h_bytes").value,
+                telemetry.counter("trainer.readbacks").value))
+            orig_hook()
+
+        wf.decision.on_training_finished = hook
+        wf.run()
+    finally:
+        root.common.telemetry.enabled = False
+    assert len(at_epoch) == 3
+    # wine: 178 samples / mb 10 -> 18 minibatches -> 5 windows/segment,
+    # so a per-window readback would show 5 d2h calls per epoch
+    assert wf.fused_trainer.window == 4
+    compiles, d2h_calls, d2h_bytes, readbacks = zip(*at_epoch)
+    # exactly ONE batched readback per segment, from epoch 1 on
+    assert readbacks == (1, 2, 3), readbacks
+    assert d2h_calls == (1, 2, 3), d2h_calls
+    # the segment readback is the ONLY d2h traffic, and it is constant
+    # per epoch (accumulators + segment-final output/argmax)
+    per_epoch_bytes = numpy.diff((0,) + d2h_bytes)
+    assert per_epoch_bytes[1] == per_epoch_bytes[2] > 0
+    # zero recompiles after the first epoch (both window-size variants
+    # k4 + tail k2 compile inside epoch 1)
+    assert compiles[-1] == compiles[0], compiles
+
+
+def test_pipeline_depth_bounds_inflight(tmp_path):
+    """Mid-epoch windows are dispatched WITHOUT waiting (tokens enter
+    the in-flight deque), completed windows retire from it, and it
+    never exceeds ``pipeline_depth`` unfinished windows after the
+    bound is applied."""
+    import collections
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    _seed()
+    wf = StandardWorkflow(
+        None, layers=[dict(l) for l in FC_LAYERS],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 2, "fail_iterations": 100},
+        snapshotter_config={"prefix": "pd", "interval": 10 ** 9,
+                            "time_interval": 1e9, "compression": "",
+                            "directory": str(tmp_path)},
+        fused={"window": 4, "pipeline_depth": 1})
+    wf.initialize(device=JaxDevice())
+
+    class SpyDeque(collections.deque):
+        appends = 0
+
+        def append(self, token):
+            SpyDeque.appends += 1
+            super(SpyDeque, self).append(token)
+
+    wf.fused_trainer._inflight = SpyDeque()
+    depths = []
+    orig_on_run = wf.decision.on_run
+
+    def on_run():
+        depths.append(len(wf.fused_trainer._inflight))
+        orig_on_run()
+
+    wf.decision.on_run = on_run
+    wf.run()
+    assert wf.fused_trainer.pipeline_depth == 1
+    # 2 epochs x (5 windows - 1 segment-final) mid-epoch dispatches,
+    # every one enqueued without a blocking readback
+    assert SpyDeque.appends == 8
+    # after the bound, never more than pipeline_depth unfinished
+    # windows are held (completed ones retire via is_ready)
+    assert max(depths) <= 1, depths
+    assert depths[-1] == 0                # drained at the segment end
+    assert len(wf.fused_trainer._inflight) == 0
+
+
+def test_deferred_sentinel_reaches_evaluator(tmp_path):
+    """Mid-epoch windows hand the evaluator the DEFERRED sentinel (no
+    host fold), the segment-final window hands it the full segment
+    aggregates."""
+    from znicz_tpu.units.fused_trainer import DEFERRED_WINDOW_STATS
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    _seed()
+    wf = StandardWorkflow(
+        None, layers=[dict(l) for l in FC_LAYERS],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 1, "fail_iterations": 100},
+        snapshotter_config={"prefix": "df", "interval": 10 ** 9,
+                            "time_interval": 1e9, "compression": "",
+                            "directory": str(tmp_path)},
+        fused={"window": 4})
+    wf.initialize(device=JaxDevice())
+    seen = []
+    orig_run = wf.evaluator.run
+
+    def spy_run():
+        ws = wf.fused_trainer.window_stats
+        seen.append("deferred" if ws is DEFERRED_WINDOW_STATS
+                    else ("final" if ws is not None else "none"))
+        orig_run()
+
+    wf.evaluator.run = spy_run
+    wf.run()
+    # 18 minibatches / window 4 -> 4 deferred windows + 1 segment-final
+    assert seen == ["deferred"] * 4 + ["final"]
+    # the decision still recorded the whole epoch's integers
+    assert wf.decision.epoch_n_err[2] is not None
+    assert wf.decision.epoch_n_evaluated_samples[2] == 178

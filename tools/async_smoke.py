@@ -1,0 +1,106 @@
+"""CI smoke: the asynchronous training control plane end to end — the
+wine fused config trained in BOTH control-plane modes, asserting the
+acceptance contract of the async window dispatch
+(units/fused_trainer.py + fused.FusedNet window accumulators):
+
+* async (default) and synchronous (``async_windows=False``) runs
+  produce IDENTICAL decision aggregates (per-epoch error integers,
+  confusion matrix, max_err_output_sum) and identical parameters,
+* the async run's batched decision-aggregate readbacks number exactly
+  ONE per segment (``readbacks_per_epoch == segments`` on the
+  telemetry meter), while the sync run pays one per window,
+* mid-epoch windows moved ZERO d2h bytes (the telemetry transfer
+  meter advances only at segment boundaries).
+
+Run by ``tools/ci.sh`` (fast lane).  Exit code 0 = pass.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy  # noqa: E402
+
+from znicz_tpu.core.config import root  # noqa: E402
+from znicz_tpu.core import prng, telemetry  # noqa: E402
+from znicz_tpu.core.backends import JaxDevice  # noqa: E402
+
+EPOCHS = 3
+WINDOW = 4
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+     "<-": {"learning_rate": 0.1}},
+    {"type": "softmax", "->": {"output_sample_shape": 3},
+     "<-": {"learning_rate": 0.1}},
+]
+
+
+def run(tmp, fused_cfg):
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    telemetry.reset()
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    wf = StandardWorkflow(
+        None, layers=[dict(l) for l in LAYERS],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": EPOCHS, "fail_iterations": 100},
+        snapshotter_config={"prefix": "asmoke", "interval": 10 ** 9,
+                            "time_interval": 1e9, "compression": ""},
+        fused=dict({"window": WINDOW}, **fused_cfg))
+    wf.initialize(device=JaxDevice())
+    wf.run()
+    return wf, telemetry.summary()
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="async_smoke_")
+    root.common.dirs.snapshots = os.path.join(tmp, "snapshots")
+    telemetry.enable()
+
+    wf_async, tele_async = run(tmp, {})
+    wf_sync, tele_sync = run(tmp, {"async_windows": False})
+
+    # equal aggregates, window for window of training later folded once
+    assert list(wf_async.decision.epoch_n_err) == \
+        list(wf_sync.decision.epoch_n_err), \
+        (wf_async.decision.epoch_n_err, wf_sync.decision.epoch_n_err)
+    for ca, cb in zip(wf_async.decision.confusion_matrixes,
+                      wf_sync.decision.confusion_matrixes):
+        if ca is None or cb is None:
+            assert ca is None and cb is None
+            continue
+        numpy.testing.assert_array_equal(ca, cb)
+    assert wf_async.decision.max_err_y_sums == \
+        wf_sync.decision.max_err_y_sums
+    for la, lb in zip(wf_async.fused_trainer.host_params(),
+                      wf_sync.fused_trainer.host_params()):
+        for k in la:
+            numpy.testing.assert_array_equal(la[k], lb[k])
+
+    # wine: one TRAIN segment per epoch, 18 minibatches -> 5 windows
+    segments = EPOCHS
+    windows_per_segment = -(-18 // WINDOW)
+    assert tele_async.get("readbacks") == segments, tele_async
+    assert tele_sync.get("readbacks") == segments * windows_per_segment, \
+        tele_sync
+    # the async run's d2h traffic is exactly the segment readbacks
+    assert tele_async.get("d2h_calls") == segments, tele_async
+
+    print("async smoke OK: %d epochs, readbacks async=%d (1/segment) "
+          "sync=%d (1/window), d2h %d B vs %d B, aggregates identical"
+          % (EPOCHS, tele_async["readbacks"], tele_sync["readbacks"],
+             tele_async["d2h_bytes"], tele_sync["d2h_bytes"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,8 @@ echo "== health smoke (NaN injection -> halt + crash report)"
 JAX_PLATFORMS=cpu python tools/health_smoke.py
 echo "== profiler smoke (fused wine, cost registry + ledger + breakdown)"
 JAX_PLATFORMS=cpu python tools/profiler_smoke.py
+echo "== async smoke (wine both control-plane modes, 1 readback/segment)"
+JAX_PLATFORMS=cpu python tools/async_smoke.py
 echo "== serving smoke (wine snapshot over HTTP, 64 concurrent, 0 recompiles)"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 if [ "$1" = "full" ]; then

@@ -394,7 +394,17 @@ def check_training_step(unit=None, steps=1, params=None, grads=None,
                         updates=None, context="train_step"):
     """Fused-trainer hook: advance the step counter by ``steps`` (a
     scan window is K steps) and, when due, run ONE fused check over the
-    given pytrees.  Returns the report when a check ran, else None."""
+    given pytrees.  Returns the report when a check ran, else None.
+
+    Asynchronous control plane interplay: the pytrees the trainer hands
+    over are the just-dispatched window's OUTPUT futures, so the check
+    piggybacks the same jitted reduction it always ran — no extra
+    device syncs are added by the async pipeline.  When a check is due,
+    its documented tiny flag/norm fetch transitively waits on the
+    window it inspects (armed health at interval=1 therefore paces the
+    pipeline to one window, exactly like the armed profiler probe);
+    when not due, the hook stays a counter bump and the pipeline keeps
+    its depth."""
     if not enabled():
         return None
     m = monitor()

@@ -477,7 +477,14 @@ class _WindowProbe(object):
     Parts: ``data_wait`` (loader time inside the collection, reported
     by ``Loader.run`` itself), ``host_collect`` (collection minus
     loader), ``dispatch``, ``device``, ``readback``.  Their sum equals
-    the probe's wall time by construction."""
+    the probe's wall time by construction.
+
+    Asynchronous control plane: the armed probe's ``dispatched`` block
+    IS its documented per-window device sync — it drains the trainer's
+    window pipeline, so breakdowns taken while profiling reflect the
+    synchronous schedule (that is the point: attribution needs the
+    wait).  Unarmed, mid-epoch windows never block and ``readback``
+    accrues only on segment-final windows."""
 
     __slots__ = ("t0", "t_collect", "t_dispatch", "t_device", "_wait0",
                  "_closed")

@@ -32,8 +32,13 @@ snapshotter, ``bench.py`` and the status server.  Three pillars:
   cache is MISSING; steady counters with growing step counts mean
   cache hits), and persistent-compilation-cache hits/misses.
   Host↔device traffic is metered where it actually happens —
-  ``memory.Array`` map_read/dev (`transfer.d2h_bytes` /
-  `transfer.h2d_bytes`).
+  ``memory.Array`` map_read/dev and the fused trainer's batched
+  ``host_fetch`` (`transfer.d2h_bytes` / `transfer.h2d_bytes`, one
+  `transfer.*_calls` bump per round trip).  The asynchronous control
+  plane additionally counts its per-segment aggregate readbacks
+  (`trainer.readbacks` — == segments when fully async; surfaced as
+  ``summary()["readbacks"]`` and `bench.py`'s `readbacks_per_epoch`)
+  and gauges the window pipeline (`trainer.inflight_windows`).
 
 Disabled-by-default fast path: everything is gated on
 ``root.common.telemetry.enabled``.  When off, :func:`span` returns one
@@ -694,8 +699,14 @@ def summary():
         "backend_compiles": int(c.get("jax.backend_compiles", 0)),
         "jaxpr_traces": int(c.get("jax.traces", 0)),
         "d2h_bytes": int(c.get("transfer.d2h_bytes", 0)),
+        "d2h_calls": int(c.get("transfer.d2h_calls", 0)),
         "h2d_bytes": int(c.get("transfer.h2d_bytes", 0)),
     }
+    if "trainer.readbacks" in c:
+        # async control plane: batched decision-aggregate readbacks the
+        # fused trainer paid (== segments when fully asynchronous) —
+        # bench.py stamps readbacks_per_epoch from this
+        out["readbacks"] = int(c["trainer.readbacks"])
     cs = h.get("jax.compile_seconds")
     if cs:
         out["compile_seconds_total"] = round(cs.get("sum", 0.0), 3)

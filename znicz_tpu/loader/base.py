@@ -313,6 +313,39 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         if prof_t0 is not None:
             profiler.note_data_wait(time.perf_counter() - prof_t0)
 
+    def fill_window_slot(self, x_out=None, labels_out=None,
+                         targets_out=None, indices_out=None):
+        """Overlap-aware window collection: copy the just-served
+        minibatch's host buffers straight into caller-owned staging rows
+        (the fused trainer's pipelined window assembly,
+        units/fused_trainer.py).
+
+        The caller owns the staging lifetime — the trainer rotates
+        ``pipeline_depth + 1`` buffer sets so a row is never rewritten
+        while the window it was dispatched with may still be reading it
+        (``jax.device_put`` may alias aligned host buffers on the CPU
+        backend).  ONE copy per minibatch replaces the previous
+        per-step ``numpy.array`` copy + ``numpy.stack`` re-copy, and the
+        loader's own buffers are free for the next ``run()`` the moment
+        this returns — which is what lets collection of window K+1
+        overlap the device executing window K.  Padded tail rows carry
+        whatever the loader's fill discipline put there (labels -1,
+        targets 0 — ``run()``); ``indices_out`` rows are valid under
+        ``skip_fill`` too (only index/size/class bookkeeping serves
+        then)."""
+        if x_out is not None:
+            self.minibatch_data.map_read()
+            x_out[...] = self.minibatch_data.mem
+        if labels_out is not None:
+            self.minibatch_labels.map_read()
+            labels_out[...] = self.minibatch_labels.mem
+        if targets_out is not None:
+            targets = self.minibatch_targets  # MSE mixin contract
+            targets.map_read()
+            targets_out[...] = targets.mem
+        if indices_out is not None:
+            indices_out[...] = self.minibatch_indices.mem
+
     # -- master-slave stubs (kept for protocol parity) ----------------------
     def generate_data_for_slave(self, slave=None):
         return None

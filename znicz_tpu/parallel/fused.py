@@ -35,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
+from znicz_tpu.core import telemetry
 from znicz_tpu.ops import activations, gd_math
 from znicz_tpu.ops import conv as conv_ops
 from znicz_tpu.ops import pooling as pool_ops
@@ -957,6 +958,14 @@ class FusedNet:
         self.stats_mean = True
         #: compiled window functions keyed by (n_steps, mode[, batch])
         self._window_fns = {}
+        #: device-resident epoch accumulators for the decision aggregates
+        #: (n_err / confusion / max_err_sum, or the MSE [sum,max,min]
+        #: metrics + class-target n_err).  Every window executable takes
+        #: the running accumulator as a donated argument and returns the
+        #: folded total under ``stats["acc"]`` — the asynchronous control
+        #: plane reads them back ONCE per segment instead of per window
+        #: (units/fused_trainer.py).  None = zeros on the next window.
+        self._win_acc = None
         self._data_d = None
         self._labels_d = None
         #: per-epoch materialized permutation of the device dataset
@@ -1411,7 +1420,7 @@ class FusedNet:
                      nerr + d_nerr, conf + d_conf, jnp.maximum(mx, d_mx))
             return carry, m["loss"]
 
-        def window_fn(p, s, k, data, lbl_all, xs, ls, bs_s, hy_s):
+        def window_fn(p, s, k, data, lbl_all, xs, ls, bs_s, hy_s, acc):
             b = batch if mode == "sliced" else xs.shape[1]
             out0 = jnp.zeros((b, n_classes), dtype=out_dtype)
             idx0 = jnp.zeros((b,), dtype=jnp.int32)
@@ -1432,8 +1441,16 @@ class FusedNet:
             carry0 = (p, s, k, out0, idx0, nerr0, conf0, mx0)
             (p, s, k, out, midx, nerr, conf, mx), losses = jax.lax.scan(
                 scan_body, carry0, xs_scan)
+            # fold this window's deltas into the device-resident epoch
+            # accumulator OUTSIDE the scan (acc + window_delta is the
+            # exact f32/int op sequence the synchronous host fold ran,
+            # so the async segment total is bit-identical)
+            acc = {"n_err": acc["n_err"] + nerr,
+                   "confusion": acc["confusion"] + conf,
+                   "max_err_sum": jnp.maximum(acc["max_err_sum"], mx)}
             stats = {"loss": losses, "n_err": nerr, "confusion": conf,
-                     "max_err_sum": mx, "output": out, "max_idx": midx}
+                     "max_err_sum": mx, "output": out, "max_idx": midx,
+                     "acc": acc}
             return p, s, k, stats
 
         if self.mesh is not None:
@@ -1442,14 +1459,53 @@ class FusedNet:
             ishard = NamedSharding(self.mesh, P("data"))
             mshard = {"loss": rep, "n_err": rep, "confusion": rep,
                       "max_err_sum": rep,
-                      "output": oshard, "max_idx": ishard}
-            fn = jax.jit(window_fn, donate_argnums=(0, 1),
+                      "output": oshard, "max_idx": ishard,
+                      "acc": {"n_err": rep, "confusion": rep,
+                              "max_err_sum": rep}}
+            fn = jax.jit(window_fn, donate_argnums=(0, 1, 9),
                          out_shardings=(self._pshard, self._sshard, rep,
                                         mshard))
         else:
-            fn = jax.jit(window_fn, donate_argnums=(0, 1))
+            fn = jax.jit(window_fn, donate_argnums=(0, 1, 9))
         self._window_fns[key_] = fn
         return fn
+
+    # -- device-resident epoch accumulators ---------------------------------
+    def _window_acc(self):
+        """The running decision-aggregate accumulator (device arrays,
+        replicated over the mesh), created as zeros on the first window
+        after a :meth:`reset_window_acc`.  Carried INTO every window
+        executable as a donated argument and OUT under ``stats["acc"]``
+        — the async control plane's one readback per segment."""
+        if self._win_acc is not None:
+            return self._win_acc
+        out_dtype = jnp.float32 if self.compute_dtype is not None \
+            else self.dtype
+        if self.objective == "mse":
+            acc = {"metrics": numpy.array([0.0, 0.0, numpy.inf],
+                                          dtype=out_dtype),
+                   "n_err": numpy.zeros(2, numpy.int32)}
+        else:
+            n_classes = int(self.specs[-1].n_out)
+            acc = {"n_err": numpy.zeros(2, numpy.int32),
+                   "confusion": numpy.zeros((n_classes, n_classes),
+                                            numpy.int32),
+                   "max_err_sum": numpy.zeros((), out_dtype)}
+        rep = None if self.mesh is None else NamedSharding(self.mesh, P())
+        self._win_acc = {k: jax.device_put(v, rep)
+                         for k, v in acc.items()}
+        return self._win_acc
+
+    @property
+    def window_acc(self):
+        """The last window's folded epoch accumulator (device; None
+        before the first window of a segment)."""
+        return self._win_acc
+
+    def reset_window_acc(self):
+        """Zero the epoch accumulator (the trainer calls this at every
+        segment boundary, after its one batched readback)."""
+        self._win_acc = None
 
     def _place_window(self, arr, tail_dims):
         """Device-put a (K, batch, ...) stacked window input: scan dim
@@ -1482,15 +1538,17 @@ class FusedNet:
         labels_s = self._place_window(
             numpy.asarray(labels_s, dtype=numpy.int32), 0)
         bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
                 "fused.window.stacked.k%d" % n_steps, fn,
                 (self.params, self.state, self._key, 0, 0, xs, labels_s,
-                 bs, hypers_s),
+                 bs, hypers_s, acc),
                 steps=n_steps, batch=xs.shape[1])
         self.params, self.state, self._key, stats = fn(
             self.params, self.state, self._key, 0, 0, xs, labels_s, bs,
-            hypers_s)
+            hypers_s, acc)
+        self._win_acc = stats["acc"]
         return stats
 
     def run_window_indexed(self, idx_s, batch_sizes, hypers_s):
@@ -1506,15 +1564,17 @@ class FusedNet:
         idx_s = self._place_window(
             numpy.asarray(idx_s, dtype=numpy.int32), 0)
         bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
                 "fused.window.indexed.k%d" % n_steps, fn,
                 (self.params, self.state, self._key, self._data_d,
-                 self._labels_d, idx_s, None, bs, hypers_s),
+                 self._labels_d, idx_s, None, bs, hypers_s, acc),
                 steps=n_steps, batch=idx_s.shape[1])
         self.params, self.state, self._key, stats = fn(
             self.params, self.state, self._key, self._data_d,
-            self._labels_d, idx_s, None, bs, hypers_s)
+            self._labels_d, idx_s, None, bs, hypers_s, acc)
+        self._win_acc = stats["acc"]
         return stats
 
     def run_window_sliced(self, starts, batch, batch_sizes, hypers_s):
@@ -1534,15 +1594,17 @@ class FusedNet:
         starts = jax.device_put(
             numpy.asarray(starts, dtype=numpy.int32), rep)
         bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
                 "fused.window.sliced.k%d" % n_steps, fn,
                 (self.params, self.state, self._key, self._data_p,
-                 self._labels_p, starts, None, bs, hypers_s),
+                 self._labels_p, starts, None, bs, hypers_s, acc),
                 steps=n_steps, batch=batch)
         self.params, self.state, self._key, stats = fn(
             self.params, self.state, self._key, self._data_p,
-            self._labels_p, starts, None, bs, hypers_s)
+            self._labels_p, starts, None, bs, hypers_s, acc)
+        self._win_acc = stats["acc"]
         return stats
 
     # -- windowed MSE (the AE/regression hot loop) --------------------------
@@ -1621,7 +1683,7 @@ class FusedNet:
             return carry, m["loss"]
 
         def window_fn(p, s, k, data, tgt_all, lbl_all, xs, ts, ls,
-                      bs_s, hy_s):
+                      bs_s, hy_s, acc):
             b = batch if mode == "sliced" else xs.shape[1]
             out0 = jnp.zeros((b,) + out_shape, dtype=out_dtype)
             mse0 = jnp.zeros((b,), dtype=out_dtype)
@@ -1641,9 +1703,19 @@ class FusedNet:
             carry0 = (p, s, k, out0, mse0, msum0, mmax0, mmin0, nerr0)
             (p, s, k, out, mse_per, msum, mmax, mmin, nerr), losses = \
                 jax.lax.scan(scan_body, carry0, xs_scan)
+            # epoch-accumulator fold — the exact op sequence of the
+            # synchronous host fold (window sum computed in-scan from
+            # zero, THEN one add onto the running total), so the async
+            # segment aggregate is bit-identical (see _get_window_fn)
+            acc = {"metrics": jnp.stack(
+                       [acc["metrics"][0] + msum,
+                        jnp.maximum(acc["metrics"][1], mmax),
+                        jnp.minimum(acc["metrics"][2], mmin)]),
+                   "n_err": acc["n_err"] + nerr}
             stats = {"loss": losses,
                      "metrics": jnp.stack([msum, mmax, mmin]),
-                     "mse_per": mse_per, "n_err": nerr, "output": out}
+                     "mse_per": mse_per, "n_err": nerr, "output": out,
+                     "acc": acc}
             return p, s, k, stats
 
         if self.mesh is not None:
@@ -1652,12 +1724,13 @@ class FusedNet:
                 self.mesh, P("data", *([None] * len(out_shape))))
             mshard = {"loss": rep, "metrics": rep, "n_err": rep,
                       "mse_per": NamedSharding(self.mesh, P("data")),
-                      "output": oshard}
-            fn = jax.jit(window_fn, donate_argnums=(0, 1),
+                      "output": oshard,
+                      "acc": {"metrics": rep, "n_err": rep}}
+            fn = jax.jit(window_fn, donate_argnums=(0, 1, 11),
                          out_shardings=(self._pshard, self._sshard, rep,
                                         mshard))
         else:
-            fn = jax.jit(window_fn, donate_argnums=(0, 1))
+            fn = jax.jit(window_fn, donate_argnums=(0, 1, 11))
         self._window_fns[key_] = fn
         return fn
 
@@ -1676,15 +1749,17 @@ class FusedNet:
         lbl_s = self._place_window(
             numpy.asarray(lbl_s, dtype=numpy.int32), 0)
         bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
                 "fused.window.mse.k%d" % n_steps, fn,
                 (self.params, self.state, self._key, 0, 0, 0, xs, ts,
-                 lbl_s, bs, hypers_s),
+                 lbl_s, bs, hypers_s, acc),
                 steps=n_steps, batch=xs.shape[1])
         self.params, self.state, self._key, stats = fn(
             self.params, self.state, self._key, 0, 0, 0, xs, ts, lbl_s,
-            bs, hypers_s)
+            bs, hypers_s, acc)
+        self._win_acc = stats["acc"]
         return stats
 
     def run_window_mse_sliced(self, starts, batch, batch_sizes, hypers_s):
@@ -1704,17 +1779,19 @@ class FusedNet:
         starts = jax.device_put(
             numpy.asarray(starts, dtype=numpy.int32), rep)
         bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
                 "fused.window.mse_sliced.k%d" % n_steps, fn,
                 (self.params, self.state, self._key, self._data_p,
                  self._targets_p, self._labels_p, starts, None, None,
-                 bs, hypers_s),
+                 bs, hypers_s, acc),
                 steps=n_steps, batch=batch)
         self.params, self.state, self._key, stats = fn(
             self.params, self.state, self._key, self._data_p,
             self._targets_p, self._labels_p, starts, None, None, bs,
-            hypers_s)
+            hypers_s, acc)
+        self._win_acc = stats["acc"]
         return stats
 
     def host_fetch(self, tree):
@@ -1722,17 +1799,25 @@ class FusedNet:
         shards live on other hosts are resharded to replicated first
         (one all-gather at READBACK time — window outputs stay
         data-sharded on the hot path and only segment-final reads pay
-        the transfer)."""
+        the transfer).  Metered on the telemetry d2h byte/call counters
+        (ONE call per fetch, however many leaves ride it) — the async
+        control plane's zero-mid-epoch-readback pin reads this meter."""
         if not self._replicate_outputs:
-            return jax.device_get(tree)
-        rep = NamedSharding(self.mesh, P())
+            host = jax.device_get(tree)
+        else:
+            rep = NamedSharding(self.mesh, P())
 
-        def _rep(x):
-            if isinstance(x, jax.Array) and not x.is_fully_addressable:
-                return jax.jit(lambda a: a, out_shardings=rep)(x)
-            return x
+            def _rep(x):
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    return jax.jit(lambda a: a, out_shardings=rep)(x)
+                return x
 
-        return jax.device_get(jax.tree.map(_rep, tree))
+            host = jax.device_get(jax.tree.map(_rep, tree))
+        if telemetry.enabled():
+            telemetry.add_bytes("d2h", sum(
+                leaf.nbytes for leaf in jax.tree.leaves(host)
+                if hasattr(leaf, "nbytes")))
+        return host
 
     def params_finite(self):
         """Device-side all-finite reduction over every parameter — the

@@ -137,6 +137,15 @@ class EvaluatorSoftmax(EvaluatorBase):
             if self.stats_source is not None else None
         if ws is None:
             return False
+        if ws.get("deferred"):
+            # asynchronous control plane: this mid-epoch window's
+            # aggregates are riding the trainer's device-resident epoch
+            # accumulators — the segment-final window delivers the whole
+            # segment's totals in ONE batched readback, and THAT is when
+            # the host fold below runs (bit-identical to per-window
+            # folding: int adds and max are associative, and the device
+            # fold replays the exact host op order)
+            return True
         self._accumulate_stats(ws["n_err"], ws["confusion"],
                                ws["max_err_sum"])
         if self.testing:
@@ -255,7 +264,14 @@ class EvaluatorMSE(EvaluatorBase):
         output buffer."""
         ws = getattr(self.stats_source, "window_stats", None) \
             if self.stats_source is not None else None
-        if ws is None or "metrics" not in ws:
+        if ws is None:
+            return False
+        if ws.get("deferred"):
+            # async control plane mid-epoch window: aggregates ride the
+            # device accumulators until the segment-final readback (see
+            # EvaluatorSoftmax._consume_window_stats)
+            return True
+        if "metrics" not in ws:
             return False
         md = numpy.asarray(ws["metrics"])
         self.metrics.map_write()

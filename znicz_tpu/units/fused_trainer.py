@@ -27,6 +27,7 @@ through the same attribute contract they use on real GD units.
 whole-net state snapshots instead of per-GD-unit weight histories.
 """
 
+import collections
 import time
 
 import numpy
@@ -43,6 +44,45 @@ from znicz_tpu.core import prng
 from znicz_tpu.core import telemetry
 from znicz_tpu.loader.base import TRAIN
 from znicz_tpu.parallel import fused
+
+
+#: sentinel ``window_stats`` value for mid-epoch windows under the
+#: asynchronous control plane: "this window's decision aggregates are
+#: riding the device-resident epoch accumulators — nothing to fold on
+#: the host until the segment-final batched readback".  The evaluator
+#: treats it as consumed (units/evaluator.py _consume_window_stats).
+DEFERRED_WINDOW_STATS = {"deferred": True}
+
+
+class _StagingRing(object):
+    """Rotating preallocated host staging buffers for window assembly.
+
+    ``depth`` independent buffer sets per key rotate round-robin: under
+    the pipelined dispatch the PREVIOUS window may still be consuming
+    its staging rows (``jax.device_put`` can alias aligned host memory
+    on the CPU backend), so a buffer set is only reused once its window
+    is at least ``depth`` dispatches old — the trainer bounds in-flight
+    windows at ``pipeline_depth = depth - 1``.  One copy per collected
+    minibatch lands straight in its (K, B, ...) row; the dispatch hands
+    the leading-axis view over with no ``numpy.stack`` re-copy."""
+
+    def __init__(self, depth):
+        self.depth = max(1, int(depth))
+        self._slots = {}   # key -> [[buffers...], next_turn]
+
+    def get(self, key, shape, dtype):
+        """The next staging buffer for ``key`` (allocated on first use
+        or when the window geometry changed)."""
+        shape = tuple(int(s) for s in shape)
+        slot = self._slots.get(key)
+        if slot is None or slot[0][0].shape != shape or \
+                slot[0][0].dtype != numpy.dtype(dtype):
+            slot = [[numpy.zeros(shape, dtype)
+                     for _ in range(self.depth)], 0]
+            self._slots[key] = slot
+        bufs, turn = slot
+        slot[1] = (turn + 1) % self.depth
+        return bufs[turn]
 
 
 class GDProxy(object):
@@ -164,6 +204,24 @@ class FusedForwardBackward(Unit):
         #: so it only adds concat/alloc churn — BENCH_NOTES.md) — while
         #: MSE uses sliced, its only device-data form.
         self.device_perm = kwargs.get("device_perm", "auto")
+        #: asynchronous control plane (windowed mode): mid-epoch windows
+        #: issue ZERO synchronous d2h transfers — the decision aggregates
+        #: ride device-resident epoch accumulators (fused.FusedNet
+        #: window_acc) and the host fetches ONE batched transfer per
+        #: segment (accumulators + segment-final output/argmax), so the
+        #: trainer collects and dispatches window K+1 while window K is
+        #: still in flight.  False restores the synchronous per-window
+        #: readback — the equivalence pin's reference mode.
+        self.async_windows = bool(kwargs.get("async_windows", True))
+        #: bound on dispatched-but-unfinished windows before collection
+        #: blocks on the oldest (a completion WAIT, not a transfer):
+        #: caps live input buffers under donation and gates the staging
+        #: ring's reuse
+        self.pipeline_depth = int(kwargs.get("pipeline_depth", 2))
+        #: in-flight window tokens (one tiny device array per dispatched
+        #: mid-epoch window, oldest first)
+        self._inflight = collections.deque()
+        self._staging = _StagingRing(self.pipeline_depth + 1)
         #: the loader unit driven directly during window collection
         #: (wired by StandardWorkflow.link_fused_trainer)
         self.loader_unit = None
@@ -458,9 +516,20 @@ class FusedForwardBackward(Unit):
         crosses a segment boundary — collection stops at the loader's
         last_minibatch, so epoch/segment bookkeeping, snapshotter gating
         and decision semantics are untouched (reference decision.py only
-        consumes segment aggregates + end-of-segment output).  Returns
-        the number of minibatches dispatched.  ``probe`` is the armed
-        profiler's window probe (None otherwise)."""
+        consumes segment aggregates + end-of-segment output).
+
+        Asynchronous control plane (``async_windows``, the default):
+        mid-epoch windows return WITHOUT any host readback — the
+        decision aggregates were folded into device-resident epoch
+        accumulators inside the dispatched executable, the evaluator
+        gets the DEFERRED sentinel, and the next iteration collects
+        window K+1 while this one is still in flight (bounded at
+        ``pipeline_depth``).  The segment-final window fetches the
+        accumulators + output/argmax in ONE batched transfer and zeros
+        them for the next segment.
+
+        Returns the number of minibatches dispatched.  ``probe`` is the
+        armed profiler's window probe (None otherwise)."""
         loader = self.loader_unit
         if self._use_device_data and not self.net.has_dataset:
             data = numpy.asarray(loader.original_data.mem,
@@ -483,35 +552,44 @@ class FusedForwardBackward(Unit):
                     numpy.asarray(loader.train_indices),
                     pad=int(loader.max_minibatch_size))
                 self._mat_serial = loader.shuffle_serial
-        idx_steps, x_steps, lbl_steps, tgt_steps = [], [], [], []
+        batch = int(self.input.shape[0])
         starts, sizes, hyper_steps = [], [], []
+        stage_x = stage_l = stage_t = stage_idx = None
+        if self._use_device_data and not self._use_sliced:
+            stage_idx = self._staging.get(
+                "idx", (self.window, batch), numpy.int32)
+        elif not self._use_device_data:
+            # overlap-aware collection: each minibatch lands straight in
+            # its staging row (ONE copy; the old per-step numpy.array +
+            # numpy.stack paid two).  The ring rotates pipeline_depth+1
+            # buffer sets so dispatched windows never see a reused row.
+            stage_x = self._staging.get(
+                "x", (self.window,) + tuple(self.input.shape),
+                self.input.dtype)
+            stage_l = self._staging.get(
+                "lbl", (self.window, batch), numpy.int32)
+            if self.loss == "mse":
+                stage_t = self._staging.get(
+                    "tgt", (self.window,) + tuple(self.target.shape),
+                    self.target.dtype)
         while True:
+            i = len(sizes)
             if self._use_device_data and self._use_sliced:
                 starts.append(int(loader.minibatch_class_offset))
             elif self._use_device_data:
-                idx_steps.append(
-                    numpy.array(loader.minibatch_indices.mem,
-                                dtype=numpy.int32))
+                loader.fill_window_slot(indices_out=stage_idx[i])
+            elif self.loss == "mse":
+                lbls = getattr(loader, "minibatch_labels", None)
+                want_lbl = self.net.class_targets is not None and lbls
+                loader.fill_window_slot(
+                    x_out=stage_x[i],
+                    labels_out=stage_l[i] if want_lbl else None,
+                    targets_out=stage_t[i])
+                if not want_lbl:
+                    stage_l[i][...] = -1
             else:
-                self.input.map_read()
-                # numpy.array COPIES (asarray would alias the loader's
-                # live buffer, which the next loader.run() overwrites)
-                x_steps.append(numpy.array(self.input.mem))
-                if self.loss == "mse":
-                    self.target.map_read()
-                    tgt_steps.append(numpy.array(self.target.mem))
-                    lbls = getattr(loader, "minibatch_labels", None)
-                    if self.net.class_targets is not None and lbls:
-                        lbls.map_read()
-                        lbl_steps.append(numpy.array(
-                            lbls.mem, dtype=numpy.int32))
-                    else:
-                        lbl_steps.append(numpy.full(
-                            self.input.shape[0], -1, numpy.int32))
-                else:
-                    self.labels.map_read()
-                    lbl_steps.append(numpy.array(self.labels.mem,
-                                                 dtype=numpy.int32))
+                loader.fill_window_slot(x_out=stage_x[i],
+                                        labels_out=stage_l[i])
             sizes.append(int(self.minibatch_size))
             hyper_steps.append(self._collect_hypers())
             n = len(sizes)
@@ -532,40 +610,76 @@ class FusedForwardBackward(Unit):
         if self._use_device_data:
             if self.loss == "mse":
                 stats = self.net.run_window_mse_sliced(
-                    starts, int(self.input.shape[0]), sizes, hypers_s)
+                    starts, batch, sizes, hypers_s)
             elif self._use_sliced:
                 stats = self.net.run_window_sliced(
-                    starts, int(self.input.shape[0]), sizes, hypers_s)
+                    starts, batch, sizes, hypers_s)
             else:
                 stats = self.net.run_window_indexed(
-                    numpy.stack(idx_steps), sizes, hypers_s)
+                    stage_idx[:n], sizes, hypers_s)
         elif self.loss == "mse":
             stats = self.net.run_window_mse(
-                numpy.stack(x_steps), numpy.stack(tgt_steps),
-                numpy.stack(lbl_steps), sizes, hypers_s)
+                stage_x[:n], stage_t[:n], stage_l[:n], sizes, hypers_s)
         else:
             stats = self.net.run_window(
-                numpy.stack(x_steps), numpy.stack(lbl_steps), sizes,
-                hypers_s)
+                stage_x[:n], stage_l[:n], sizes, hypers_s)
         if probe is not None:
             # blocks on the window's result tree: the wait IS the
-            # device-compute share of this window's wall time
+            # device-compute share of this window's wall time (the
+            # armed profiler's documented per-window sync — it drains
+            # the async pipeline by construction)
             probe.dispatched(stats)
-        # ONE pipelined host readback per window (device_get issues all
+        pull_output = bool(loader.last_minibatch)
+        if self.async_windows and not pull_output:
+            # asynchronous steady state: ZERO host readback — this
+            # window's aggregates were folded into the device-resident
+            # epoch accumulators inside the dispatched executable, and
+            # the host moves straight on to collecting window K+1 while
+            # this one is still in flight.  Bound the pipeline so live
+            # input buffers (and the staging ring) stay capped under
+            # donation: waiting on a tiny result token is a completion
+            # wait, NOT a transfer.
+            self.window_stats = DEFERRED_WINDOW_STATS
+            # the per-window n_err delta is the wait token: tiny, and —
+            # unlike the accumulator leaves — never DONATED into the
+            # next window's dispatch (blocking on a donated buffer
+            # raises once the successor consumes it)
+            self._inflight.append(stats["n_err"])
+            # retire tokens whose windows already finished (is_ready is
+            # a host-side peek, no sync) so the deque — and the gauge —
+            # count windows that are genuinely still executing: under a
+            # forced per-window sync (armed probe/health) it correctly
+            # reads 0, the regression it exists to surface
+            while self._inflight and self._inflight[0].is_ready():
+                self._inflight.popleft()
+            while len(self._inflight) > self.pipeline_depth:
+                jax.block_until_ready(self._inflight.popleft())
+            if telemetry.enabled():
+                telemetry.gauge("trainer.inflight_windows").set(
+                    len(self._inflight))
+            self._refresh_weight_views()
+            return n
+        # ONE pipelined batched host readback (device_get issues all
         # async copies before waiting — per-leaf numpy.asarray would pay
         # one full round trip EACH, which dominates on tunneled devices).
-        # The (batch, classes) output/argmax buffers are pulled only for
-        # SEGMENT-FINAL windows: in windowed mode every reference
-        # consumer of ``output`` (evaluator merge, image saver,
-        # plotters, decision end-of-segment bookkeeping) fires at
-        # segment/epoch boundaries, and mid-epoch windows' outputs are
-        # unread — skipping them saves the large transfer per window.
-        pull_output = bool(loader.last_minibatch)
+        # Async mode reads it once per SEGMENT: the device accumulators
+        # carry the whole segment's decision aggregates (max_err_sum
+        # included — no per-window scalar sync), and the (batch, classes)
+        # output/argmax buffers ride the same transfer because every
+        # reference consumer of ``output`` (evaluator merge, image
+        # saver, plotters, decision bookkeeping) fires at segment
+        # boundaries.  Sync mode (async_windows=False) keeps the
+        # reference per-window delta readback.
+        use_acc = self.async_windows
+        acc = self.net.window_acc
         if self.loss == "mse":
-            keys = ["metrics", "n_err"]
+            fetch = {
+                "metrics": acc["metrics"] if use_acc else stats["metrics"],
+                "n_err": acc["n_err"] if use_acc else stats["n_err"]}
             if pull_output:
-                keys += ["output", "mse_per"]
-            host = self.net.host_fetch({k: stats[k] for k in keys})
+                fetch["output"] = stats["output"]
+                fetch["mse_per"] = stats["mse_per"]
+            host = self.net.host_fetch(fetch)
             self.window_stats = {
                 "metrics": host["metrics"],
                 "n_err": host["n_err"],
@@ -573,16 +687,32 @@ class FusedForwardBackward(Unit):
             if pull_output:
                 self.window_stats["mse_per"] = host["mse_per"]
         else:
-            keys = ["n_err", "confusion", "max_err_sum"]
+            fetch = {
+                "n_err": acc["n_err"] if use_acc else stats["n_err"],
+                "confusion": (acc["confusion"] if use_acc
+                              else stats["confusion"]),
+                "max_err_sum": (acc["max_err_sum"] if use_acc
+                                else stats["max_err_sum"])}
             if pull_output:
-                keys += ["output", "max_idx"]
-            host = self.net.host_fetch({k: stats[k] for k in keys})
+                fetch["output"] = stats["output"]
+                fetch["max_idx"] = stats["max_idx"]
+            host = self.net.host_fetch(fetch)
             self.window_stats = {
                 "n_err": host["n_err"],
                 "confusion": host["confusion"],
                 "max_err_sum": float(host["max_err_sum"]),
             }
+        if telemetry.enabled():
+            telemetry.counter("trainer.readbacks").inc()
         if pull_output:
+            # segment boundary: the accumulators were consumed whole —
+            # the next segment starts from zeros, and nothing remains in
+            # flight (this fetch transitively waited on every ancestor
+            # window)
+            self.net.reset_window_acc()
+            self._inflight.clear()
+            if telemetry.enabled():
+                telemetry.gauge("trainer.inflight_windows").set(0)
             self.output.map_invalidate()
             self.output.mem[...] = numpy.asarray(host["output"],
                                                  dtype=self.output.dtype)
