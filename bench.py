@@ -431,6 +431,122 @@ def main(profile_dir=None):
     print(json.dumps(out))
 
 
+#: device counts the mesh-scaling bench sweeps (ISSUE 6: multi-device
+#: throughput becomes a tracked number instead of an exit code)
+MESH_DEVICE_COUNTS = (1, 2, 4, 8)
+
+
+def _mesh_worker(n_devices):
+    """Inner process of ``--mesh``: measure the flagship and the
+    cifar-caffe workloads through the SHIPPED control plane on an
+    ``n_devices`` data-parallel mesh (the caller forced
+    ``--xla_force_host_platform_device_count``).  Prints ONE JSON line.
+
+    Sizes are CPU-feasible (the forced-host-device sweep shares one
+    machine's cores): relative scaling and the invariants — not
+    absolute TPU throughput — are the tracked numbers."""
+    import __graft_entry__ as ge
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core import telemetry
+    import znicz_tpu.samples.cifar  # noqa: F401 (root.cifar)
+
+    root.common.telemetry.enabled = True
+    n_steps, n_epochs, batch = 8, 4, 64
+    fused_extra = {} if n_devices == 1 else {"mesh": n_devices}
+    out = {"devices": n_devices}
+    ips, _, fpi = _measure(
+        ge.FLAGSHIP_LAYERS, "mnist_loader", batch, None,
+        n_steps=n_steps, n_epochs=n_epochs, fused_extra=fused_extra)
+    tele = telemetry.summary()
+    out["flagship_images_per_sec"] = round(ips, 1)
+    out["flagship_flops_per_image"] = fpi
+    # the async-control-plane invariant, per device count: readbacks ==
+    # segments (one per epoch here — no VALID split), and the d2h bytes
+    # of one epoch split across the shards
+    segs = float(n_epochs)
+    out["readbacks_per_epoch"] = round(
+        (tele or {}).get("readbacks", 0) / segs, 2)
+    d2h_epoch = int((tele or {}).get("d2h_bytes", 0) / segs)
+    out["d2h_bytes_per_epoch"] = d2h_epoch
+    out["d2h_bytes_per_device_per_epoch"] = d2h_epoch // max(
+        (tele or {}).get("data_shards", 1), 1)
+    out["data_shards"] = (tele or {}).get("data_shards", 1)
+    cifar_ips, _, _ = _measure(
+        root.cifar.layers, "cifar_loader", batch, None,
+        n_steps=n_steps, n_epochs=n_epochs, fused_extra=fused_extra)
+    out["cifar_caffe_images_per_sec"] = round(cifar_ips, 1)
+    print(json.dumps(out))
+
+
+def main_mesh(max_devices=8):
+    """``--mesh [N]``: sweep the fused training control plane over
+    1/2/4/8 forced virtual CPU host devices (each count in its own
+    subprocess — the device count is fixed at backend init) and print
+    ONE JSON line with images/sec per device count, scaling efficiency
+    (ips_N / (N * ips_1)), the readbacks-per-epoch invariant and
+    per-device d2h bytes — the MULTICHIP stamp's payload."""
+    import subprocess
+    import sys
+    counts = [n for n in MESH_DEVICE_COUNTS if n <= max_devices]
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if "xla_force_host_platform_device_count" not in f)
+    here = os.path.dirname(os.path.abspath(__file__))
+    per_n = {}
+    for n in counts:
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(flags +
+                       " --xla_force_host_platform_device_count=%d"
+                       % n).strip(),
+        )
+        code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                "import bench; bench._mesh_worker(%d)" % n)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=here, env=env,
+            capture_output=True, text=True, timeout=1800)
+        if proc.returncode:
+            raise RuntimeError(
+                "mesh worker n=%d failed (rc=%d):\n%s"
+                % (n, proc.returncode, proc.stderr[-4000:]))
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("{")][-1]
+        per_n[n] = json.loads(line)
+
+    def series(key):
+        return {str(n): per_n[n][key] for n in counts}
+
+    def efficiency(key):
+        base = per_n[counts[0]][key]
+        return {str(n): round(per_n[n][key] / (n * base), 3)
+                for n in counts if base}
+
+    out = {
+        "metric": "mesh_scaling_images_per_sec",
+        "device_counts": counts,
+        "backend": "forced virtual CPU host devices "
+                   "(--xla_force_host_platform_device_count; one "
+                   "machine's cores shared across shards — relative "
+                   "scaling + invariants, not absolute TPU throughput)",
+        "flagship_images_per_sec": series("flagship_images_per_sec"),
+        "flagship_scaling_efficiency": efficiency(
+            "flagship_images_per_sec"),
+        "cifar_caffe_images_per_sec": series(
+            "cifar_caffe_images_per_sec"),
+        "cifar_caffe_scaling_efficiency": efficiency(
+            "cifar_caffe_images_per_sec"),
+        # the sharded-async invariant, stamped per device count: must
+        # stay == 1.0 (one batched readback per segment) at every width
+        "readbacks_per_epoch": series("readbacks_per_epoch"),
+        "d2h_bytes_per_epoch": series("d2h_bytes_per_epoch"),
+        "d2h_bytes_per_device_per_epoch": series(
+            "d2h_bytes_per_device_per_epoch"),
+        "data_shards": series("data_shards"),
+    }
+    print(json.dumps(out))
+
+
 def main_serving(duration=5.0, clients=16, max_batch=64):
     """Serving-tier benchmark — prints ONE JSON line: sustained
     throughput (req/s, rows/s) and request latency p50/p99 of the
@@ -531,6 +647,13 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
 
 if __name__ == "__main__":
     import sys
+    if "--mesh" in sys.argv:
+        index = sys.argv.index("--mesh")
+        max_devices = 8
+        if index + 1 < len(sys.argv) and sys.argv[index + 1].isdigit():
+            max_devices = int(sys.argv[index + 1])
+        main_mesh(max_devices=max_devices)
+        sys.exit(0)
     if "--serving" in sys.argv:
         kwargs = {}
         if "--duration" in sys.argv:

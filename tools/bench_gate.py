@@ -1,0 +1,183 @@
+"""Perf-regression gate: compare a fresh bench.py JSON against the
+previous committed ``BENCH_r*.json`` and EXIT NONZERO on a >threshold
+throughput drop for any stamped workload (ROADMAP item 1: the
+trajectory can never silently decay again).
+
+Usage:
+    python tools/bench_gate.py NEW.json [--old OLD.json]
+                               [--threshold 0.10]
+    python bench.py | python tools/bench_gate.py -      # pipe mode
+    python tools/bench_gate.py --selftest               # CI wiring pin
+
+* ``NEW.json`` is bench.py's one-line JSON (or a driver stamp whose
+  payload sits under ``"parsed"``); ``-`` reads stdin.
+* The previous round defaults to the highest-numbered ``BENCH_r*.json``
+  in the repo root (driver stamps — the payload under ``"parsed"``).
+* Gated metrics: every stamped images/sec workload the PREVIOUS round
+  carries (flagship ``value``, ``f32_images_per_sec``,
+  ``cifar_caffe_images_per_sec``, ``wide_conv_images_per_sec``).  A
+  metric absent from the previous round never gates (a new workload
+  must not fail the round that introduces it), but a metric the
+  previous round stamped that comes back zero (bench.py's crash-guard
+  fallback) or missing FAILS — a workload that stopped producing a
+  number is the worst regression, not a skip.
+* ``--selftest`` proves the gate actually fails: it takes the latest
+  committed round, synthesizes a run with one workload dropped 15%
+  below it, asserts the gate REJECTS it (likewise a zeroed/vanished
+  workload), then asserts a 5% drop and an improvement both PASS.
+  ``tools/ci.sh`` runs this mode — the wiring is exercised on every CI
+  run even though CI has no TPU to re-bench.
+
+Exit codes: 0 = within threshold (or nothing to compare), 1 = regression,
+2 = usage/input error.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: stamped throughput keys gated when present in both rounds
+GATED = ("value", "f32_images_per_sec", "cifar_caffe_images_per_sec",
+         "wide_conv_images_per_sec")
+
+
+def _payload(doc):
+    """Unwrap a driver stamp ({"parsed": {...}}) or pass a raw bench
+    JSON through."""
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def latest_round(repo=REPO):
+    """(path, payload) of the highest-numbered BENCH_r*.json, or
+    (None, None)."""
+    best, best_n = None, -1
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = path, int(m.group(1))
+    if best is None:
+        return None, None
+    with open(best) as f:
+        return best, _payload(json.load(f))
+
+
+def compare(new, old, threshold=0.10):
+    """Returns (ok, report): per-metric verdicts; ok=False when any
+    gated metric dropped more than ``threshold``."""
+    checks, ok = [], True
+    for key in GATED:
+        nv, ov = new.get(key), old.get(key)
+        if not ov:
+            # the previous round never measured this workload — a new
+            # metric must not fail the round that introduces it
+            checks.append({"metric": key, "status": "skipped",
+                           "new": nv, "old": ov})
+            continue
+        if not nv:
+            # the previous round HAS a number and the fresh run lost it
+            # (missing key, or bench.py's zero crash-guard stamp): that
+            # is a 100% drop, the exact case the gate exists for
+            ok = False
+            checks.append({"metric": key, "status": "FAIL",
+                           "new": nv, "old": ov, "ratio": 0.0})
+            continue
+        ratio = float(nv) / float(ov)
+        failed = ratio < 1.0 - threshold
+        ok = ok and not failed
+        checks.append({"metric": key, "status":
+                       "FAIL" if failed else "ok",
+                       "new": nv, "old": ov,
+                       "ratio": round(ratio, 4)})
+    return ok, {"threshold": threshold, "checks": checks,
+                "ok": ok}
+
+
+def selftest(threshold=0.10):
+    path, old = latest_round()
+    if old is None:
+        # no committed rounds (fresh clone): prove the math on a stub
+        path, old = "<synthetic>", {"value": 100000.0,
+                                    "cifar_caffe_images_per_sec": 50000.0}
+    base = {k: old[k] for k in GATED if old.get(k)}
+    if not base:
+        print("bench_gate selftest: no gated metrics in %s" % path)
+        return 2
+    key = sorted(base)[0]
+    dropped = dict(base)
+    dropped[key] = base[key] * 0.85          # >10% drop must FAIL
+    ok_drop, _ = compare(dropped, old, threshold)
+    zeroed = dict(base)
+    zeroed[key] = 0.0                        # crash-guard stamp: FAIL
+    ok_zero, _ = compare(zeroed, old, threshold)
+    vanished = dict(base)
+    del vanished[key]                        # lost workload: FAIL
+    ok_gone, _ = compare(vanished, old, threshold)
+    wobble = dict(base)
+    wobble[key] = base[key] * 0.95           # 5% wobble must PASS
+    ok_wobble, _ = compare(wobble, old, threshold)
+    improved = {k: v * 1.2 for k, v in base.items()}
+    ok_up, _ = compare(improved, old, threshold)
+    if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up:
+        print("bench_gate selftest FAILED: drop_rejected=%s "
+              "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
+              "improvement_passed=%s"
+              % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
+                 ok_up))
+        return 1
+    print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
+          "vanished key on %r rejected, 5%% wobble and +20%% "
+          "improvement pass (threshold %.0f%%)"
+          % (os.path.basename(path), key, 100 * threshold))
+    return 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    threshold = 0.10
+    if "--threshold" in argv:
+        i = argv.index("--threshold")
+        threshold = float(argv[i + 1])
+        del argv[i:i + 2]
+    if "--selftest" in argv:
+        return selftest(threshold)
+    old_path = None
+    if "--old" in argv:
+        i = argv.index("--old")
+        old_path = argv[i + 1]
+        del argv[i:i + 2]
+    if not argv:
+        print(__doc__)
+        return 2
+    try:
+        if argv[0] == "-":
+            new = _payload(json.loads(sys.stdin.read()))
+        else:
+            with open(argv[0]) as f:
+                new = _payload(json.load(f))
+    except (OSError, ValueError) as e:
+        print("bench_gate: cannot read new run: %s" % e)
+        return 2
+    if old_path:
+        with open(old_path) as f:
+            old = _payload(json.load(f))
+        old_name = old_path
+    else:
+        old_name, old = latest_round()
+        if old is None:
+            print("bench_gate: no previous BENCH_r*.json; nothing to "
+                  "gate")
+            return 0
+    ok, report = compare(new, old, threshold)
+    report["previous"] = os.path.basename(str(old_name))
+    print(json.dumps(report))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,10 @@ echo "== profiler smoke (fused wine, cost registry + ledger + breakdown)"
 JAX_PLATFORMS=cpu python tools/profiler_smoke.py
 echo "== async smoke (wine both control-plane modes, 1 readback/segment)"
 JAX_PLATFORMS=cpu python tools/async_smoke.py
+echo "== mesh smoke (wine 1 vs 4 data shards: identical aggregates, 1 readback/segment)"
+JAX_PLATFORMS=cpu python tools/mesh_smoke.py
+echo "== bench gate selftest (injected >10% drop must fail the gate)"
+python tools/bench_gate.py --selftest
 echo "== serving smoke (wine snapshot over HTTP, 64 concurrent, 0 recompiles)"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 if [ "$1" = "full" ]; then

@@ -707,6 +707,13 @@ def summary():
         # fused trainer paid (== segments when fully asynchronous) —
         # bench.py stamps readbacks_per_epoch from this
         out["readbacks"] = int(c["trainer.readbacks"])
+    g = snap.get("gauges") or {}
+    if "trainer.data_shards" in g:
+        # mesh-sharded control plane: the shard extents the trainer ran
+        # under (bench.py --mesh divides d2h bytes by data_shards for
+        # the per-device transfer stamp)
+        out["data_shards"] = int(g["trainer.data_shards"])
+        out["model_shards"] = int(g.get("trainer.model_shards", 1))
     cs = h.get("jax.compile_seconds")
     if cs:
         out["compile_seconds_total"] = round(cs.get("sum", 0.0), 3)

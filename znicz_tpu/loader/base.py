@@ -332,19 +332,28 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         whatever the loader's fill discipline put there (labels -1,
         targets 0 — ``run()``); ``indices_out`` rows are valid under
         ``skip_fill`` too (only index/size/class bookkeeping serves
-        then)."""
+        then).
+
+        Destination views may carry a PER-SHARD staging layout — under a
+        data-parallel mesh the trainer's staging ring is shard-major
+        ``(S, B // S, ...)`` so every shard's rows stay one contiguous
+        host block for ``device_put`` — so each source reshapes to the
+        destination's shape (a view of the contiguous minibatch buffer;
+        still exactly one copy per minibatch)."""
         if x_out is not None:
             self.minibatch_data.map_read()
-            x_out[...] = self.minibatch_data.mem
+            x_out[...] = self.minibatch_data.mem.reshape(x_out.shape)
         if labels_out is not None:
             self.minibatch_labels.map_read()
-            labels_out[...] = self.minibatch_labels.mem
+            labels_out[...] = self.minibatch_labels.mem.reshape(
+                labels_out.shape)
         if targets_out is not None:
             targets = self.minibatch_targets  # MSE mixin contract
             targets.map_read()
-            targets_out[...] = targets.mem
+            targets_out[...] = targets.mem.reshape(targets_out.shape)
         if indices_out is not None:
-            indices_out[...] = self.minibatch_indices.mem
+            indices_out[...] = self.minibatch_indices.mem.reshape(
+                indices_out.shape)
 
     # -- master-slave stubs (kept for protocol parity) ----------------------
     def generate_data_for_slave(self, slave=None):

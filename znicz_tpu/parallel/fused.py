@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
 from znicz_tpu.core import telemetry
+from znicz_tpu.parallel import mesh as mesh_mod
 from znicz_tpu.ops import activations, gd_math
 from znicz_tpu.ops import conv as conv_ops
 from znicz_tpu.ops import pooling as pool_ops
@@ -840,33 +841,63 @@ def _loss_and_stats_mse(params, x, target, batch_size, specs, key=None,
     return loss, y
 
 
-def _eval_stats(probs, max_idx, labels, batch_size, n_classes, mean):
+def _eval_stats(probs, max_idx, labels, batch_size, n_classes, mean,
+                shards=1):
     """Evaluator-identical per-minibatch stats computed INSIDE the
     compiled window (ops/evaluator.softmax_ce_jax semantics, reference
     evaluator.py:271-312): n_err_delta[2], confusion_delta[C,C],
     max_err_output_sum.  Same masking (in-batch AND label >= 0) and the
     same ``err = (probs - onehot) * mult`` row math, so the windowed
     control plane accumulates the exact integers/floats the per-minibatch
-    evaluator would."""
+    evaluator would.
+
+    ``shards > 1`` (a data-parallel mesh): every reduction runs over the
+    LOCAL batch rows only — outputs gain a leading ``shards`` axis
+    (n_err[S,2], confusion[S,C,C], max_err_sum[S]) that stays sharded
+    ``P("data", ...)``, so mid-epoch windows insert NO stats collective;
+    the per-segment all-reduce folds the partials once, at the
+    segment-final window (see _get_window_fn).  Integer partials reduce
+    exactly; the max is order-independent — the sharded aggregates equal
+    the single-device fold bit for bit (docs/distributed.md)."""
     B = probs.shape[0]
     idx = jnp.arange(B)
     in_batch = idx < batch_size
     valid = in_batch & (labels >= 0)
     hits = valid & (max_idx == labels)
-    n_total = valid.sum()
-    n_ok = hits.sum()
-    n_err2 = jnp.stack([n_total - n_ok, n_total]).astype(jnp.int32)
+    if shards == 1:
+        n_total = valid.sum()
+        n_ok = hits.sum()
+        n_err2 = jnp.stack([n_total - n_ok, n_total]).astype(jnp.int32)
+        onehot = jax.nn.one_hot(jnp.maximum(labels, 0), n_classes,
+                                dtype=probs.dtype)
+        # confusion[pred, label] += valid — as a one-hot GEMM, not a
+        # scatter-add (TPU scatters with duplicate indices serialize; the
+        # f32 accumulation is exact for counts < 2^24)
+        pred_onehot = jax.nn.one_hot(max_idx, n_classes, dtype=jnp.float32)
+        conf = ((pred_onehot * valid[:, None].astype(jnp.float32)).T
+                @ onehot.astype(jnp.float32)).astype(jnp.int32)
+        mult = jnp.where(mean, 1.0 / jnp.maximum(batch_size, 1), 1.0)
+        err = (probs - onehot) * mult.astype(probs.dtype)
+        mx = jnp.where(valid, jnp.abs(err).sum(axis=1), 0).max()
+        return n_err2, conf, mx
+    b = B // shards
+    n_total = valid.reshape(shards, b).sum(axis=1)
+    n_ok = hits.reshape(shards, b).sum(axis=1)
+    n_err2 = jnp.stack([n_total - n_ok, n_total],
+                       axis=-1).astype(jnp.int32)
     onehot = jax.nn.one_hot(jnp.maximum(labels, 0), n_classes,
                             dtype=probs.dtype)
-    # confusion[pred, label] += valid — as a one-hot GEMM, not a
-    # scatter-add (TPU scatters with duplicate indices serialize; the
-    # f32 accumulation is exact for counts < 2^24)
     pred_onehot = jax.nn.one_hot(max_idx, n_classes, dtype=jnp.float32)
-    conf = ((pred_onehot * valid[:, None].astype(jnp.float32)).T
-            @ onehot.astype(jnp.float32)).astype(jnp.int32)
+    pv = (pred_onehot * valid[:, None].astype(jnp.float32)).reshape(
+        shards, b, n_classes)
+    oh = onehot.astype(jnp.float32).reshape(shards, b, n_classes)
+    # per-shard one-hot GEMM: the batch contraction stays inside the
+    # shard's local rows — no cross-shard traffic
+    conf = jnp.einsum("sbp,sbl->spl", pv, oh).astype(jnp.int32)
     mult = jnp.where(mean, 1.0 / jnp.maximum(batch_size, 1), 1.0)
     err = (probs - onehot) * mult.astype(probs.dtype)
-    mx = jnp.where(valid, jnp.abs(err).sum(axis=1), 0).max()
+    mx = jnp.where(valid, jnp.abs(err).sum(axis=1),
+                   0).reshape(shards, b).max(axis=1)
     return n_err2, conf, mx
 
 
@@ -895,6 +926,55 @@ def _train_step_mse(params, state, x, target, batch_size, specs, key=None,
         new_params.append(np_)
         new_state.append(nst)
     return new_params, new_state, {"loss": loss, "output": y}
+
+
+class ShardMajorWindow(object):
+    """A host-staged ``(K, B, ...)`` window laid out SHARD-MAJOR:
+    ``base`` has shape ``(S, K, B // S, ...)`` where ``S`` is the data-
+    parallel shard count, so each shard's rows are one contiguous host
+    block (``base[s]``) and :meth:`FusedNet._place_window` can feed
+    ``device_put`` per-shard memcpys instead of strided splits of a
+    batch-major stack (units/fused_trainer.py allocates these via the
+    staging ring; Loader.fill_window_slot writes straight into the
+    per-step ``base[:, i]`` views)."""
+
+    __slots__ = ("base",)
+
+    def __init__(self, base):
+        self.base = base
+
+    @property
+    def shape(self):
+        """The LOGICAL (K, B, ...) window shape."""
+        s, k, b = self.base.shape[:3]
+        return (k, s * b) + tuple(self.base.shape[3:])
+
+    @property
+    def ndim(self):
+        return self.base.ndim - 1
+
+
+def reduce_window_partials(stats, objective):
+    """Host-side fold of per-shard window partials (leading ``S`` axis,
+    see ``_eval_stats(shards=...)``) into the single-device aggregate
+    shapes — the synchronous control plane's per-window host reduce
+    under a data mesh (the async path folds the same reduction into the
+    segment-final window executable instead)."""
+    out = dict(stats)
+    if objective == "mse":
+        m = numpy.asarray(stats["metrics"])
+        out["metrics"] = numpy.stack(
+            [m[:, 0].sum(), m[:, 1].max(), m[:, 2].min()])
+        out["n_err"] = numpy.asarray(stats["n_err"]).sum(axis=0)
+    else:
+        out["n_err"] = numpy.asarray(stats["n_err"]).sum(axis=0)
+        if "confusion" in stats:
+            out["confusion"] = numpy.asarray(
+                stats["confusion"]).sum(axis=0)
+        if "max_err_sum" in stats:
+            out["max_err_sum"] = numpy.asarray(
+                stats["max_err_sum"]).max(axis=0)
+    return out
 
 
 def flops_per_image(specs):
@@ -996,6 +1076,12 @@ class FusedNet:
         else:
             raise ValueError("unknown objective %r" % objective)
         self.mesh = mesh
+        #: data-parallel shard count (1 without a mesh).  When > 1 the
+        #: windowed epoch accumulators keep a leading shard axis
+        #: (sharded P("data", ...)) and mid-epoch windows run with ZERO
+        #: stats collectives; the segment-final window folds the one
+        #: all-reduce per segment (_get_window_fn final=True).
+        self._dp = 1 if mesh is None else int(mesh.shape["data"])
         params_host = init_params(self.specs, rand, dtype)
         states_host = init_opt_state(self.specs, params_host)
         self.params = self._place_params(params_host)
@@ -1089,6 +1175,11 @@ class FusedNet:
             if fwd_kw else {}))
 
     # -- sharding -----------------------------------------------------------
+    @property
+    def data_shards(self):
+        """The mesh's data-parallel extent (1 when unsharded)."""
+        return self._dp
+
     def _param_spec(self, spec, name):
         """model-axis sharding for wide FC layers, replicated otherwise
         (conv kernels are small — replication beats the all-gather)."""
@@ -1127,10 +1218,7 @@ class FusedNet:
     def _place_batch(self, x, labels):
         if self.mesh is None:
             return jax.device_put(x), jax.device_put(labels)
-        dsize = self.mesh.shape["data"]
-        if x.shape[0] % dsize:
-            raise ValueError("batch %d not divisible by data-parallel %d"
-                             % (x.shape[0], dsize))
+        mesh_mod.check_data_batch(self.mesh, x.shape[0])
         xs = NamedSharding(self.mesh, P("data", *([None] * (x.ndim - 1))))
         ls = NamedSharding(self.mesh, P("data"))
         return jax.device_put(x, xs), jax.device_put(labels, ls)
@@ -1255,11 +1343,7 @@ class FusedNet:
             else:
                 self._scan_step = jax.jit(scan_fn, donate_argnums=(0, 1))
         if self.mesh is not None:
-            dsize = self.mesh.shape["data"]
-            if xs.shape[1] % dsize:
-                raise ValueError(
-                    "batch %d not divisible by data-parallel %d"
-                    % (xs.shape[1], dsize))
+            mesh_mod.check_data_batch(self.mesh, xs.shape[1])
             xs = jax.device_put(xs, NamedSharding(
                 self.mesh, P(None, "data", *([None] * (xs.ndim - 2)))))
             labels_s = jax.device_put(
@@ -1353,8 +1437,16 @@ class FusedNet:
                 fn = jax.jit(materialize)
             self._perm_fns[key_] = fn
         rep = None if self.mesh is None else NamedSharding(self.mesh, P())
+        # SNAPSHOT the permutation (numpy.array copies; asarray would
+        # alias): device_put may alias aligned host memory on the CPU
+        # backend and the materialize dispatch below is ASYNCHRONOUS —
+        # the caller's buffer is the loader's live train_indices, which
+        # the epoch-end reshuffle mutates IN PLACE mid window-collection.
+        # Without the copy the gather raced the shuffle and the epoch's
+        # tail window could train on next-epoch rows (the flaky
+        # test_window_sliced_no_valid_segment_epoch_boundary failure).
         perm_d = jax.device_put(
-            numpy.asarray(perm, dtype=numpy.int32), rep)
+            numpy.array(perm, dtype=numpy.int32), rep)
         self._data_p, self._labels_p, tp = fn(
             self._data_d, self._labels_d,
             self._targets_d if has_targets else 0, perm_d)
@@ -1364,7 +1456,7 @@ class FusedNet:
     def has_epoch_perm(self):
         return self._data_p is not None
 
-    def _get_window_fn(self, n_steps, mode, batch=None):
+    def _get_window_fn(self, n_steps, mode, batch=None, final=False):
         """Build (and cache) the compiled K-step window: one ``lax.scan``
         over ``_train_step`` with per-step traced hypers + in-scan
         evaluator stats.  Aggregates (n_err, confusion, max_err_sum) ride
@@ -1376,20 +1468,36 @@ class FusedNet:
         (device-resident dataset + per-row gather), or "sliced"
         (per-epoch materialized permutation + contiguous dynamic
         slices — the production data path; ``batch`` is the static
-        minibatch row count)."""
-        key_ = (int(n_steps), mode, batch)
+        minibatch row count).
+
+        Data-parallel mesh (data shards S > 1): per-step stats and the
+        epoch accumulator keep a leading ``S`` shard axis sharded
+        ``P("data", ...)`` — every in-scan reduction is LOCAL to its
+        shard's batch rows, so mid-epoch windows insert no stats
+        collective beyond the gradient psum the update itself needs.
+        ``final=True`` (the segment-final window) additionally folds the
+        segment's ONE stats all-reduce into the executable and returns
+        the replicated totals under ``stats["acc_reduced"]`` — exactly
+        one aggregate all-reduce per segment, none on the host path."""
+        dp = self._dp
+        final = bool(final) and dp > 1
+        key_ = (int(n_steps), mode, batch, final)
         fn = self._window_fns.get(key_)
         if fn is not None:
             return fn
         specs = tuple(self.specs)
         cd = self.compute_dtype
+        mesh = self.mesh
         needs_key = self._needs_key
         n_classes = int(self.specs[-1].n_out)
         mean = bool(self.stats_mean)
         out_dtype = jnp.float32 if cd is not None else self.dtype
 
         def body(carry, step):
-            p, s, k, _, _, nerr, conf, mx = carry
+            if dp > 1:
+                p, s, k, _, _, nerr, conf, mx, i, lbuf = carry
+            else:
+                p, s, k, _, _, nerr, conf, mx = carry
             if mode == "indexed":
                 data, lbl_all, idx, bs, hy = step
                 safe = jnp.maximum(idx, 0)
@@ -1408,6 +1516,15 @@ class FusedNet:
                                 jnp.int32(-1))
             else:
                 x, lbl, bs, hy = step
+            if dp > 1:
+                # pin the minibatch to the data axis INSIDE the scan:
+                # the indexed gather / dynamic slice reads a replicated
+                # dataset, and without the constraint GSPMD is free to
+                # keep the whole step replicated (no scaling)
+                x = jax.lax.with_sharding_constraint(x, NamedSharding(
+                    mesh, P("data", *([None] * (x.ndim - 1)))))
+                lbl = jax.lax.with_sharding_constraint(
+                    lbl, NamedSharding(mesh, P("data")))
             if needs_key:
                 k, sub = jax.random.split(k)
             else:
@@ -1415,18 +1532,37 @@ class FusedNet:
             p, s, m = _train_step(p, s, x, lbl, specs, sub, cd, hy,
                                   with_output=True)
             d_nerr, d_conf, d_mx = _eval_stats(
-                m["output"], m["max_idx"], lbl, bs, n_classes, mean)
-            carry = (p, s, k, m["output"], m["max_idx"],
-                     nerr + d_nerr, conf + d_conf, jnp.maximum(mx, d_mx))
+                m["output"], m["max_idx"], lbl, bs, n_classes, mean,
+                shards=dp)
+            stats_c = (nerr + d_nerr, conf + d_conf,
+                       jnp.maximum(mx, d_mx))
+            if dp > 1:
+                # per-step losses accumulate into a CARRIED buffer via a
+                # one-hot add instead of the scan's ys stacking: a
+                # dynamic-update-slice over a (K,) buffer is sharded by
+                # GSPMD whenever K divides by the shard count, and the
+                # installed jaxlib's partitioner then emits a mixed
+                # s64/s32 offset compare under x64 (hlo verifier error).
+                # The elementwise add partitions trivially.
+                loss = jax.lax.with_sharding_constraint(
+                    m["loss"], NamedSharding(mesh, P()))
+                lbuf = lbuf + loss.astype(lbuf.dtype) * \
+                    jax.nn.one_hot(i, lbuf.shape[0], dtype=lbuf.dtype)
+                carry = (p, s, k, m["output"], m["max_idx"]) + stats_c \
+                    + (i + 1, lbuf)
+                return carry, None
+            carry = (p, s, k, m["output"], m["max_idx"]) + stats_c
             return carry, m["loss"]
 
         def window_fn(p, s, k, data, lbl_all, xs, ls, bs_s, hy_s, acc):
             b = batch if mode == "sliced" else xs.shape[1]
             out0 = jnp.zeros((b, n_classes), dtype=out_dtype)
             idx0 = jnp.zeros((b,), dtype=jnp.int32)
-            nerr0 = jnp.zeros((2,), dtype=jnp.int32)
-            conf0 = jnp.zeros((n_classes, n_classes), dtype=jnp.int32)
-            mx0 = jnp.zeros((), dtype=out_dtype)
+            lead = (dp,) if dp > 1 else ()
+            nerr0 = jnp.zeros(lead + (2,), dtype=jnp.int32)
+            conf0 = jnp.zeros(lead + (n_classes, n_classes),
+                              dtype=jnp.int32)
+            mx0 = jnp.zeros(lead, dtype=out_dtype)
             if mode in ("indexed", "sliced"):
                 # the dataset enters once as a plain argument (closing
                 # over it would bake a huge constant into the program;
@@ -1439,29 +1575,55 @@ class FusedNet:
                 xs_scan = (xs, ls, bs_s, hy_s)
                 scan_body = body
             carry0 = (p, s, k, out0, idx0, nerr0, conf0, mx0)
-            (p, s, k, out, midx, nerr, conf, mx), losses = jax.lax.scan(
-                scan_body, carry0, xs_scan)
+            if dp > 1:
+                carry0 = carry0 + (jnp.int32(0),
+                                   jnp.zeros((n_steps,), dtype=out_dtype))
+                carry1, _ = jax.lax.scan(scan_body, carry0, xs_scan)
+                (p, s, k, out, midx, nerr, conf, mx) = carry1[:8]
+                losses = carry1[9]
+            else:
+                (p, s, k, out, midx, nerr, conf, mx), losses = \
+                    jax.lax.scan(scan_body, carry0, xs_scan)
             # fold this window's deltas into the device-resident epoch
             # accumulator OUTSIDE the scan (acc + window_delta is the
             # exact f32/int op sequence the synchronous host fold ran,
-            # so the async segment total is bit-identical)
+            # so the async segment total is bit-identical; under a data
+            # mesh the fold stays per-shard — elementwise, no collective)
             acc = {"n_err": acc["n_err"] + nerr,
                    "confusion": acc["confusion"] + conf,
                    "max_err_sum": jnp.maximum(acc["max_err_sum"], mx)}
             stats = {"loss": losses, "n_err": nerr, "confusion": conf,
                      "max_err_sum": mx, "output": out, "max_idx": midx,
                      "acc": acc}
+            if final:
+                # the segment's ONE stats all-reduce: integer sums and a
+                # max over the shard axis — order-independent, so the
+                # reduced totals equal the single-device fold bit for bit
+                stats["acc_reduced"] = {
+                    "n_err": acc["n_err"].sum(axis=0),
+                    "confusion": acc["confusion"].sum(axis=0),
+                    "max_err_sum": acc["max_err_sum"].max(axis=0)}
             return p, s, k, stats
 
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             oshard = NamedSharding(self.mesh, P("data", None))
             ishard = NamedSharding(self.mesh, P("data"))
-            mshard = {"loss": rep, "n_err": rep, "confusion": rep,
-                      "max_err_sum": rep,
-                      "output": oshard, "max_idx": ishard,
-                      "acc": {"n_err": rep, "confusion": rep,
-                              "max_err_sum": rep}}
+            if dp > 1:
+                sh1 = NamedSharding(self.mesh, P("data"))
+                sh2 = NamedSharding(self.mesh, P("data", None))
+                sh3 = NamedSharding(self.mesh, P("data", None, None))
+                stat_shard = {"n_err": sh2, "confusion": sh3,
+                              "max_err_sum": sh1}
+            else:
+                stat_shard = {"n_err": rep, "confusion": rep,
+                              "max_err_sum": rep}
+            mshard = dict(stat_shard)
+            mshard.update({"loss": rep, "output": oshard,
+                           "max_idx": ishard, "acc": dict(stat_shard)})
+            if final:
+                mshard["acc_reduced"] = {"n_err": rep, "confusion": rep,
+                                         "max_err_sum": rep}
             fn = jax.jit(window_fn, donate_argnums=(0, 1, 9),
                          out_shardings=(self._pshard, self._sshard, rep,
                                         mshard))
@@ -1472,27 +1634,42 @@ class FusedNet:
 
     # -- device-resident epoch accumulators ---------------------------------
     def _window_acc(self):
-        """The running decision-aggregate accumulator (device arrays,
-        replicated over the mesh), created as zeros on the first window
-        after a :meth:`reset_window_acc`.  Carried INTO every window
-        executable as a donated argument and OUT under ``stats["acc"]``
-        — the async control plane's one readback per segment."""
+        """The running decision-aggregate accumulator (device arrays),
+        created as zeros on the first window after a
+        :meth:`reset_window_acc`.  Carried INTO every window executable
+        as a donated argument and OUT under ``stats["acc"]`` — the async
+        control plane's one readback per segment.
+
+        Data-parallel mesh: the leaves keep a leading ``data_shards``
+        axis and live SHARDED ``P("data", ...)`` — each shard
+        accumulates its local batch rows' partials with no collective
+        until the segment-final window's one all-reduce."""
         if self._win_acc is not None:
             return self._win_acc
         out_dtype = jnp.float32 if self.compute_dtype is not None \
             else self.dtype
+        lead = (self._dp,) if self._dp > 1 else ()
         if self.objective == "mse":
-            acc = {"metrics": numpy.array([0.0, 0.0, numpy.inf],
-                                          dtype=out_dtype),
-                   "n_err": numpy.zeros(2, numpy.int32)}
+            metrics = numpy.zeros(lead + (3,), dtype=out_dtype)
+            metrics[..., 2] = numpy.inf
+            acc = {"metrics": metrics,
+                   "n_err": numpy.zeros(lead + (2,), numpy.int32)}
         else:
             n_classes = int(self.specs[-1].n_out)
-            acc = {"n_err": numpy.zeros(2, numpy.int32),
-                   "confusion": numpy.zeros((n_classes, n_classes),
-                                            numpy.int32),
-                   "max_err_sum": numpy.zeros((), out_dtype)}
-        rep = None if self.mesh is None else NamedSharding(self.mesh, P())
-        self._win_acc = {k: jax.device_put(v, rep)
+            acc = {"n_err": numpy.zeros(lead + (2,), numpy.int32),
+                   "confusion": numpy.zeros(
+                       lead + (n_classes, n_classes), numpy.int32),
+                   "max_err_sum": numpy.zeros(lead, out_dtype)}
+        if self.mesh is None:
+            shard = {k: None for k in acc}
+        elif self._dp > 1:
+            shard = {k: NamedSharding(
+                self.mesh, P("data", *([None] * (v.ndim - 1))))
+                for k, v in acc.items()}
+        else:
+            rep = NamedSharding(self.mesh, P())
+            shard = {k: rep for k in acc}
+        self._win_acc = {k: jax.device_put(v, shard[k])
                          for k, v in acc.items()}
         return self._win_acc
 
@@ -1509,39 +1686,98 @@ class FusedNet:
 
     def _place_window(self, arr, tail_dims):
         """Device-put a (K, batch, ...) stacked window input: scan dim
-        unsharded, batch dim over ``data``."""
+        unsharded, batch dim over ``data``.  A :class:`ShardMajorWindow`
+        (the trainer's shard-aligned staging layout) is assembled from
+        its per-shard contiguous blocks — each device receives one
+        memcpy'able block instead of a strided split of the batch-major
+        stack."""
+        if isinstance(arr, ShardMajorWindow):
+            return self._place_window_shard_major(arr.base, tail_dims)
         if self.mesh is None:
             return jax.device_put(arr)
         return jax.device_put(arr, NamedSharding(
             self.mesh, P(None, "data", *([None] * tail_dims))))
 
-    def _check_window_batch(self, batch):
-        if self.mesh is not None and batch % self.mesh.shape["data"]:
-            raise ValueError("batch %d not divisible by data-parallel %d"
-                             % (batch, self.mesh.shape["data"]))
+    def _place_window_shard_major(self, base, tail_dims):
+        """Build the global sharded (K, B, ...) window array from a
+        shard-major host base ``(S, K, B // S, ...)``: every addressable
+        device gets its data shard's contiguous block via one
+        ``device_put`` and the global array is assembled without a host
+        restack (``jax.make_array_from_single_device_arrays``)."""
+        if self.mesh is None or self._dp == 1:
+            raise ValueError("shard-major staging needs a data mesh")
+        dp, k, b = base.shape[:3]
+        if dp != self._dp:
+            raise ValueError("staging shards %d != mesh data shards %d"
+                             % (dp, self._dp))
+        gshape = (k, dp * b) + tuple(base.shape[3:])
+        ns = NamedSharding(self.mesh,
+                           P(None, "data", *([None] * tail_dims)))
+        bufs = []
+        for dev, idx in ns.addressable_devices_indices_map(
+                gshape).items():
+            start = idx[1].start or 0
+            bufs.append(jax.device_put(base[start // b], dev))
+        return jax.make_array_from_single_device_arrays(gshape, ns, bufs)
 
-    def run_window(self, xs, labels_s, batch_sizes, hypers_s):
+    def _place_window_scalars(self, batch_sizes, hypers_s):
+        """Commit the per-step (K,) scalar rails — batch sizes and the
+        stacked hyper pytree — REPLICATED on the mesh.  Left unpinned,
+        GSPMD is free to shard a (K,) rail over ``data`` whenever K is
+        divisible by the shard count, which both serializes the scan's
+        per-step reads behind collectives and trips the installed
+        jaxlib's s64/s32 dynamic-slice partitioner bug under x64."""
+        bs = numpy.asarray(batch_sizes, dtype=numpy.int32)
+        if self.mesh is None:
+            return jnp.asarray(bs), hypers_s
+        rep = NamedSharding(self.mesh, P())
+        if self._dp > 1 and jax.tree.leaves(hypers_s):
+            first = jax.tree.leaves(hypers_s)[0]
+            if not isinstance(first, jax.Array):
+                hypers_s = jax.device_put(hypers_s, rep)
+        return jax.device_put(bs, rep), hypers_s
+
+    def _check_window_batch(self, batch):
+        if self.mesh is not None:
+            mesh_mod.check_data_batch(self.mesh, batch)
+
+    def _cost_name(self, kind, n_steps, final):
+        name = "fused.window.%s.k%d" % (kind, n_steps)
+        if final and self._dp > 1:
+            # the segment-final variant is a DISTINCT executable (it
+            # folds the per-segment stats all-reduce) — keep the cost
+            # registry 1:1 with compiled programs
+            name += ".final"
+        return name
+
+    def run_window(self, xs, labels_s, batch_sizes, hypers_s,
+                   final=False):
         """K train steps in ONE compiled dispatch over host-stacked
         minibatches ``xs (K, B, *sample)`` / ``labels_s (K, B)``.
         ``batch_sizes (K,)`` masks padded tail minibatches exactly like
         the per-minibatch evaluator; ``hypers_s`` is the hyper pytree
         with a leading K axis (policy(k) applies to step k — LR-schedule
         step accuracy inside the window).  Returns the aggregated window
-        stats (see _get_window_fn)."""
+        stats (see _get_window_fn).  ``final`` marks the segment-final
+        window (under a data mesh it selects the executable variant
+        that folds the per-segment stats all-reduce); ``xs``/``labels_s``
+        may be :class:`ShardMajorWindow` staging views."""
         if self.objective != "softmax":
             raise ValueError("run_window supports the softmax objective")
         self._check_window_batch(xs.shape[1])
         n_steps = xs.shape[0]
-        fn = self._get_window_fn(n_steps, "stacked")
-        xs = self._place_window(
-            numpy.ascontiguousarray(xs), xs.ndim - 2)
-        labels_s = self._place_window(
-            numpy.asarray(labels_s, dtype=numpy.int32), 0)
-        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        fn = self._get_window_fn(n_steps, "stacked", final=final)
+        if not isinstance(xs, ShardMajorWindow):
+            xs = numpy.ascontiguousarray(xs)
+        xs = self._place_window(xs, xs.ndim - 2)
+        if not isinstance(labels_s, ShardMajorWindow):
+            labels_s = numpy.asarray(labels_s, dtype=numpy.int32)
+        labels_s = self._place_window(labels_s, 0)
+        bs, hypers_s = self._place_window_scalars(batch_sizes, hypers_s)
         acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
-                "fused.window.stacked.k%d" % n_steps, fn,
+                self._cost_name("stacked", n_steps, final), fn,
                 (self.params, self.state, self._key, 0, 0, xs, labels_s,
                  bs, hypers_s, acc),
                 steps=n_steps, batch=xs.shape[1])
@@ -1551,7 +1787,8 @@ class FusedNet:
         self._win_acc = stats["acc"]
         return stats
 
-    def run_window_indexed(self, idx_s, batch_sizes, hypers_s):
+    def run_window_indexed(self, idx_s, batch_sizes, hypers_s,
+                           final=False):
         """Windowed training over the device-resident dataset
         (:meth:`set_dataset`): ``idx_s (K, B)`` dataset row indices
         (-1 = padded tail slot).  Only the indices cross the host/device
@@ -1560,14 +1797,15 @@ class FusedNet:
             raise RuntimeError("set_dataset() before run_window_indexed")
         self._check_window_batch(idx_s.shape[1])
         n_steps = idx_s.shape[0]
-        fn = self._get_window_fn(n_steps, "indexed")
-        idx_s = self._place_window(
-            numpy.asarray(idx_s, dtype=numpy.int32), 0)
-        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        fn = self._get_window_fn(n_steps, "indexed", final=final)
+        if not isinstance(idx_s, ShardMajorWindow):
+            idx_s = numpy.asarray(idx_s, dtype=numpy.int32)
+        idx_s = self._place_window(idx_s, 0)
+        bs, hypers_s = self._place_window_scalars(batch_sizes, hypers_s)
         acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
-                "fused.window.indexed.k%d" % n_steps, fn,
+                self._cost_name("indexed", n_steps, final), fn,
                 (self.params, self.state, self._key, self._data_d,
                  self._labels_d, idx_s, None, bs, hypers_s, acc),
                 steps=n_steps, batch=idx_s.shape[1])
@@ -1577,7 +1815,8 @@ class FusedNet:
         self._win_acc = stats["acc"]
         return stats
 
-    def run_window_sliced(self, starts, batch, batch_sizes, hypers_s):
+    def run_window_sliced(self, starts, batch, batch_sizes, hypers_s,
+                          final=False):
         """Windowed training over the epoch-materialized permuted
         dataset (:meth:`set_epoch_perm`): ``starts (K,)`` are the
         minibatches' row offsets into the epoch order (the loader's
@@ -1589,15 +1828,16 @@ class FusedNet:
             raise RuntimeError("set_epoch_perm() before run_window_sliced")
         self._check_window_batch(batch)
         n_steps = len(starts)
-        fn = self._get_window_fn(n_steps, "sliced", int(batch))
+        fn = self._get_window_fn(n_steps, "sliced", int(batch),
+                                 final=final)
         rep = None if self.mesh is None else NamedSharding(self.mesh, P())
         starts = jax.device_put(
             numpy.asarray(starts, dtype=numpy.int32), rep)
-        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        bs, hypers_s = self._place_window_scalars(batch_sizes, hypers_s)
         acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
-                "fused.window.sliced.k%d" % n_steps, fn,
+                self._cost_name("sliced", n_steps, final), fn,
                 (self.params, self.state, self._key, self._data_p,
                  self._labels_p, starts, None, bs, hypers_s, acc),
                 steps=n_steps, batch=batch)
@@ -1608,7 +1848,7 @@ class FusedNet:
         return stats
 
     # -- windowed MSE (the AE/regression hot loop) --------------------------
-    def _get_window_fn_mse(self, n_steps, mode, batch=None):
+    def _get_window_fn_mse(self, n_steps, mode, batch=None, final=False):
         """K-step MSE scan window (reference evaluator contract:
         /root/reference/evaluator.py:334-556).  Carry aggregates the
         evaluator-identical metrics ([sum, max, min] of per-sample mse,
@@ -1618,14 +1858,26 @@ class FusedNet:
         output and per-sample mse come back for the downstream units.
 
         ``mode``: "stacked" or "sliced" (MSE has no indexed-gather
-        variant; non-contiguous loaders use the host-stacked window)."""
+        variant; non-contiguous loaders use the host-stacked window).
+
+        Data-parallel mesh: same sharded-partial discipline as
+        :meth:`_get_window_fn` — metrics/n_err keep a leading shard
+        axis, ``final=True`` folds the per-segment all-reduce into the
+        executable (``stats["acc_reduced"]``).  The mse SUM partial is
+        f32-reassociated across shards (per-shard sums, then one
+        cross-shard sum) — the ONE documented reduction-order deviation
+        from the single-device fold (MESH_MSE_SUM; max/min and the
+        integer n_err stay exact)."""
+        dp = self._dp
+        final = bool(final) and dp > 1
         ct = self.class_targets
-        key_ = ("mse", int(n_steps), mode, batch, ct is not None)
+        key_ = ("mse", int(n_steps), mode, batch, ct is not None, final)
         fn = self._window_fns.get(key_)
         if fn is not None:
             return fn
         specs = tuple(self.specs)
         cd = self.compute_dtype
+        mesh = self.mesh
         needs_key = self._needs_key
         root = bool(self.mse_root)
         mean = bool(self.stats_mean)
@@ -1640,7 +1892,8 @@ class FusedNet:
             parity has one source of truth — plus the optional
             nearest-class-target error (the evaluator's host loop:
             squared distance summed over the sample axis, argmin vs
-            label)."""
+            label).  Under a data mesh the reductions run per shard
+            (leading ``dp`` axis, see _eval_stats)."""
             from znicz_tpu.ops import evaluator as ev_ops
             out = out.astype(out_dtype)
             B = out.shape[0]
@@ -1648,18 +1901,37 @@ class FusedNet:
             t2 = target.reshape(B, -1).astype(out_dtype)
             _, md, mse_per = ev_ops.mse_jax(o2, t2, bs, mean=mean,
                                             root=root)
+            in_batch = jnp.arange(B) < bs
+            if dp > 1:
+                b = B // dp
+                m2 = mse_per.reshape(dp, b)
+                md = jnp.stack(
+                    [m2.sum(axis=1), m2.max(axis=1),
+                     jnp.where(in_batch.reshape(dp, b), m2,
+                               jnp.inf).min(axis=1)], axis=-1)
             if ct_c is None:
-                nerr_d = jnp.zeros((2,), jnp.int32)
+                lead = (dp,) if dp > 1 else ()
+                nerr_d = jnp.zeros(lead + (2,), jnp.int32)
             else:
-                in_batch = jnp.arange(B) < bs
                 d = ((ct_c[None, :, :] - o2[:, None, :]) ** 2).sum(-1)
                 pred = jnp.argmin(d, axis=1).astype(jnp.int32)
-                n_ok = (in_batch & (pred == lbl)).sum()
-                nerr_d = jnp.stack([bs - n_ok, bs]).astype(jnp.int32)
+                if dp > 1:
+                    b = B // dp
+                    cnt = in_batch.reshape(dp, b).sum(axis=1)
+                    n_ok = (in_batch & (pred == lbl)).reshape(
+                        dp, b).sum(axis=1)
+                    nerr_d = jnp.stack([cnt - n_ok, cnt],
+                                       axis=-1).astype(jnp.int32)
+                else:
+                    n_ok = (in_batch & (pred == lbl)).sum()
+                    nerr_d = jnp.stack([bs - n_ok, bs]).astype(jnp.int32)
             return md, mse_per, nerr_d, out
 
         def body(carry, step):
-            p, s, k, _, _, msum, mmax, mmin, nerr = carry
+            if dp > 1:
+                p, s, k, _, _, msum, mmax, mmin, nerr, i, lbuf = carry
+            else:
+                p, s, k, _, _, msum, mmax, mmin, nerr = carry
             if mode == "sliced":
                 data, tgt_all, lbl_all, start, bs, hy = step
                 x = jax.lax.dynamic_slice_in_dim(data, start, batch,
@@ -1671,26 +1943,45 @@ class FusedNet:
                                 jnp.int32(-1))
             else:
                 x, t, lbl, bs, hy = step
+            if dp > 1:
+                # pin the minibatch to the data axis (see _get_window_fn)
+                x = jax.lax.with_sharding_constraint(x, NamedSharding(
+                    mesh, P("data", *([None] * (x.ndim - 1)))))
+                t = jax.lax.with_sharding_constraint(t, NamedSharding(
+                    mesh, P("data", *([None] * (t.ndim - 1)))))
+                lbl = jax.lax.with_sharding_constraint(
+                    lbl, NamedSharding(mesh, P("data")))
             if needs_key:
                 k, sub = jax.random.split(k)
             else:
                 sub = k
             p, s, m = _train_step_mse(p, s, x, t, bs, specs, sub, cd, hy)
             md, mse_per, nerr_d, out = _stats(m["output"], t, lbl, bs)
-            carry = (p, s, k, out, mse_per,
-                     msum + md[0], jnp.maximum(mmax, md[1]),
-                     jnp.minimum(mmin, md[2]), nerr + nerr_d)
+            stats_c = (msum + md[..., 0], jnp.maximum(mmax, md[..., 1]),
+                       jnp.minimum(mmin, md[..., 2]), nerr + nerr_d)
+            if dp > 1:
+                # carried one-hot loss accumulation — see _get_window_fn
+                # (the scan ys dynamic-update-slice trips the jaxlib
+                # partitioner when K divides by the shard count)
+                loss = jax.lax.with_sharding_constraint(
+                    m["loss"], NamedSharding(mesh, P()))
+                lbuf = lbuf + loss.astype(lbuf.dtype) * \
+                    jax.nn.one_hot(i, lbuf.shape[0], dtype=lbuf.dtype)
+                carry = (p, s, k, out, mse_per) + stats_c + (i + 1, lbuf)
+                return carry, None
+            carry = (p, s, k, out, mse_per) + stats_c
             return carry, m["loss"]
 
         def window_fn(p, s, k, data, tgt_all, lbl_all, xs, ts, ls,
                       bs_s, hy_s, acc):
             b = batch if mode == "sliced" else xs.shape[1]
+            lead = (dp,) if dp > 1 else ()
             out0 = jnp.zeros((b,) + out_shape, dtype=out_dtype)
             mse0 = jnp.zeros((b,), dtype=out_dtype)
-            msum0 = jnp.zeros((), dtype=out_dtype)
-            mmax0 = jnp.zeros((), dtype=out_dtype)
-            mmin0 = jnp.full((), jnp.inf, dtype=out_dtype)
-            nerr0 = jnp.zeros((2,), dtype=jnp.int32)
+            msum0 = jnp.zeros(lead, dtype=out_dtype)
+            mmax0 = jnp.zeros(lead, dtype=out_dtype)
+            mmin0 = jnp.full(lead, jnp.inf, dtype=out_dtype)
+            nerr0 = jnp.zeros(lead + (2,), dtype=jnp.int32)
             if mode == "sliced":
                 def scan_body(carry, step):
                     start, bs, hy = step
@@ -1701,31 +1992,59 @@ class FusedNet:
                 xs_scan = (xs, ts, ls, bs_s, hy_s)
                 scan_body = body
             carry0 = (p, s, k, out0, mse0, msum0, mmax0, mmin0, nerr0)
-            (p, s, k, out, mse_per, msum, mmax, mmin, nerr), losses = \
-                jax.lax.scan(scan_body, carry0, xs_scan)
+            if dp > 1:
+                carry0 = carry0 + (jnp.int32(0),
+                                   jnp.zeros((n_steps,), dtype=out_dtype))
+                carry1, _ = jax.lax.scan(scan_body, carry0, xs_scan)
+                (p, s, k, out, mse_per, msum, mmax, mmin,
+                 nerr) = carry1[:9]
+                losses = carry1[10]
+            else:
+                (p, s, k, out, mse_per, msum, mmax, mmin, nerr), \
+                    losses = jax.lax.scan(scan_body, carry0, xs_scan)
             # epoch-accumulator fold — the exact op sequence of the
             # synchronous host fold (window sum computed in-scan from
             # zero, THEN one add onto the running total), so the async
-            # segment aggregate is bit-identical (see _get_window_fn)
+            # segment aggregate is bit-identical (see _get_window_fn);
+            # under a data mesh the fold stays per-shard (axis -1 keeps
+            # the leading shard axis) with no collective
             acc = {"metrics": jnp.stack(
-                       [acc["metrics"][0] + msum,
-                        jnp.maximum(acc["metrics"][1], mmax),
-                        jnp.minimum(acc["metrics"][2], mmin)]),
+                       [acc["metrics"][..., 0] + msum,
+                        jnp.maximum(acc["metrics"][..., 1], mmax),
+                        jnp.minimum(acc["metrics"][..., 2], mmin)],
+                       axis=-1),
                    "n_err": acc["n_err"] + nerr}
             stats = {"loss": losses,
-                     "metrics": jnp.stack([msum, mmax, mmin]),
+                     "metrics": jnp.stack([msum, mmax, mmin], axis=-1),
                      "mse_per": mse_per, "n_err": nerr, "output": out,
                      "acc": acc}
+            if final:
+                # the segment's ONE stats all-reduce (the mse SUM is the
+                # documented f32 reassociation — max/min/integers exact)
+                stats["acc_reduced"] = {
+                    "metrics": jnp.stack(
+                        [acc["metrics"][:, 0].sum(),
+                         acc["metrics"][:, 1].max(),
+                         acc["metrics"][:, 2].min()]),
+                    "n_err": acc["n_err"].sum(axis=0)}
             return p, s, k, stats
 
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             oshard = NamedSharding(
                 self.mesh, P("data", *([None] * len(out_shape))))
-            mshard = {"loss": rep, "metrics": rep, "n_err": rep,
-                      "mse_per": NamedSharding(self.mesh, P("data")),
-                      "output": oshard,
-                      "acc": {"metrics": rep, "n_err": rep}}
+            if dp > 1:
+                sh2 = NamedSharding(self.mesh, P("data", None))
+                stat_shard = {"metrics": sh2, "n_err": sh2}
+            else:
+                stat_shard = {"metrics": rep, "n_err": rep}
+            mshard = dict(stat_shard)
+            mshard.update({"loss": rep,
+                           "mse_per": NamedSharding(self.mesh, P("data")),
+                           "output": oshard,
+                           "acc": dict(stat_shard)})
+            if final:
+                mshard["acc_reduced"] = {"metrics": rep, "n_err": rep}
             fn = jax.jit(window_fn, donate_argnums=(0, 1, 11),
                          out_shardings=(self._pshard, self._sshard, rep,
                                         mshard))
@@ -1734,7 +2053,8 @@ class FusedNet:
         self._window_fns[key_] = fn
         return fn
 
-    def run_window_mse(self, xs, ts, lbl_s, batch_sizes, hypers_s):
+    def run_window_mse(self, xs, ts, lbl_s, batch_sizes, hypers_s,
+                       final=False):
         """K MSE train steps in ONE compiled dispatch over host-stacked
         minibatches ``xs (K, B, *sample)`` / ``ts (K, B, *target)``;
         ``lbl_s (K, B)`` feeds the nearest-class-target error when
@@ -1743,16 +2063,21 @@ class FusedNet:
             raise ValueError("run_window_mse needs the mse objective")
         self._check_window_batch(xs.shape[1])
         n_steps = xs.shape[0]
-        fn = self._get_window_fn_mse(n_steps, "stacked")
-        xs = self._place_window(numpy.ascontiguousarray(xs), xs.ndim - 2)
-        ts = self._place_window(numpy.ascontiguousarray(ts), ts.ndim - 2)
-        lbl_s = self._place_window(
-            numpy.asarray(lbl_s, dtype=numpy.int32), 0)
-        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        fn = self._get_window_fn_mse(n_steps, "stacked", final=final)
+        if not isinstance(xs, ShardMajorWindow):
+            xs = numpy.ascontiguousarray(xs)
+        xs = self._place_window(xs, xs.ndim - 2)
+        if not isinstance(ts, ShardMajorWindow):
+            ts = numpy.ascontiguousarray(ts)
+        ts = self._place_window(ts, ts.ndim - 2)
+        if not isinstance(lbl_s, ShardMajorWindow):
+            lbl_s = numpy.asarray(lbl_s, dtype=numpy.int32)
+        lbl_s = self._place_window(lbl_s, 0)
+        bs, hypers_s = self._place_window_scalars(batch_sizes, hypers_s)
         acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
-                "fused.window.mse.k%d" % n_steps, fn,
+                self._cost_name("mse", n_steps, final), fn,
                 (self.params, self.state, self._key, 0, 0, 0, xs, ts,
                  lbl_s, bs, hypers_s, acc),
                 steps=n_steps, batch=xs.shape[1])
@@ -1762,7 +2087,8 @@ class FusedNet:
         self._win_acc = stats["acc"]
         return stats
 
-    def run_window_mse_sliced(self, starts, batch, batch_sizes, hypers_s):
+    def run_window_mse_sliced(self, starts, batch, batch_sizes, hypers_s,
+                              final=False):
         """Windowed MSE training over the epoch-materialized dataset —
         the sliced production path (see :meth:`run_window_sliced`);
         needs targets passed to :meth:`set_dataset`."""
@@ -1774,15 +2100,16 @@ class FusedNet:
                                "run_window_mse_sliced")
         self._check_window_batch(batch)
         n_steps = len(starts)
-        fn = self._get_window_fn_mse(n_steps, "sliced", int(batch))
+        fn = self._get_window_fn_mse(n_steps, "sliced", int(batch),
+                                     final=final)
         rep = None if self.mesh is None else NamedSharding(self.mesh, P())
         starts = jax.device_put(
             numpy.asarray(starts, dtype=numpy.int32), rep)
-        bs = jnp.asarray(numpy.asarray(batch_sizes, dtype=numpy.int32))
+        bs, hypers_s = self._place_window_scalars(batch_sizes, hypers_s)
         acc = self._window_acc()
         if profiler.enabled():
             self._register_cost(
-                "fused.window.mse_sliced.k%d" % n_steps, fn,
+                self._cost_name("mse_sliced", n_steps, final), fn,
                 (self.params, self.state, self._key, self._data_p,
                  self._targets_p, self._labels_p, starts, None, None,
                  bs, hypers_s, acc),

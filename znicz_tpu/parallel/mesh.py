@@ -36,3 +36,14 @@ def data_parallel_size(mesh):
 
 def model_parallel_size(mesh):
     return mesh.shape["model"] if mesh is not None else 1
+
+
+def check_data_batch(mesh, batch):
+    """Loud divisibility contract of every batch-sharded entry point:
+    a global batch must split evenly over the mesh's ``data`` axis
+    (jagged shards would silently change the per-step math).  No-op
+    without a mesh."""
+    dsize = data_parallel_size(mesh)
+    if batch % dsize:
+        raise ValueError("batch %d not divisible by data-parallel %d"
+                         % (batch, dsize))

@@ -70,10 +70,26 @@ class _StagingRing(object):
         self.depth = max(1, int(depth))
         self._slots = {}   # key -> [[buffers...], next_turn]
 
-    def get(self, key, shape, dtype):
+    def get(self, key, shape, dtype, shards=1):
         """The next staging buffer for ``key`` (allocated on first use
-        or when the window geometry changed)."""
+        or when the window geometry changed).
+
+        ``shards > 1`` (a data-parallel mesh): the logical
+        ``(K, B, ...)`` window is allocated SHARD-MAJOR as
+        ``(shards, K, B // shards, ...)`` so each data shard's rows are
+        one contiguous host block — ``FusedNet._place_window`` feeds
+        ``device_put`` per-shard memcpys instead of strided splits.
+        The trainer writes minibatch ``i`` through the ``base[:, i]``
+        view (``Loader.fill_window_slot`` reshapes its source to the
+        destination layout)."""
         shape = tuple(int(s) for s in shape)
+        if shards > 1:
+            k, b = shape[0], shape[1]
+            if b % shards:
+                raise ValueError(
+                    "window batch %d not divisible by %d data shards"
+                    % (b, shards))
+            shape = (shards, k, b // shards) + shape[2:]
         slot = self._slots.get(key)
         if slot is None or slot[0][0].shape != shape or \
                 slot[0][0].dtype != numpy.dtype(dtype):
@@ -99,6 +115,12 @@ class GDProxy(object):
                    "gd_alpha", "gd_beta")
 
     def __init__(self, name, hyper, hyper_bias):
+        #: bumped by every STATE_ATTRS assignment (schedules, rollback,
+        #: state restore) — the trainer's hyper-collection cache key:
+        #: unchanged serials mean the per-step hyper pytree (and its
+        #: stacked window form) can be reused instead of rebuilt per
+        #: minibatch (the r6 small-model host-path fix, BENCH_NOTES.md)
+        self.serial = 0
         self.name = name
         self.gate_skip = Bool(False)
         self.learning_rate = hyper["lr"]
@@ -114,6 +136,18 @@ class GDProxy(object):
         self.acc_beta = hyper["acc_beta"]
         self.gd_alpha = hyper["gd_alpha"]
         self.gd_beta = hyper["gd_beta"]
+
+    def __setattr__(self, name, value):
+        if name in self.STATE_ATTRS:
+            # a hyper MUTATION (schedule tick, rollback, restore)
+            # invalidates the trainer's collected-hypers cache.  Value
+            # compare, not assignment count: LR adjusters re-assign the
+            # same value every train minibatch (lr_adjust.run), which
+            # must not defeat the cache.
+            if getattr(self, name, None) != value:
+                object.__setattr__(self, "serial",
+                                   getattr(self, "serial", 0) + 1)
+        object.__setattr__(self, name, value)
 
     def hyper_dicts(self):
         """(hyper, hyper_bias) in gd_math.update vocabulary — rebuilt from
@@ -222,6 +256,14 @@ class FusedForwardBackward(Unit):
         #: mid-epoch window, oldest first)
         self._inflight = collections.deque()
         self._staging = _StagingRing(self.pipeline_depth + 1)
+        #: hyper-collection cache (GDProxy.serial keyed): the per-step
+        #: hyper pytree and its stacked (K-leading-axis) window form are
+        #: rebuilt ONLY when a proxy attribute actually changed — with
+        #: no schedule running this removes the per-minibatch dict
+        #: rebuild + per-window restack from the host path entirely
+        self._hyper_serials = None
+        self._hyper_cache = None
+        self._hyper_stacked = {}
         #: the loader unit driven directly during window collection
         #: (wired by StandardWorkflow.link_fused_trainer)
         self.loader_unit = None
@@ -346,6 +388,14 @@ class FusedForwardBackward(Unit):
                 self.net.class_targets = mem.reshape(mem.shape[0], -1)
         self._setup_device_data()
         self._refresh_weight_views()
+        if telemetry.enabled() and self.net.mesh is not None:
+            # mesh-aware observability: every counter the async control
+            # plane exports (readbacks, inflight, d2h bytes) can be read
+            # per shard against these gauges (telemetry.summary())
+            telemetry.gauge("trainer.data_shards").set(
+                self.net.data_shards)
+            telemetry.gauge("trainer.model_shards").set(
+                int(self.net.mesh.shape["model"]))
         batch = int(self.input.shape[0])
         out_shape = (batch,) + tuple(self.net.specs[-1].out_shape)
         self.output.reset(numpy.zeros(out_shape, dtype=dtype))
@@ -553,45 +603,59 @@ class FusedForwardBackward(Unit):
                     pad=int(loader.max_minibatch_size))
                 self._mat_serial = loader.shuffle_serial
         batch = int(self.input.shape[0])
+        dp = self.net.data_shards
         starts, sizes, hyper_steps = [], [], []
         stage_x = stage_l = stage_t = stage_idx = None
+
+        def _row(stage, i):
+            # shard-major staging keeps the step axis SECOND: minibatch
+            # i's rows are the (S, B // S, ...) cross-shard view
+            return stage[:, i] if dp > 1 else stage[i]
+
+        def _win(stage, n):
+            if dp > 1:
+                return fused.ShardMajorWindow(stage[:, :n])
+            return stage[:n]
+
         if self._use_device_data and not self._use_sliced:
             stage_idx = self._staging.get(
-                "idx", (self.window, batch), numpy.int32)
+                "idx", (self.window, batch), numpy.int32, shards=dp)
         elif not self._use_device_data:
             # overlap-aware collection: each minibatch lands straight in
             # its staging row (ONE copy; the old per-step numpy.array +
             # numpy.stack paid two).  The ring rotates pipeline_depth+1
             # buffer sets so dispatched windows never see a reused row.
+            # Under a data mesh the buffers are SHARD-MAJOR (one
+            # contiguous block per shard) so device_put splits nothing.
             stage_x = self._staging.get(
                 "x", (self.window,) + tuple(self.input.shape),
-                self.input.dtype)
+                self.input.dtype, shards=dp)
             stage_l = self._staging.get(
-                "lbl", (self.window, batch), numpy.int32)
+                "lbl", (self.window, batch), numpy.int32, shards=dp)
             if self.loss == "mse":
                 stage_t = self._staging.get(
                     "tgt", (self.window,) + tuple(self.target.shape),
-                    self.target.dtype)
+                    self.target.dtype, shards=dp)
         while True:
             i = len(sizes)
             if self._use_device_data and self._use_sliced:
                 starts.append(int(loader.minibatch_class_offset))
             elif self._use_device_data:
-                loader.fill_window_slot(indices_out=stage_idx[i])
+                loader.fill_window_slot(indices_out=_row(stage_idx, i))
             elif self.loss == "mse":
                 lbls = getattr(loader, "minibatch_labels", None)
                 want_lbl = self.net.class_targets is not None and lbls
                 loader.fill_window_slot(
-                    x_out=stage_x[i],
-                    labels_out=stage_l[i] if want_lbl else None,
-                    targets_out=stage_t[i])
+                    x_out=_row(stage_x, i),
+                    labels_out=_row(stage_l, i) if want_lbl else None,
+                    targets_out=_row(stage_t, i))
                 if not want_lbl:
-                    stage_l[i][...] = -1
+                    _row(stage_l, i)[...] = -1
             else:
-                loader.fill_window_slot(x_out=stage_x[i],
-                                        labels_out=stage_l[i])
+                loader.fill_window_slot(x_out=_row(stage_x, i),
+                                        labels_out=_row(stage_l, i))
             sizes.append(int(self.minibatch_size))
-            hyper_steps.append(self._collect_hypers())
+            hyper_steps.append(self._current_hypers())
             n = len(sizes)
             if n >= self.window or bool(loader.last_minibatch):
                 break
@@ -601,35 +665,56 @@ class FusedForwardBackward(Unit):
         # stack per-step hypers along a leading K axis; cast to the
         # master param dtype (a float64 leaf would promote the f32
         # optimizer state inside the scan — the per-minibatch path's
-        # python-float hypers are weakly typed and never promote)
-        hypers_s = jax.tree.map(
-            lambda *leaves: numpy.asarray(leaves, dtype=self.net.dtype),
-            *hyper_steps)
+        # python-float hypers are weakly typed and never promote).
+        # All-same windows (no schedule ticked mid-window — the common
+        # case) reuse the cached stacked pytree instead of restacking.
+        if all(h is hyper_steps[0] for h in hyper_steps):
+            hypers_s = self._hyper_stacked.get(n)
+            if hypers_s is None:
+                hypers_s = jax.tree.map(
+                    lambda *leaves: numpy.asarray(
+                        leaves, dtype=self.net.dtype), *hyper_steps)
+                self._hyper_stacked[n] = hypers_s
+        else:
+            hypers_s = jax.tree.map(
+                lambda *leaves: numpy.asarray(leaves,
+                                              dtype=self.net.dtype),
+                *hyper_steps)
         if probe is not None:
             probe.collected()
+        # segment-final windows are known BEFORE dispatch (collection
+        # stopped at last_minibatch) — under a data mesh the final
+        # window selects the executable variant that folds the
+        # per-segment stats all-reduce (fused._get_window_fn).  Sync
+        # mode reads per-window sharded partials and host-folds them
+        # instead, so it never compiles (or pays) the final variant.
+        pull_output = bool(loader.last_minibatch)
+        dispatch_final = pull_output and self.async_windows
         if self._use_device_data:
             if self.loss == "mse":
                 stats = self.net.run_window_mse_sliced(
-                    starts, batch, sizes, hypers_s)
+                    starts, batch, sizes, hypers_s, final=dispatch_final)
             elif self._use_sliced:
                 stats = self.net.run_window_sliced(
-                    starts, batch, sizes, hypers_s)
+                    starts, batch, sizes, hypers_s, final=dispatch_final)
             else:
                 stats = self.net.run_window_indexed(
-                    stage_idx[:n], sizes, hypers_s)
+                    _win(stage_idx, n), sizes, hypers_s,
+                    final=dispatch_final)
         elif self.loss == "mse":
             stats = self.net.run_window_mse(
-                stage_x[:n], stage_t[:n], stage_l[:n], sizes, hypers_s)
+                _win(stage_x, n), _win(stage_t, n), _win(stage_l, n),
+                sizes, hypers_s, final=dispatch_final)
         else:
             stats = self.net.run_window(
-                stage_x[:n], stage_l[:n], sizes, hypers_s)
+                _win(stage_x, n), _win(stage_l, n), sizes, hypers_s,
+                final=dispatch_final)
         if probe is not None:
             # blocks on the window's result tree: the wait IS the
             # device-compute share of this window's wall time (the
             # armed profiler's documented per-window sync — it drains
             # the async pipeline by construction)
             probe.dispatched(stats)
-        pull_output = bool(loader.last_minibatch)
         if self.async_windows and not pull_output:
             # asynchronous steady state: ZERO host readback — this
             # window's aggregates were folded into the device-resident
@@ -671,7 +756,15 @@ class FusedForwardBackward(Unit):
         # boundaries.  Sync mode (async_windows=False) keeps the
         # reference per-window delta readback.
         use_acc = self.async_windows
-        acc = self.net.window_acc
+        # under a data mesh the segment-final executable already folded
+        # the one per-segment all-reduce — read the replicated totals;
+        # the sync mode's per-window deltas stay SHARDED partials (no
+        # device collective) and are reduced on host after the fetch
+        if use_acc and dp > 1:
+            acc = stats["acc_reduced"]
+        else:
+            acc = self.net.window_acc
+        reduce_host = dp > 1 and not use_acc
         if self.loss == "mse":
             fetch = {
                 "metrics": acc["metrics"] if use_acc else stats["metrics"],
@@ -680,6 +773,8 @@ class FusedForwardBackward(Unit):
                 fetch["output"] = stats["output"]
                 fetch["mse_per"] = stats["mse_per"]
             host = self.net.host_fetch(fetch)
+            if reduce_host:
+                host = fused.reduce_window_partials(host, "mse")
             self.window_stats = {
                 "metrics": host["metrics"],
                 "n_err": host["n_err"],
@@ -697,6 +792,8 @@ class FusedForwardBackward(Unit):
                 fetch["output"] = stats["output"]
                 fetch["max_idx"] = stats["max_idx"]
             host = self.net.host_fetch(fetch)
+            if reduce_host:
+                host = fused.reduce_window_partials(host, "softmax")
             self.window_stats = {
                 "n_err": host["n_err"],
                 "confusion": host["confusion"],
@@ -721,6 +818,19 @@ class FusedForwardBackward(Unit):
                 self.max_idx.mem[...] = host["max_idx"]
         self._refresh_weight_views()
         return len(sizes)
+
+    def _current_hypers(self):
+        """The live hyper pytree, rebuilt ONLY when a proxy attribute
+        actually changed (GDProxy.serial) — per-minibatch dict churn was
+        a measurable host-path cost on small windows (BENCH_NOTES.md
+        r6).  Returns the SAME object while nothing mutates, which also
+        lets the window path reuse its stacked K-axis form."""
+        s = tuple(p.serial for p in self.gd_proxies)
+        if s != self._hyper_serials:
+            self._hyper_cache = self._collect_hypers()
+            self._hyper_serials = s
+            self._hyper_stacked.clear()
+        return self._hyper_cache
 
     def _collect_hypers(self):
         """Rebuild the traced hyper pytree from the live proxies."""
@@ -759,7 +869,7 @@ class FusedForwardBackward(Unit):
                         probe.collected()
                     metrics = self.net.step_mse(
                         x, self.target.mem, int(self.minibatch_size),
-                        hypers=self._collect_hypers())
+                        hypers=self._current_hypers())
                     if probe is not None:
                         probe.dispatched(metrics)
                     out = metrics["output"]
@@ -773,7 +883,7 @@ class FusedForwardBackward(Unit):
                     if probe is not None:
                         probe.collected()
                     metrics = self.net.step(
-                        x, labels, hypers=self._collect_hypers())
+                        x, labels, hypers=self._current_hypers())
                     if probe is not None:
                         probe.dispatched(metrics)
                     out, idx = metrics["output"], metrics["max_idx"]
