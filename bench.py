@@ -252,6 +252,88 @@ def _roofline_block(prof_snap, peaks, ips, device_kind):
     return out
 
 
+def _fault_tolerance_block():
+    """Measured recovery cost (ISSUE 7): train a small fused wine run
+    that writes mid-epoch ``window_interval`` snapshots, then time the
+    restart path a supervised job actually pays —
+
+    * ``resume_overhead_seconds``: restoring the newest snapshot into a
+      freshly built workflow (pickle read + device placement of params/
+      optimizer/accumulators),
+    * ``restart_to_first_window_seconds``: fresh build + initialize +
+      restore + the first training window dispatched — the wall time
+      from "process back up" to "training again".
+
+    Tracked round over round next to throughput so recovery cost can
+    never silently regress."""
+    import shutil
+    import tempfile
+
+    import znicz_tpu.loader.loader_wine  # noqa: F401 (registry)
+
+    tmp = tempfile.mkdtemp(prefix="bench_ft_")
+    try:
+        return _fault_tolerance_measure(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _fault_tolerance_measure(tmp):
+    from znicz_tpu.core import prng
+    from znicz_tpu.launcher import Launcher
+    from znicz_tpu.standard_workflow import StandardWorkflow
+    from znicz_tpu.units.nn_units import load_snapshot_into_workflow
+
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+         "<-": {"learning_rate": 0.1}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": 0.1}},
+    ]
+
+    def build():
+        prng.get(1).seed(1234)
+        prng.get(2).seed(5678)
+        wf = StandardWorkflow(
+            None, layers=[dict(l) for l in layers],
+            loader_name="wine_loader",
+            loader_config={"minibatch_size": 10},
+            decision_config={"max_epochs": 2, "fail_iterations": 100},
+            snapshotter_config={"prefix": "benchft",
+                                "interval": 10 ** 9,
+                                "time_interval": 1e9, "compression": "",
+                                "directory": tmp,
+                                "window_interval": 2},
+            fused={"window": 4})
+        wf.initialize()
+        return wf
+
+    build().run()  # leaves mid-epoch snapshots behind
+
+    t_restart = time.perf_counter()
+    wf = build()
+    t_restore = time.perf_counter()
+    state = Launcher(auto_resume=True)._find_resume_state(wf)
+    load_snapshot_into_workflow(state, wf)
+    resume_overhead = time.perf_counter() - t_restore
+    first = {}
+    orig_window = wf.fused_trainer._run_train_window
+
+    def hooked():
+        if "t" not in first:
+            first["t"] = time.perf_counter()
+        return orig_window()
+
+    wf.fused_trainer._run_train_window = hooked
+    wf.run()
+    return {
+        "resume_overhead_seconds": round(resume_overhead, 4),
+        "restart_to_first_window_seconds": round(
+            first["t"] - t_restart, 4),
+        "resumed_suffix": state.get("suffix"),
+    }
+
+
 def _measure_rtt(n=5):
     """Host<->device round-trip latency (median of ``n`` 1-element
     readbacks) — the tunnel-day quality signal.  The axon tunnel's RTT
@@ -418,6 +500,12 @@ def main(profile_dir=None):
         # device-memory accounting of the flagship run
         "memory_ledger": flagship_profiler.get("ledger"),
     }
+    # recovery cost (ISSUE 7): mid-epoch snapshot restore + restart-to-
+    # first-window wall time — crash-guarded like the secondary models
+    try:
+        out["fault_tolerance"] = _fault_tolerance_block()
+    except Exception as e:  # noqa: BLE001 - never kill the primary
+        out["fault_tolerance"] = {"error": repr(e)}
     # mfu keys are ALWAYS stamped: null (with a visible note + a trace
     # instant) when the device kind has no PEAK_TABLE row — an unknown
     # accelerator must not silently drop the metric from BENCH_*.json

@@ -59,6 +59,7 @@ def _engine_flags_isolated():
     hpolicy = root.common.health.get("policy", "warn")
     hinterval = root.common.health.get("interval", 1)
     pen = root.common.profiler.get("enabled", False)
+    fen = root.common.faults.get("enabled", False)
     yield
     root.common.timings.sync_each_run = sync
     root.common.telemetry.enabled = tel
@@ -66,4 +67,12 @@ def _engine_flags_isolated():
     root.common.health.policy = hpolicy
     root.common.health.interval = hinterval
     root.common.profiler.enabled = pen
+    # fault-injection isolation: the gate, any armed rules (registry
+    # AND config-declared) and the site counters all reset per test
+    root.common.faults.enabled = fen
+    from znicz_tpu.core.config import Config
+    object.__setattr__(root.common.faults, "rules",
+                       Config("root.common.faults.rules"))
+    from znicz_tpu.core import faults
+    faults.reset()
 

@@ -70,6 +70,19 @@ def test_serve_subcommand_dispatches():
     assert newest_snapshot(d, "p").endswith("p_new.2.pickle")
 
 
+def test_optimize_rejects_max_restarts(tmp_path):
+    """--max-restarts supervision does not cover the genetics sweep —
+    the combination errors loudly instead of silently dropping the
+    flag."""
+    import pytest
+    from znicz_tpu.__main__ import main
+    wf = tmp_path / "wf_noop.py"
+    wf.write_text("def run(load, main):\n    pass\n")
+    with pytest.raises(SystemExit) as e:
+        main([str(wf), "--optimize", "2", "--max-restarts", "1"])
+    assert e.value.code == 2
+
+
 def test_launcher_roles():
     l = Launcher()
     assert l.is_standalone and not l.is_master and not l.is_slave

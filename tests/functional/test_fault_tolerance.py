@@ -1,0 +1,407 @@
+"""Fault-tolerant training acceptance pins (ISSUE 7).
+
+* **Kill-and-resume equivalence** — a fused training run crashed
+  mid-epoch by an injected dispatch fault and resumed through the
+  supervised launcher (``run_supervised`` → auto-resume → the
+  mid-epoch ``window_interval`` snapshot) finishes with bit-identical
+  integer aggregates (n_err, confusion) and parameters vs the
+  uninterrupted run — async single-device AND data-mesh=2 variants.
+* **Loader retry** — injected transient I/O faults at the minibatch
+  fill are absorbed by the bounded-backoff retry; the trajectory is
+  identical to a fault-free run.
+* **Supervised restart policy** — health halts are NOT restarted.
+* **Snapshotter satellites** — the durable (fsynced) publish, the
+  interval state advancing only after a SUCCESSFUL export, the
+  window-interval retry after a failed mid-epoch write, and
+  ``--auto-resume`` skipping corrupt/incompatible snapshots (with
+  journal events) down to the newest readable one.
+"""
+
+import os
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import faults, prng, telemetry
+from znicz_tpu.standard_workflow import StandardWorkflow
+
+FC_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+     "<-": {"learning_rate": 0.1}},
+    {"type": "softmax", "->": {"output_sample_shape": 3},
+     "<-": {"learning_rate": 0.1}},
+]
+
+
+def _seed():
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+
+
+def _wine_module(snapshot_dir, fused_cfg, max_epochs=3,
+                 window_interval=2):
+    """A run(load, main) module shim — what the supervised launcher
+    drives, rebuilt fresh on every restart attempt exactly like a
+    crashed process coming back up."""
+    import types
+    mod = types.ModuleType("wine_chaos")
+    mod.__file__ = __file__
+
+    def run(load, main):
+        import znicz_tpu.loader.loader_wine  # noqa: F401 (registry)
+        _seed()
+        load(StandardWorkflow,
+             layers=[dict(l) for l in FC_LAYERS],
+             loader_name="wine_loader",
+             loader_config={"minibatch_size": 10},
+             loss_function="softmax",
+             decision_config={"max_epochs": max_epochs,
+                              "fail_iterations": 100},
+             snapshotter_config={"prefix": "chaos", "interval": 1,
+                                 "time_interval": 0, "compression": "",
+                                 "directory": str(snapshot_dir),
+                                 "window_interval": window_interval},
+             fused=dict(fused_cfg))
+        main()
+
+    mod.run = run
+    return mod
+
+
+def _assert_same_final_state(wf_a, wf_b, params_exact=True):
+    """Bit-identical integer aggregates; params exact (or to a
+    tolerance where reassociation is documented)."""
+    assert list(wf_a.decision.epoch_n_err) == \
+        list(wf_b.decision.epoch_n_err)
+    assert wf_a.decision.epoch_n_evaluated_samples == \
+        wf_b.decision.epoch_n_evaluated_samples
+    for ca, cb in zip(wf_a.decision.confusion_matrixes,
+                      wf_b.decision.confusion_matrixes):
+        if ca is None or cb is None:
+            assert ca is None and cb is None
+            continue
+        numpy.testing.assert_array_equal(ca, cb)
+    assert wf_a.decision.max_err_y_sums == wf_b.decision.max_err_y_sums
+    pa = wf_a.fused_trainer.host_params()
+    pb = wf_b.fused_trainer.host_params()
+    for i, (la, lb) in enumerate(zip(pa, pb)):
+        for k in la:
+            if params_exact:
+                numpy.testing.assert_array_equal(
+                    la[k], lb[k], "layer %d %s" % (i, k))
+            else:
+                numpy.testing.assert_allclose(
+                    la[k], lb[k], rtol=1e-5, atol=1e-7,
+                    err_msg="layer %d %s" % (i, k))
+
+
+def _kill_and_resume(tmp_path, fused_cfg, params_exact=True):
+    from znicz_tpu.launcher import run_supervised, run_workflow
+
+    ref_dir = tmp_path / "ref"
+    chaos_dir = tmp_path / "chaos"
+    ref_dir.mkdir()
+    chaos_dir.mkdir()
+    # the uninterrupted reference (identical config, no faults)
+    wf_ref = run_workflow(_wine_module(ref_dir, fused_cfg))
+    assert wf_ref.decision.epoch_n_err[2] is not None
+
+    # wine: 18 TRAIN minibatches / window 4 -> 5 window dispatches per
+    # epoch; invocation 8 = epoch 2, window 3 — mid-epoch, after the
+    # window_interval=2 snapshot at epoch-2 window 2
+    faults.install("fused.dispatch", kind="crash", at=8)
+    root.common.faults.enabled = True
+    wf = run_supervised(_wine_module(chaos_dir, fused_cfg),
+                        max_restarts=2, restart_backoff_ms=0.0)
+    st = faults.status()
+    assert st["sites"]["fused.dispatch"]["injected"] == 1
+    # a MID-epoch snapshot was actually what restored (not just the
+    # epoch-end one): the newest snapshot at crash time carried the
+    # midepoch suffix
+    assert any("midepoch" in f for f in os.listdir(str(chaos_dir)))
+    _assert_same_final_state(wf, wf_ref, params_exact=params_exact)
+
+
+def test_kill_resume_equivalence_async(tmp_path):
+    """Async control plane: crash mid-epoch-2, supervised restart,
+    mid-epoch resume — final integer aggregates and params
+    bit-identical to the uninterrupted run."""
+    _kill_and_resume(tmp_path, {"window": 4})
+
+
+def test_kill_resume_equivalence_mesh2(tmp_path):
+    """Same pin data-parallel over a 2-shard mesh: the sharded epoch
+    accumulator partials snapshot/restore through the same one-readback
+    machinery; the resumed replay runs the same executables, so even
+    params stay bit-identical."""
+    _kill_and_resume(tmp_path, {"window": 4, "mesh": 2})
+
+
+def test_kill_resume_equivalence_sync_windows(tmp_path):
+    """Sync per-window readback mode: here the segment partials live in
+    the EVALUATOR's host accumulators, which ride the snapshot too."""
+    _kill_and_resume(tmp_path, {"window": 4, "async_windows": False})
+
+
+def test_host_fetch_fault_also_recovered(tmp_path):
+    """A transient RESOURCE_EXHAUSTED at the segment-final readback
+    (fused.host_fetch) crashes the attempt; the supervised restart
+    resumes and the result still matches the reference."""
+    from znicz_tpu.launcher import run_supervised, run_workflow
+
+    ref_dir = tmp_path / "ref"
+    chaos_dir = tmp_path / "chaos"
+    ref_dir.mkdir()
+    chaos_dir.mkdir()
+    wf_ref = run_workflow(_wine_module(ref_dir, {"window": 4}))
+    # host_fetch fires once per segment (plus snapshot drains); target
+    # epoch 2's segment-final readback
+    faults.install("fused.host_fetch", kind="xla", at=2)
+    root.common.faults.enabled = True
+    wf = run_supervised(_wine_module(chaos_dir, {"window": 4}),
+                        max_restarts=2, restart_backoff_ms=0.0)
+    _assert_same_final_state(wf, wf_ref)
+
+
+def test_loader_retry_absorbs_transient_io(tmp_path):
+    """Injected transient I/O at the minibatch fill: retried with
+    backoff, the run completes, and the trajectory is identical to a
+    fault-free run."""
+    from znicz_tpu.launcher import run_workflow
+
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir()
+    b.mkdir()
+    # window=1 keeps the per-minibatch path, where every TRAIN
+    # minibatch pays a host fill (the device-data window path skips
+    # TRAIN fills by design)
+    wf_clean = run_workflow(_wine_module(a, {"window": 1},
+                                         max_epochs=2))
+    faults.install("loader.fill", kind="io", every=7, times=3)
+    root.common.faults.enabled = True
+    wf = run_workflow(_wine_module(b, {"window": 1}, max_epochs=2))
+    st = faults.status()
+    assert st["sites"]["loader.fill"]["injected"] == 3
+    assert st["retries"] >= 3
+    _assert_same_final_state(wf, wf_clean)
+
+
+def test_supervised_never_restarts_health_halt():
+    """A HealthViolationError is a deliberate stop — restarting would
+    replay into the same violation forever."""
+    import types
+
+    from znicz_tpu.core.health import HealthViolationError
+    from znicz_tpu.launcher import run_supervised
+
+    attempts = []
+    mod = types.ModuleType("halting")
+    mod.__file__ = __file__
+
+    def run(load, main):
+        attempts.append(1)
+        raise HealthViolationError("loss diverged")
+
+    mod.run = run
+    with pytest.raises(HealthViolationError):
+        run_supervised(mod, max_restarts=5, restart_backoff_ms=0.0)
+    assert len(attempts) == 1
+
+
+def test_supervised_restart_falls_back_to_explicit_snapshot(tmp_path):
+    """A crash BEFORE the first snapshot write must re-enter the
+    user's explicit --snapshot warm start on restart, not fresh random
+    weights (the restart keeps the explicit snapshot as the fallback
+    seed; a newer resumable snapshot would win when one exists)."""
+    from znicz_tpu.launcher import run_supervised, run_workflow
+
+    seed_dir = tmp_path / "seed"
+    ref_dir = tmp_path / "ref"
+    chaos_dir = tmp_path / "chaos"
+    seed_dir.mkdir()
+    ref_dir.mkdir()
+    chaos_dir.mkdir()
+    run_workflow(_wine_module(seed_dir, {"window": 4}))
+    seed_snap = max((seed_dir / f for f in os.listdir(str(seed_dir))),
+                    key=lambda p: p.stat().st_mtime)
+    # reference: uninterrupted continuation from the seed to 6 epochs
+    wf_ref = run_workflow(
+        _wine_module(ref_dir, {"window": 4}, max_epochs=6),
+        snapshot=str(seed_snap))
+
+    # crash at the FIRST dispatch after the restore: nothing was
+    # snapshotted in chaos_dir yet, so the restart's auto-resume finds
+    # no candidate and must fall back to the explicit seed — finishing
+    # identically to the uninterrupted continuation, not retraining
+    # from fresh random weights
+    faults.install("fused.dispatch", kind="crash", at=1)
+    root.common.faults.enabled = True
+    wf = run_supervised(
+        _wine_module(chaos_dir, {"window": 4}, max_epochs=6),
+        max_restarts=1, restart_backoff_ms=0.0,
+        snapshot=str(seed_snap))
+    assert faults.status()["sites"]["fused.dispatch"]["injected"] == 1
+    _assert_same_final_state(wf, wf_ref)
+
+
+def test_supervised_restart_is_bounded():
+    import types
+
+    from znicz_tpu.launcher import run_supervised
+
+    attempts = []
+    mod = types.ModuleType("crashing")
+    mod.__file__ = __file__
+
+    def run(load, main):
+        attempts.append(1)
+        raise RuntimeError("crash %d" % len(attempts))
+
+    mod.run = run
+    with pytest.raises(RuntimeError, match="crash 3"):
+        run_supervised(mod, max_restarts=2, restart_backoff_ms=0.0)
+    assert len(attempts) == 3
+
+
+# ---------------------------------------------------------------------------
+# Snapshotter satellites
+# ---------------------------------------------------------------------------
+
+class _StubWorkflow(object):
+    """Just enough workflow for a standalone snapshotter unit."""
+
+    units = ()
+    forwards = ()
+
+    def add_unit(self, unit):
+        unit.workflow = self
+
+
+def _snapshotter(tmp_path, **kwargs):
+    from znicz_tpu.core.snapshotter import SnapshotterToFile
+    kwargs.setdefault("prefix", "sat")
+    kwargs.setdefault("compression", "")
+    kwargs.setdefault("directory", str(tmp_path))
+    snap = SnapshotterToFile(_StubWorkflow(), **kwargs)
+    snap.initialize()
+    return snap
+
+
+def test_snapshot_publish_is_fsynced(tmp_path, monkeypatch):
+    """Durability: the .part data AND the directory entry are fsynced
+    around the atomic rename."""
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (synced.append(fd),
+                                    real_fsync(fd))[1])
+    snap = _snapshotter(tmp_path)
+    path = snap.export()
+    assert path and os.path.exists(path)
+    assert not os.path.exists(path + ".part")
+    assert len(synced) >= 2  # file blocks + directory entry
+
+
+def test_failed_export_does_not_push_interval(tmp_path):
+    """Satellite: a failed write must NOT silently delay the next
+    snapshot by a full time_interval — the next fire retries."""
+    snap = _snapshotter(tmp_path, interval=1, time_interval=3600.0)
+    faults.install("snapshot.write", kind="crash", at=1)
+    root.common.faults.enabled = True
+    with pytest.raises(faults.InjectedCrashError):
+        snap.run()
+    assert not [f for f in os.listdir(str(tmp_path))
+                if f.endswith(".pickle")]
+    snap.run()  # the at=1 rule is spent; this one must write NOW
+    assert [f for f in os.listdir(str(tmp_path))
+            if f.endswith(".pickle")]
+
+
+def test_window_tick_interval_and_retry_after_failure(tmp_path):
+    snap = _snapshotter(tmp_path, window_interval=2)
+    assert snap.window_tick() is None          # 1 of 2
+    faults.install("snapshot.write", kind="io", at=1)
+    root.common.faults.enabled = True
+    with pytest.raises(faults.InjectedIOError):
+        snap.window_tick()                     # due, but write fails
+    wrote = snap.window_tick()                 # retries NEXT window
+    assert wrote and "midepoch" in wrote
+    assert snap.window_tick() is None          # counter reset: 1 of 2
+
+
+def test_auto_resume_skips_corrupt_and_incompatible(tmp_path):
+    """Satellite: a truncated file and a wrong-workflow snapshot ahead
+    of a good one are skipped (journal events recorded) and the newest
+    READABLE one restores."""
+    import pickle
+    import time
+
+    from znicz_tpu.launcher import Launcher, run_workflow
+
+    snap_dir = tmp_path / "snaps"
+    snap_dir.mkdir()
+    wf = run_workflow(_wine_module(snap_dir, {"window": 4},
+                                   max_epochs=1))
+    good = wf.snapshotter.export()
+    assert good
+    # two NEWER decoys matching the naming scheme
+    wrong = os.path.join(str(snap_dir), "chaos_wrongwf.999.pickle")
+    with open(wrong, "wb") as f:
+        pickle.dump({"format": 1, "workflow": "SomethingElse",
+                     "units": {}}, f)
+    truncated = os.path.join(str(snap_dir), "chaos_trunc.999.pickle")
+    with open(truncated, "wb") as f:
+        f.write(b"\x80\x04not a pickle at all")
+    # decoys NEWER than every snapshot the run itself wrote, so the
+    # candidate walk must skip both before reaching a readable one
+    now = time.time()
+    os.utime(wrong, (now + 10, now + 10))
+    os.utime(truncated, (now + 20, now + 20))
+
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    try:
+        launcher = Launcher(auto_resume=True)
+        state = launcher._find_resume_state(wf)
+    finally:
+        root.common.telemetry.enabled = False
+    assert state is not None
+    assert state["workflow"] == type(wf).__name__
+    skipped = [e for e in telemetry.journal_events()
+               if e["kind"] == "resume.skipped"]
+    whys = sorted(e["why"] for e in skipped)
+    assert whys == ["incompatible", "unreadable"]
+
+
+def test_auto_resume_rejects_mismatched_epoch_acc(tmp_path):
+    """A mid-epoch capture from a different data-shard count must be
+    SKIPPED as incompatible (the resumed window executable would reject
+    the donated accumulator and, under run_supervised, the job would
+    burn every restart on the same bad snapshot), while a matching
+    capture passes."""
+    import numpy
+
+    from znicz_tpu.launcher import Launcher, run_workflow
+
+    snap_dir = tmp_path / "snaps"
+    snap_dir.mkdir()
+    wf = run_workflow(_wine_module(snap_dir, {"window": 4},
+                                   max_epochs=1))
+    good = Launcher(auto_resume=True)._find_resume_state(wf)
+    assert good is not None
+    launcher = Launcher(auto_resume=True)
+
+    trainer_name = wf.fused_trainer.name
+    ustate = good["units"][trainer_name]
+    # a (4, ...)-lead capture, as a mesh={"data": 4} run writes
+    zeros = wf.fused_trainer.net.window_acc_zeros()
+    ustate["epoch_acc"] = {
+        k: numpy.zeros((4,) + v.shape, v.dtype)
+        for k, v in zeros.items()}
+    reason = launcher._snapshot_incompatible(good, wf)
+    assert reason and "epoch_acc" in reason
+    # the matching layout passes (shapes equal the live zero-acc)
+    ustate["epoch_acc"] = zeros
+    assert launcher._snapshot_incompatible(good, wf) is None

@@ -1,0 +1,137 @@
+"""Circuit-breaker state machine (serving/breaker.py) — driven by a
+fake clock, no sleeps anywhere (the acceptance discipline)."""
+
+import pytest
+
+from znicz_tpu.serving.breaker import (CircuitBreaker, CircuitOpenError,
+                                       CLOSED, OPEN, HALF_OPEN)
+
+
+class Clock(object):
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make(threshold=3, cooldown=1.0, half_open_max=1):
+    clock = Clock()
+    return CircuitBreaker("b8", threshold=threshold,
+                          cooldown_s=cooldown,
+                          half_open_max=half_open_max,
+                          clock=clock), clock
+
+
+def test_opens_after_consecutive_failures_only():
+    b, _ = make(threshold=3)
+    for _ in range(2):
+        b.allow()
+        b.record_failure()
+    b.allow()
+    b.record_success()  # success resets the consecutive count
+    for _ in range(2):
+        b.allow()
+        b.record_failure()
+    assert b.state == CLOSED
+    b.allow()
+    b.record_failure()  # third consecutive
+    assert b.state == OPEN
+    assert b.opens == 1
+
+
+def test_open_rejects_with_retry_after_then_half_opens():
+    b, clock = make(threshold=1, cooldown=2.0)
+    b.allow()
+    b.record_failure()
+    assert b.state == OPEN
+    with pytest.raises(CircuitOpenError) as ei:
+        b.allow()
+    assert 0.0 < ei.value.retry_after <= 2.0
+    clock.t = 1.0
+    with pytest.raises(CircuitOpenError) as ei:
+        b.allow()
+    assert ei.value.retry_after == pytest.approx(1.0)
+    clock.t = 2.5  # cooldown elapsed: one probe admitted
+    b.allow()
+    assert b.state == HALF_OPEN
+    # concurrent second probe is over half_open_max
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+
+
+def test_half_open_probe_success_closes():
+    b, clock = make(threshold=1, cooldown=1.0)
+    b.allow()
+    b.record_failure()
+    clock.t = 1.5
+    b.allow()
+    b.record_success()
+    assert b.state == CLOSED
+    b.allow()  # back to normal admission
+
+
+def test_half_open_probe_failure_reopens_fresh_cooldown():
+    b, clock = make(threshold=1, cooldown=1.0)
+    b.allow()
+    b.record_failure()
+    clock.t = 1.5
+    b.allow()
+    b.record_failure()
+    assert b.state == OPEN
+    assert b.opens == 2
+    with pytest.raises(CircuitOpenError) as ei:
+        b.allow()  # fresh cooldown from t=1.5
+    assert ei.value.retry_after == pytest.approx(1.0)
+    clock.t = 2.6
+    b.allow()
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_neutral_outcome_releases_half_open_probe():
+    # a client-caused trace error after an admitted half-open probe is
+    # no evidence about the backend: the slot must come back, or the
+    # breaker wedges with every probe consumed and no transition pending
+    b, clock = make(threshold=1, cooldown=1.0)
+    b.allow()
+    b.record_failure()
+    clock.t = 1.5
+    b.allow()               # the one half-open probe slot
+    b.record_neutral()      # client error: slot released, still half-open
+    assert b.state == HALF_OPEN
+    b.allow()               # a real probe can still be admitted
+    b.record_success()
+    assert b.state == CLOSED
+    b.record_neutral()      # closed: a no-op
+    assert b.state == CLOSED
+
+
+def test_closed_era_neutral_does_not_free_probe_slot():
+    """allow() returns whether a half-open probe slot was consumed; a
+    dispatch admitted while CLOSED that finishes neutrally during
+    HALF_OPEN must NOT free the slot a real probe still holds (the
+    bounded-probe contract)."""
+    b, clock = make(threshold=1, cooldown=2.0, half_open_max=1)
+    assert b.allow() is False  # request A admitted while CLOSED
+    b.record_failure()  # concurrent traffic opens the breaker
+    assert b.state == OPEN
+    clock.t = 3.0
+    assert b.allow() is True  # request B takes the ONE probe slot
+    assert b.state == HALF_OPEN
+    b.record_neutral(False)  # A finishes client-errored: no slot held
+    with pytest.raises(CircuitOpenError):
+        b.allow()  # the probe slot is still B's
+    b.record_success()  # B's probe succeeds
+    assert b.state == CLOSED
+
+
+def test_status_payload():
+    b, clock = make(threshold=1, cooldown=4.0)
+    assert b.status() == {"state": CLOSED, "failures": 0, "opens": 0}
+    b.allow()
+    b.record_failure()
+    clock.t = 1.0
+    st = b.status()
+    assert st["state"] == OPEN and st["opens"] == 1
+    assert st["retry_after"] == pytest.approx(3.0)

@@ -1,0 +1,202 @@
+"""Deterministic fault injection + transient retry (core/faults.py).
+
+Pins: rules fire EXACTLY where configured (at-step-N / every-K /
+seeded-probability — a chaos test must replay bit-identically), the
+``times`` cap disarms, the transient classifier separates retryable
+failures from crashes, ``retry_call`` bounds its backoff, and the
+disabled path never reaches the registry (the health.py zero-overhead
+guard discipline, asserted boom-style).
+"""
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import faults, telemetry
+
+
+def test_disabled_is_default_and_check_unreached(monkeypatch):
+    """Disabled gate: one config predicate; the registry is never even
+    allocated by status(), and guarded call sites never call check()
+    (boom-proof)."""
+    assert not faults.enabled()
+    monkeypatch.setattr(faults, "check", lambda site: (_ for _ in ()
+                                                       ).throw(
+        AssertionError("check() on a disabled path")))
+    # the loader's guarded site: fill with faults disabled
+    import numpy
+    from znicz_tpu.loader.base import Loader
+
+    class L(Loader):
+        filled = 0
+
+        def load_data(self):
+            self.class_lengths = [0, 0, 8]
+
+        def create_minibatch_data(self):
+            self.minibatch_data.reset(numpy.zeros((4, 2),
+                                                  dtype=numpy.float32))
+
+        def fill_minibatch(self):
+            L.filled += 1
+
+    loader = L(None, minibatch_size=4)
+    loader.initialize()
+    loader.run()
+    assert L.filled == 1
+    assert faults.status()["enabled"] is False
+    assert faults.status()["sites"] == {}
+
+
+def test_at_step_fires_exactly_once():
+    faults.install("x.site", kind="io", at=3)
+    root.common.faults.enabled = True
+    for i in range(1, 10):
+        if i == 3:
+            with pytest.raises(faults.InjectedIOError):
+                faults.check("x.site")
+        else:
+            faults.check("x.site")
+    st = faults.status()
+    assert st["sites"]["x.site"] == {"invocations": 9, "injected": 1}
+
+
+def test_every_k_with_times_cap():
+    faults.install("x.every", kind="io", every=2, times=2)
+    root.common.faults.enabled = True
+    fired = []
+    for i in range(1, 9):
+        try:
+            faults.check("x.every")
+        except faults.InjectedIOError:
+            fired.append(i)
+    assert fired == [2, 4]  # every 2nd, capped at 2 fires
+
+
+def test_seeded_probability_replays_exactly():
+    def run():
+        faults.reset()
+        faults.install("x.p", kind="io", p=0.5, seed=42)
+        fired = []
+        for i in range(1, 33):
+            try:
+                faults.check("x.p")
+            except faults.InjectedIOError:
+                fired.append(i)
+        return fired
+
+    root.common.faults.enabled = True
+    a, b = run(), run()
+    assert a == b and len(a) > 0  # same seed -> identical schedule
+
+
+def test_stall_sleeps_instead_of_raising(monkeypatch):
+    slept = []
+    import time as time_mod
+    monkeypatch.setattr(time_mod, "sleep", lambda s: slept.append(s))
+    faults.install("x.stall", kind="stall", every=1, stall_ms=25.0)
+    root.common.faults.enabled = True
+    faults.check("x.stall")  # no exception
+    assert slept == [0.025]
+
+
+def test_config_declared_rules_adopted():
+    """The CLI path: rules armed via root.common.faults.rules (the
+    chaos subprocess's --config vector) are adopted lazily."""
+    root.common.faults.rules = {"cfg.site": {"kind": "crash", "at": 1}}
+    root.common.faults.enabled = True
+    with pytest.raises(faults.InjectedCrashError):
+        faults.check("cfg.site")
+
+
+def test_config_rules_reassignment_invalidates_negative_cache():
+    """Hitting a site with NO declared rule negative-caches it; a
+    runtime reassignment of root.common.faults.rules must drop that
+    cache so the newly declared site arms (the documented live-config
+    contract)."""
+    root.common.faults.enabled = True
+    assert faults.check("late.site") is None  # negative-cached
+    root.common.faults.rules = {"late.site": {"kind": "crash",
+                                              "every": 1}}
+    with pytest.raises(faults.InjectedCrashError):
+        faults.check("late.site")
+
+
+def test_transient_classifier():
+    assert faults.is_transient(faults.InjectedIOError("disk hiccup"))
+    assert faults.is_transient(OSError("real I/O"))
+    assert faults.is_transient(
+        faults.InjectedXlaError("RESOURCE_EXHAUSTED: oom"))
+
+    class XlaRuntimeError(RuntimeError):  # organic type-name match
+        pass
+
+    assert faults.is_transient(XlaRuntimeError("UNAVAILABLE: link"))
+    assert not faults.is_transient(XlaRuntimeError("INVALID_ARGUMENT"))
+    assert not faults.is_transient(faults.InjectedCrashError("boom"))
+    assert not faults.is_transient(ValueError("shape"))
+    # deterministic filesystem errors can never succeed on retry —
+    # retrying would only burn the budget before the inevitable crash
+    assert not faults.is_transient(FileNotFoundError("gone.npy"))
+    assert not faults.is_transient(PermissionError("locked"))
+
+
+def test_retry_call_recovers_and_is_bounded(monkeypatch):
+    import time as time_mod
+    delays = []
+    monkeypatch.setattr(time_mod, "sleep", lambda s: delays.append(s))
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient %d" % calls["n"])
+        return "ok"
+
+    assert faults.retry_call(flaky, "t.site", attempts=3) == "ok"
+    assert calls["n"] == 3
+    # exponential backoff: base 5 ms doubling, capped at 200 ms
+    assert delays == [0.005, 0.01]
+    assert faults.status()["retries"] == 2
+
+    def always():
+        raise OSError("forever")
+
+    with pytest.raises(OSError):
+        faults.retry_call(always, "t.site", attempts=2)
+
+    def terminal():
+        raise ValueError("not transient")
+
+    calls["n"] = 0
+    with pytest.raises(ValueError):
+        faults.retry_call(terminal, "t.site", attempts=5)
+
+
+def test_injection_metered_and_journaled():
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    try:
+        faults.install("m.site", kind="io", at=1)
+        root.common.faults.enabled = True
+        with pytest.raises(faults.InjectedIOError):
+            faults.check("m.site")
+        assert telemetry.counter("faults.injected").value == 1
+        events = [e for e in telemetry.journal_events()
+                  if e["kind"] == "fault.injected"]
+        assert events and events[0]["site"] == "m.site"
+    finally:
+        root.common.telemetry.enabled = False
+
+
+def test_journal_records_with_only_faults_enabled():
+    """A chaos run without telemetry still gets its black box: the
+    journal gate includes the faults gate."""
+    telemetry.reset()
+    assert not telemetry.journal_enabled()
+    root.common.faults.enabled = True
+    assert telemetry.journal_enabled()
+    faults.install("j.site", kind="stall", at=10**9)
+    faults.check("j.site")  # not due - no event, but gate is live
+    telemetry.record_event("test.event", x=1)
+    assert any(e["kind"] == "test.event"
+               for e in telemetry.journal_events())

@@ -1,0 +1,250 @@
+"""Chaos smoke — a REAL SIGKILL mid-epoch, then resume, then breakers.
+
+Two acts (both deterministic, both asserting recovery, wired into
+tools/ci.sh):
+
+1. **Kill-and-resume**: a child process trains fused wine with
+   mid-epoch ``window_interval`` snapshots; the parent watches the
+   snapshot directory and SIGKILLs the child the moment a ``midepoch``
+   capture exists (no cooperation from the victim — this is the
+   preemption the supervised launcher exists for).  A second child
+   with ``--auto-resume`` restores the newest snapshot and finishes;
+   its integer aggregates (n_err, evaluated samples, confusion) and a
+   SHA-256 over the final parameters must equal an uninterrupted
+   reference run bit for bit.
+2. **Serving breaker**: an engine serving the reference run's snapshot
+   gets deterministic ``serving.forward`` faults injected; the
+   per-bucket breaker must open after the configured threshold,
+   reject WITHOUT dispatching (CircuitOpenError carrying Retry-After),
+   and recover through a half-open probe once the faults clear (fake
+   clock — the smoke sleeps for nothing but the victim's startup).
+
+Usage: ``python tools/chaos_smoke.py`` (parent), or the internal child
+mode ``--child OUT.json --snapshots DIR [--resume]``.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+EPOCHS = 40
+WINDOW_INTERVAL = 2
+PREFIX = "chaos"
+
+_CHILD = {"snapshots": None}
+
+
+def run(load, main):
+    """The run(load, main) module contract — this file IS the workflow
+    module the launcher drives (child mode)."""
+    import znicz_tpu.loader.loader_wine  # noqa: F401 (registry)
+    from znicz_tpu.core import prng
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    load(StandardWorkflow,
+         layers=[
+             {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+              "<-": {"learning_rate": 0.02}},
+             {"type": "softmax", "->": {"output_sample_shape": 3},
+              "<-": {"learning_rate": 0.02}},
+         ],
+         loader_name="wine_loader",
+         loader_config={"minibatch_size": 10},
+         loss_function="softmax",
+         decision_config={"max_epochs": EPOCHS,
+                          "fail_iterations": 10 ** 6},
+         snapshotter_config={"prefix": PREFIX, "interval": 1,
+                             "time_interval": 0, "compression": "",
+                             "directory": _CHILD["snapshots"],
+                             "window_interval": WINDOW_INTERVAL},
+         fused={"window": 4})
+    main()
+
+
+def _child(out_path, snapshots, resume):
+    from znicz_tpu.launcher import run_workflow
+
+    _CHILD["snapshots"] = snapshots
+    wf = run_workflow(sys.modules[__name__], auto_resume=resume)
+    params = wf.fused_trainer.host_params()
+    sha = hashlib.sha256()
+    for layer in params:
+        for key in sorted(layer):
+            sha.update(layer[key].tobytes())
+    conf_sha = hashlib.sha256()
+    for cm in wf.decision.confusion_matrixes:
+        conf_sha.update(b"-" if cm is None else cm.tobytes())
+    with open(out_path, "w") as f:
+        json.dump({
+            "epoch_n_err": list(wf.decision.epoch_n_err),
+            "samples": list(wf.decision.epoch_n_evaluated_samples),
+            "max_err_y_sums": [float(v)
+                               for v in wf.decision.max_err_y_sums],
+            "confusion_sha": conf_sha.hexdigest(),
+            "params_sha": sha.hexdigest(),
+        }, f)
+    return 0
+
+
+def _spawn_child(out, snapshots, resume=False):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", out,
+           "--snapshots", snapshots]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.Popen(cmd, cwd=REPO)
+
+
+def _kill_and_resume(tmp):
+    ref_dir = os.path.join(tmp, "ref")
+    chaos_dir = os.path.join(tmp, "chaos")
+    os.makedirs(ref_dir)
+    os.makedirs(chaos_dir)
+
+    ref_out = os.path.join(tmp, "ref.json")
+    proc = _spawn_child(ref_out, ref_dir)
+    assert proc.wait(timeout=300) == 0, "reference run failed"
+
+    # the victim: SIGKILL the moment a mid-epoch snapshot exists
+    victim_out = os.path.join(tmp, "victim.json")
+    victim = _spawn_child(victim_out, chaos_dir)
+    deadline = time.time() + 240
+    midepoch = None
+    while time.time() < deadline and victim.poll() is None:
+        hits = [f for f in os.listdir(chaos_dir) if "midepoch" in f
+                and not f.endswith(".part")]
+        if hits:
+            midepoch = hits[0]
+            break
+        time.sleep(0.005)
+    assert midepoch, "no mid-epoch snapshot appeared before timeout"
+    time.sleep(0.1)  # let training advance PAST the capture
+    victim.send_signal(signal.SIGKILL)
+    rc = victim.wait(timeout=60)
+    assert rc == -signal.SIGKILL, "victim rc %r (expected SIGKILL)" % rc
+    assert not os.path.exists(victim_out), "victim somehow finished"
+    print("chaos_smoke: victim SIGKILLed mid-epoch (saw %s)" % midepoch)
+
+    # resume: a fresh process with --auto-resume finishes the job
+    resumed_out = os.path.join(tmp, "resumed.json")
+    proc = _spawn_child(resumed_out, chaos_dir, resume=True)
+    assert proc.wait(timeout=300) == 0, "resumed run failed"
+
+    with open(ref_out) as f:
+        ref = json.load(f)
+    with open(resumed_out) as f:
+        res = json.load(f)
+    assert res == ref, ("kill-resume mismatch:\nref     %r\n"
+                        "resumed %r" % (ref, res))
+    print("chaos_smoke: resumed aggregates + params SHA bit-identical "
+          "to the uninterrupted run (n_err=%s)" % ref["epoch_n_err"])
+    return ref_dir
+
+
+def _servable_snapshot(tmp):
+    """A quick unit-graph wine run — fused snapshots deliberately skip
+    the serving-topology sidecar, so the breaker act serves a
+    unit-graph one."""
+    import znicz_tpu.loader.loader_wine  # noqa: F401 (registry)
+    from znicz_tpu.core import prng
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    prng.get(1).seed(7)
+    prng.get(2).seed(8)
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 2, "fail_iterations": 20},
+        snapshotter_config={"prefix": "serve", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": tmp})
+    wf.initialize()
+    wf.run()
+    wf.snapshotter.suffix = "final"
+    return wf.snapshotter.export()
+
+
+def _breaker_smoke(tmp):
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core import faults
+    from znicz_tpu.serving import CircuitOpenError, InferenceEngine
+
+    import numpy
+
+    snap = _servable_snapshot(tmp)
+    assert snap, "no snapshot to serve"
+    root.common.serving.breaker_threshold = 3
+    root.common.serving.breaker_cooldown_ms = 3600 * 1e3
+    root.common.retry.attempts = 0
+    engine = InferenceEngine(snap, max_batch=8)
+    x = numpy.zeros((1, 13), dtype=numpy.float32)
+
+    faults.install("serving.forward", kind="xla", every=1)
+    root.common.faults.enabled = True
+    failures = 0
+    for _ in range(3):
+        try:
+            engine.predict(x)
+        except Exception as e:  # noqa: BLE001 - injected
+            assert "RESOURCE_EXHAUSTED" in str(e), e
+            failures += 1
+    assert failures == 3
+    breaker = engine._breakers[1]
+    assert breaker.state == "open", breaker.state
+    before = faults.status()["sites"]["serving.forward"]["invocations"]
+    try:
+        engine.predict(x)
+        raise AssertionError("open breaker admitted a dispatch")
+    except CircuitOpenError as e:
+        assert e.retry_after > 0
+    assert faults.status()["sites"]["serving.forward"][
+        "invocations"] == before, "open breaker still dispatched"
+    print("chaos_smoke: breaker OPEN after 3 injected forward faults; "
+          "503-class rejection without dispatch (retry_after stamped)")
+
+    faults.clear("serving.forward")
+    opened_at = breaker._opened_at
+    breaker._clock = lambda: opened_at + 10 * 3600.0  # cooldown passed
+    y = engine.predict(x)
+    assert y.shape[0] == 1
+    assert breaker.state == "closed"
+    assert breaker.opens == 1
+    print("chaos_smoke: breaker recovered through half-open probe; "
+          "serving again")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--child", metavar="OUT.json")
+    parser.add_argument("--snapshots")
+    parser.add_argument("--resume", action="store_true")
+    args = parser.parse_args()
+    if args.child:
+        return _child(args.child, args.snapshots, args.resume)
+
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="chaos_smoke_")
+    _kill_and_resume(tmp)
+    _breaker_smoke(os.path.join(tmp, "serve"))
+    print("chaos_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

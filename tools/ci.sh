@@ -25,6 +25,8 @@ echo "== mesh smoke (wine 1 vs 4 data shards: identical aggregates, 1 readback/s
 JAX_PLATFORMS=cpu python tools/mesh_smoke.py
 echo "== bench gate selftest (injected >10% drop must fail the gate)"
 python tools/bench_gate.py --selftest
+echo "== chaos smoke (SIGKILL mid-epoch -> resume bit-identical; breaker opens -> recovers)"
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 echo "== serving smoke (wine snapshot over HTTP, 64 concurrent, 0 recompiles)"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 if [ "$1" = "full" ]; then

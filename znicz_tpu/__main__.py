@@ -193,6 +193,17 @@ def main(argv=None):
                              "matching snapshot (if any) and continue "
                              "training — safe to use as the default "
                              "launch mode of a supervised job")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        metavar="N",
+                        help="supervised mode: catch a crashed run, "
+                             "back off, and re-enter with auto-resume "
+                             "up to N times (mid-epoch snapshots — "
+                             "snapshotter window_interval — make the "
+                             "re-entry resume mid-epoch)")
+    parser.add_argument("--restart-backoff-ms", type=float,
+                        default=1000.0, metavar="MS",
+                        help="supervised-restart backoff base (doubles "
+                             "per attempt, capped at 30 s)")
     parser.add_argument("--testing", action="store_true",
                         help="forward-only run (reference --test)")
     parser.add_argument("--dry-run", action="store_true",
@@ -270,11 +281,24 @@ def main(argv=None):
                 args.dump_graph:
             parser.error("--optimize cannot be combined with --snapshot/"
                          "--testing/--dry-run/--dump-graph")
+        if args.max_restarts > 0:
+            # loud, not silently inert: the genetics sweep is not
+            # supervised
+            parser.error("--optimize cannot be combined with "
+                         "--max-restarts")
         return run_genetics(module, args.optimize, fused=fused)
     dry_run = args.dry_run or (bool(args.dump_graph) and not args.testing)
-    wf = run_workflow(module, snapshot=args.snapshot,
-                      testing=args.testing, dry_run=dry_run, fused=fused,
-                      auto_resume=args.auto_resume)
+    if args.max_restarts > 0:
+        from znicz_tpu.launcher import run_supervised
+        wf = run_supervised(module, max_restarts=args.max_restarts,
+                            restart_backoff_ms=args.restart_backoff_ms,
+                            snapshot=args.snapshot, testing=args.testing,
+                            dry_run=dry_run, fused=fused,
+                            auto_resume=args.auto_resume)
+    else:
+        wf = run_workflow(module, snapshot=args.snapshot,
+                          testing=args.testing, dry_run=dry_run,
+                          fused=fused, auto_resume=args.auto_resume)
     if args.dump_graph:
         wf.dump_graph(args.dump_graph)
     decision = getattr(wf, "decision", None)

@@ -158,6 +158,26 @@ root.common.update({
         "capture_seconds_cap": 60.0,  # /debug/profile?seconds= ceiling
         "capture_dir": None,      # default: <cache>/profiles
     },
+    # deterministic fault injection (core/faults.py) — off by default;
+    # when off every injection site is a single predicate with ZERO
+    # device syncs and zero compiles.  Rules map site names to trigger
+    # dicts ({"kind": "io"|"xla"|"crash"|"stall", "at": N | "every": K
+    # | "p": x, "times": M, "stall_ms": ...}) so chaos tests replay
+    # deterministically.  See docs/deployment.md "Fault tolerance".
+    "faults": {
+        "enabled": False,
+        "seed": 0,            # default stream for p-mode rules
+        "rules": {},          # site -> rule dict (declarative arming)
+    },
+    # bounded-retry policy for TRANSIENT faults (loader minibatch fill,
+    # serving executable dispatch — core/faults.py retry_call); always
+    # armed: a try/except around an already-expensive call costs
+    # nothing until a fault actually fires
+    "retry": {
+        "attempts": 3,          # retries AFTER the first try
+        "backoff_base_ms": 5.0,  # exponential base; doubles per retry
+        "backoff_max_ms": 200.0,  # backoff ceiling
+    },
     # engine timing behavior (was the mutable class global
     # Unit.sync_timings; config-backed so tests can't leak
     # blocking-sync mode into the rest of the suite)
@@ -173,6 +193,15 @@ root.common.update({
         "timeout_ms": 1000.0,   # per-request deadline in the queue
         "warmup": True,         # compile every bucket before ready
         "slow_request_ms": 1000.0,  # log requests slower than this
+        # graceful degradation (serving/breaker.py + HandlerBase):
+        "breaker_threshold": 5,     # consecutive dispatch failures
+                                    # before a bucket's breaker opens
+                                    # (0 disables circuit breaking)
+        "breaker_cooldown_ms": 1000.0,  # open -> half-open delay; also
+                                        # the Retry-After hint on 503s
+        "breaker_half_open_max": 1,  # concurrent half-open probes
+        "max_body_bytes": 16 << 20,  # request bodies over this get 413
+                                     # (0 disables the cap)
     },
 })
 

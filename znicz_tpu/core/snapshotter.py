@@ -27,6 +27,7 @@ import time
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core.config import root
 from znicz_tpu.core.memory import Array
+from znicz_tpu.core import faults
 from znicz_tpu.core import telemetry
 
 import numpy
@@ -61,27 +62,67 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
             "directory", root.common.dirs.snapshots)
         self.interval = kwargs.get("interval", 1)
         self.time_interval = kwargs.get("time_interval", 0)
+        #: mid-epoch trigger: every N dispatched fused training windows
+        #: the trainer's ``window_tick`` call captures a resumable
+        #: snapshot under the ``midepoch`` suffix (0 = off).  With the
+        #: loader cursor + PRNG streams + the trainer's drained epoch
+        #: accumulators all in the payload, a SIGKILLed run resumes
+        #: mid-epoch with aggregates exactly equal to an uninterrupted
+        #: one (tests/functional/test_fault_tolerance.py).
+        self.window_interval = int(kwargs.get("window_interval", 0))
         self.suffix = None
         self.destination = None
         self._last_time = 0.0
-        self._counter = 0
+        self._since_fire = 0
+        self._windows_since = 0
 
     def initialize(self, device=None, **kwargs):
         super(SnapshotterBase, self).initialize(device=device, **kwargs)
         os.makedirs(self.directory, exist_ok=True)
 
     def run(self):
-        self._counter += 1
-        if self._counter % self.interval:
+        self._since_fire += 1
+        if self._since_fire < self.interval:
             return
         if time.time() - self._last_time < self.time_interval:
             return
+        self._metered_export("snapshotter.export")
+        # interval state advances ONLY after a successful export (a
+        # failed write above raised out of run()): a transient write
+        # failure must not silently push the next snapshot a full
+        # interval/time_interval out — the next fire retries instead
+        self._since_fire = 0
         self._last_time = time.time()
+
+    def window_tick(self):
+        """Mid-epoch trigger — the fused trainer calls this once per
+        dispatched NON-segment-final training window.  Every
+        ``window_interval`` windows it exports a snapshot under the
+        ``midepoch`` suffix; 0 (the default) keeps this a single
+        predicate.  Like :meth:`run`, the counter resets only after a
+        successful export, so a failed write retries on the very next
+        window.  Returns the written path (None when off/not due)."""
+        if not self.window_interval:
+            return None
+        self._windows_since += 1
+        if self._windows_since < self.window_interval:
+            return None
+        saved = self.suffix
+        self.suffix = "midepoch"
+        try:
+            wrote = self._metered_export("snapshotter.midepoch")
+        finally:
+            self.suffix = saved
+        self._windows_since = 0
+        return wrote
+
+    def _metered_export(self, span_name):
+        """Telemetry shell shared by the decision-gated :meth:`run` and
+        the window-interval :meth:`window_tick` trigger."""
         if not telemetry.enabled():
-            self.export()
-            return
+            return self.export()
         t0 = time.perf_counter()
-        with telemetry.span("snapshotter.export", prefix=self.prefix):
+        with telemetry.span(span_name, prefix=self.prefix):
             wrote = self.export()
         # the series are created on EVERY rank (registries must stay
         # SPMD-identical or cross-host aggregation refuses to merge)
@@ -94,6 +135,7 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         if wrote:
             exports.inc()
             seconds.observe(time.perf_counter() - t0)
+        return wrote
 
     def export(self):
         """Write a snapshot; return the destination path, or None when
@@ -128,7 +170,7 @@ class SnapshotterToFile(SnapshotterBase):
 
     MAPPING = "file"
 
-    def export(self):
+    def export(self, units_state=None):
         from znicz_tpu.core import prng
         import jax
         if jax.process_count() > 1 and jax.process_index() != 0:
@@ -142,7 +184,12 @@ class SnapshotterToFile(SnapshotterBase):
             "format": 1,
             "workflow": type(self.workflow).__name__,
             "config": root.to_json(),
-            "units": self.collect_state(),
+            # a subclass that already collected (NNSnapshotterBase's
+            # tensor-stat logging) passes the state through — the
+            # epoch_acc export drains the async pipeline, so one
+            # collection per capture, not two
+            "units": self.collect_state() if units_state is None
+            else units_state,
             # PRNG stream states make resume-retrain EXACT (the reference
             # gets this by pickling the whole workflow, prng included)
             "prng": prng.states(),
@@ -160,10 +207,28 @@ class SnapshotterToFile(SnapshotterBase):
         # atomic publish: a crash/SIGKILL mid-write must never leave a
         # truncated file where auto-resume (launcher --auto-resume) will
         # look for the newest snapshot
+        if faults.enabled():
+            faults.check("snapshot.write")
         tmp = self.destination + ".part"
         with opener(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=4)
+        # crash-DURABLE publish: os.replace is atomic against readers
+        # but not against power loss — the .part data blocks (fsynced
+        # after close so compressed trailers are included) and the
+        # directory entry must both hit disk, or a crash can leave the
+        # published name pointing at truncated bytes
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp, self.destination)
+        dfd = os.open(os.path.dirname(self.destination) or ".",
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self.info("snapshot -> %s", self.destination)
         telemetry.record_event("snapshot", path=self.destination,
                                suffix=self.suffix)

@@ -47,6 +47,14 @@ _PAGE = """<html><head><title>znicz_tpu status</title>
 </body></html>"""
 
 
+class BodyTooLargeError(ValueError):
+    """Request body over ``root.common.serving.max_body_bytes`` —
+    refused BEFORE reading (HTTP 413): one oversized upload must not
+    be buffered into server memory.  Subclasses ``ValueError`` so
+    body-draining helpers treat it like the other refuse-to-read
+    case (Transfer-Encoding)."""
+
+
 class HandlerBase(BaseHTTPRequestHandler):
     """Shared request-handler plumbing.  Subclasses (closed over their
     owning server) implement ``do_GET``/``do_POST`` with the ``_send*``
@@ -91,6 +99,15 @@ class HandlerBase(BaseHTTPRequestHandler):
             raise ValueError("Transfer-Encoding is not supported — "
                              "send a Content-Length body")
         length = int(self.headers.get("Content-Length") or 0)
+        cap = int(root.common.serving.get("max_body_bytes",
+                                          16 << 20) or 0)
+        if cap and length > cap:
+            # refuse BEFORE reading: the unread bytes mean this
+            # keep-alive socket cannot be reused, say so honestly
+            self.close_connection = True
+            raise BodyTooLargeError(
+                "request body of %d bytes exceeds the %d-byte limit"
+                % (length, cap))
         return self.rfile.read(length) if length > 0 else b""
 
     def _drain_body(self):
@@ -134,6 +151,10 @@ class HandlerBase(BaseHTTPRequestHandler):
             self._send_json(200,
                             {"events": telemetry.journal_events(),
                              "dropped": telemetry.journal_dropped()})
+            return True
+        if path == "/debug/faults":
+            from znicz_tpu.core import faults
+            self._send_json(200, faults.status())
             return True
         if path == "/debug/profiler":
             from znicz_tpu.core import profiler

@@ -252,11 +252,15 @@ def export_trace(path):
 # ---------------------------------------------------------------------------
 
 def journal_enabled():
-    """The flight recorder records when EITHER telemetry or the health
-    monitor is on — a health-only run still wants its black box."""
+    """The flight recorder records when telemetry, the health monitor
+    OR the fault-injection registry is on — a health-only run still
+    wants its black box, and a chaos run must journal what it injected
+    and how recovery went."""
     if _cfg.get("enabled", False):
         return True
-    return bool(root.common.health.get("enabled", False))
+    if root.common.health.get("enabled", False):
+        return True
+    return bool(root.common.faults.get("enabled", False))
 
 
 def record_event(kind, **fields):

@@ -149,6 +149,30 @@ class Launcher(Logger):
             for attr, value in ustate.items():
                 if value is None:
                     continue
+                if attr == "epoch_acc":
+                    # mid-epoch accumulator capture: validate against
+                    # the net's zero-acc layout (host-side shapes — the
+                    # live getattr would force a device drain per
+                    # candidate).  A lead-dim mismatch means a
+                    # different data-shard count; resuming it would
+                    # crash the first window dispatch and, under
+                    # run_supervised, burn every restart on the same
+                    # bad snapshot instead of falling back
+                    net = getattr(u, "net", None)
+                    if net is None or not isinstance(value, dict):
+                        continue
+                    expect = net.window_acc_zeros()
+                    for leaf, zero in expect.items():
+                        got = value.get(leaf)
+                        if got is None or \
+                                tuple(numpy.shape(got)) != zero.shape:
+                            return "unit %s.epoch_acc[%s] shape %s " \
+                                "!= %s" % (
+                                    uname, leaf,
+                                    None if got is None
+                                    else tuple(numpy.shape(got)),
+                                    zero.shape)
+                    continue
                 cur = getattr(u, attr, None)
                 if isinstance(cur, Array) and cur and \
                         tuple(cur.shape) != tuple(numpy.shape(value)):
@@ -232,17 +256,24 @@ class Launcher(Logger):
         if snap is None:
             self.warning("--auto-resume: workflow has no snapshotter")
             return None
+        from znicz_tpu.core import telemetry
         for path in snapshot_candidates(snap.directory, snap.prefix):
             try:
                 state = SnapshotterToFile.import_(path)
             except Exception as e:  # noqa: BLE001 - corrupt snapshot
                 self.warning("auto-resume: skipping unreadable snapshot "
                              "%s (%s)", path, e)
+                telemetry.record_event("resume.skipped", path=path,
+                                       why="unreadable",
+                                       error=repr(e))
                 continue
             reason = self._snapshot_incompatible(state, wf)
             if reason:
                 self.warning("auto-resume: skipping incompatible "
                              "snapshot %s (%s)", path, reason)
+                telemetry.record_event("resume.skipped", path=path,
+                                       why="incompatible",
+                                       reason=reason)
                 continue
             self.info("auto-resume: restoring %s", path)
             return state
@@ -254,8 +285,20 @@ class Launcher(Logger):
         if wf is None:
             raise RuntimeError("main() before load()")
         wf.initialize(device=self.device, **kwargs)
-        if self._state is None and self.auto_resume:
-            self._state = self._find_resume_state(wf)
+        if self.auto_resume:
+            found = self._find_resume_state(wf)
+            if found is not None:
+                # the newest resumable state wins over an explicit
+                # --snapshot (which stays the fallback seed): a
+                # supervised restart that crashed BEFORE the first new
+                # snapshot write must re-enter the user's warm start,
+                # and one that crashed after must continue the run,
+                # not rewind to the seed
+                self._state = found
+            elif self._state is not None:
+                self.info("auto-resume: no resumable snapshot; "
+                          "falling back to explicit snapshot %s",
+                          self.snapshot_path)
         if self._state is not None:
             from znicz_tpu.units.nn_units import load_snapshot_into_workflow
             load_snapshot_into_workflow(self._state, wf)
@@ -382,3 +425,73 @@ def run_workflow(spec, snapshot=None, testing=False, dry_run=False,
         return module.run_sample(device=device)
     raise SystemExit(
         "%s exposes neither run(load, main) nor run_sample()" % spec)
+
+
+def run_supervised(spec, max_restarts=0, restart_backoff_ms=1000.0,
+                   restart_backoff_max_ms=30000.0, snapshot=None,
+                   testing=False, dry_run=False, device=None, fused=None,
+                   auto_resume=False):
+    """Supervised :func:`run_workflow`: a crashed run is caught, backed
+    off (exponentially, ``restart_backoff_ms * 2**attempt`` capped at
+    ``restart_backoff_max_ms``) and re-entered up to ``max_restarts``
+    times with ``auto_resume`` forced on — the restarted attempt
+    rebuilds the workflow and restores the newest readable snapshot,
+    including mid-epoch ``window_interval`` captures, so a preempted
+    training run continues instead of restarting the epoch.
+
+    The job-level twin of the reference's slave-loss recovery
+    (a worker dies, the master re-issues its work): here the whole
+    process is the worker and the snapshot directory is the master.
+
+    Deliberately NOT restarted:
+
+    * ``KeyboardInterrupt`` / ``SystemExit`` — operator intent;
+    * :class:`~znicz_tpu.core.health.HealthViolationError` — the halt
+      policy asked to stop; resuming would replay into the same
+      violation, forever.
+
+    Each restart is metered (``launcher.restarts`` counter) and
+    journaled (``launcher.restart`` events carry the attempt number,
+    the error and the backoff).  Returns the finished workflow.
+    """
+    import time
+
+    from znicz_tpu.core import telemetry
+    from znicz_tpu.core.health import HealthViolationError
+    from znicz_tpu.core.logger import Logger
+
+    log = Logger(logger_name="Supervisor")
+    attempt = 0
+    while True:
+        try:
+            # the explicit snapshot rides along on EVERY attempt: with
+            # auto-resume forced on, a restart prefers the newest
+            # resumable snapshot but a crash before the first write
+            # falls back to the user's warm start instead of fresh
+            # random weights
+            return run_workflow(
+                spec, snapshot=snapshot,
+                testing=testing, dry_run=dry_run, device=device,
+                fused=fused, auto_resume=auto_resume or attempt > 0)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except HealthViolationError:
+            raise
+        except Exception as e:  # noqa: BLE001 - the supervised surface
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            delay = min(float(restart_backoff_ms) / 1e3
+                        * (2 ** (attempt - 1)),
+                        float(restart_backoff_max_ms) / 1e3)
+            if telemetry.enabled():
+                telemetry.counter("launcher.restarts").inc()
+            telemetry.record_event("launcher.restart", attempt=attempt,
+                                   max_restarts=max_restarts,
+                                   error=repr(e),
+                                   backoff_ms=round(delay * 1e3, 3))
+            log.warning(
+                "run crashed (%r); restart %d/%d with auto-resume in "
+                "%.1f s", e, attempt, max_restarts, delay)
+            if delay > 0:
+                time.sleep(delay)

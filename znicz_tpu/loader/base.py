@@ -33,6 +33,7 @@ import numpy
 from znicz_tpu.core.units import Unit
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
+from znicz_tpu.core import faults
 from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
 from znicz_tpu.core import telemetry
@@ -275,9 +276,9 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             if traced:
                 with telemetry.span("loader.fill", size=int(n),
                                     clazz=CLASS_NAME[clazz]):
-                    self.fill_minibatch()
+                    self._fill_resilient()
             else:
-                self.fill_minibatch()
+                self._fill_resilient()
             if n < self.max_minibatch_size:
                 self.minibatch_labels.map_write()
                 self.minibatch_labels.mem[n:] = -1
@@ -312,6 +313,24 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             self._offset_in_class = off + n
         if prof_t0 is not None:
             profiler.note_data_wait(time.perf_counter() - prof_t0)
+
+    def _serve_fill(self):
+        """One fill attempt, with the ``loader.fill`` fault-injection
+        site INSIDE the retried region — an injected (or organic)
+        transient I/O error is recovered by the retry below exactly
+        like a flaky disk read would be; ``stall`` faults model a slow
+        source and simply delay the fill."""
+        if faults.enabled():
+            faults.check("loader.fill")
+        self.fill_minibatch()
+
+    def _fill_resilient(self):
+        """``fill_minibatch`` with bounded exponential-backoff retry on
+        TRANSIENT failures (core/faults.py classifier + the
+        ``root.common.retry`` policy).  A loader that raises a terminal
+        error still fails the run; a flaky one costs a logged retry
+        instead of an epoch of device-resident state."""
+        faults.retry_call(self._serve_fill, "loader.fill")
 
     def fill_window_slot(self, x_out=None, labels_out=None,
                          targets_out=None, indices_out=None):

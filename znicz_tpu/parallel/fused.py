@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from znicz_tpu.core import faults
 from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
 from znicz_tpu.core import telemetry
@@ -1646,38 +1647,73 @@ class FusedNet:
         until the segment-final window's one all-reduce."""
         if self._win_acc is not None:
             return self._win_acc
+        acc = self.window_acc_zeros()
+        shard = self._acc_shardings(acc)
+        self._win_acc = {k: jax.device_put(v, shard[k])
+                         for k, v in acc.items()}
+        return self._win_acc
+
+    def window_acc_zeros(self):
+        """Host-side zero epoch accumulators — the shape/dtype
+        authority for the device leaves.  Shared by the zero-init path
+        and by launcher auto-resume's compatibility check, which must
+        validate a candidate snapshot's ``epoch_acc`` (including the
+        leading data-shard axis — a mesh=4 capture cannot resume into a
+        mesh=2 run) WITHOUT forcing a device drain."""
         out_dtype = jnp.float32 if self.compute_dtype is not None \
             else self.dtype
         lead = (self._dp,) if self._dp > 1 else ()
         if self.objective == "mse":
             metrics = numpy.zeros(lead + (3,), dtype=out_dtype)
             metrics[..., 2] = numpy.inf
-            acc = {"metrics": metrics,
-                   "n_err": numpy.zeros(lead + (2,), numpy.int32)}
-        else:
-            n_classes = int(self.specs[-1].n_out)
-            acc = {"n_err": numpy.zeros(lead + (2,), numpy.int32),
-                   "confusion": numpy.zeros(
-                       lead + (n_classes, n_classes), numpy.int32),
-                   "max_err_sum": numpy.zeros(lead, out_dtype)}
+            return {"metrics": metrics,
+                    "n_err": numpy.zeros(lead + (2,), numpy.int32)}
+        n_classes = int(self.specs[-1].n_out)
+        return {"n_err": numpy.zeros(lead + (2,), numpy.int32),
+                "confusion": numpy.zeros(
+                    lead + (n_classes, n_classes), numpy.int32),
+                "max_err_sum": numpy.zeros(lead, out_dtype)}
+
+    def _acc_shardings(self, acc):
+        """Accumulator leaf placements — replicated off-mesh, sharded
+        ``P("data", ...)`` partials under a data mesh (shared by the
+        zero-init path and mid-epoch resume's :meth:`set_window_acc`)."""
         if self.mesh is None:
-            shard = {k: None for k in acc}
-        elif self._dp > 1:
-            shard = {k: NamedSharding(
-                self.mesh, P("data", *([None] * (v.ndim - 1))))
+            return {k: None for k in acc}
+        if self._dp > 1:
+            return {k: NamedSharding(
+                self.mesh, P("data", *([None] * (numpy.ndim(v) - 1))))
                 for k, v in acc.items()}
-        else:
-            rep = NamedSharding(self.mesh, P())
-            shard = {k: rep for k in acc}
-        self._win_acc = {k: jax.device_put(v, shard[k])
-                         for k, v in acc.items()}
-        return self._win_acc
+        rep = NamedSharding(self.mesh, P())
+        return {k: rep for k in acc}
 
     @property
     def window_acc(self):
         """The last window's folded epoch accumulator (device; None
         before the first window of a segment)."""
         return self._win_acc
+
+    def window_acc_host(self):
+        """Drained host copy of the epoch accumulator for the mid-epoch
+        snapshot payload — ONE batched readback (:meth:`host_fetch`),
+        transitively waiting on every in-flight window.  None when the
+        accumulator is at its zero state (segment boundary)."""
+        if self._win_acc is None:
+            return None
+        return self.host_fetch(self._win_acc)
+
+    def set_window_acc(self, host_acc):
+        """Restore a :meth:`window_acc_host` capture (mid-epoch
+        resume): leaves re-placed with the accumulator shardings, so
+        the next dispatched window folds onto the pre-crash partials —
+        async and mesh modes included."""
+        if host_acc is None:
+            self._win_acc = None
+            return
+        host_acc = {k: numpy.asarray(v) for k, v in host_acc.items()}
+        shard = self._acc_shardings(host_acc)
+        self._win_acc = {k: jax.device_put(v, shard[k])
+                         for k, v in host_acc.items()}
 
     def reset_window_acc(self):
         """Zero the epoch accumulator (the trainer calls this at every
@@ -2129,6 +2165,12 @@ class FusedNet:
         the transfer).  Metered on the telemetry d2h byte/call counters
         (ONE call per fetch, however many leaves ride it) — the async
         control plane's zero-mid-epoch-readback pin reads this meter."""
+        if faults.enabled():
+            # readback injection site (transient RESOURCE_EXHAUSTED /
+            # stalled-transfer class).  Like the dispatch site, not
+            # retried in place — the supervised launcher's restart +
+            # mid-epoch resume is the recovery path.
+            faults.check("fused.host_fetch")
         if not self._replicate_outputs:
             host = jax.device_get(tree)
         else:

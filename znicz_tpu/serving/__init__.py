@@ -14,14 +14,24 @@ Three pillars (docs/serving.md):
 * :class:`znicz_tpu.serving.server.ServingServer` — the stdlib HTTP
   front end (``POST /predict``, ``GET /healthz``, ``POST /reload``,
   ``GET /metrics``), fully instrumented through
-  :mod:`znicz_tpu.core.telemetry`.
+  :mod:`znicz_tpu.core.telemetry`;
+* :class:`znicz_tpu.serving.breaker.CircuitBreaker` — per-bucket
+  circuit breaking around executable dispatch (503 + ``Retry-After``
+  while open, half-open recovery probes) plus graceful SIGTERM drain
+  on the server — the degradation valves of docs/deployment.md's
+  "Fault tolerance" story.
 """
 
 from znicz_tpu.serving.engine import (  # noqa: F401 - re-export
     InferenceEngine, default_buckets)
 from znicz_tpu.serving.batcher import (  # noqa: F401 - re-export
-    MicroBatcher, QueueFullError, RequestTimeoutError)
+    BatcherStoppedError, MicroBatcher, QueueFullError,
+    RequestTimeoutError)
+from znicz_tpu.serving.breaker import (  # noqa: F401 - re-export
+    CircuitBreaker, CircuitOpenError)
 from znicz_tpu.serving.server import ServingServer  # noqa: F401
 
 __all__ = ["InferenceEngine", "MicroBatcher", "ServingServer",
-           "QueueFullError", "RequestTimeoutError", "default_buckets"]
+           "BatcherStoppedError", "QueueFullError",
+           "RequestTimeoutError", "default_buckets",
+           "CircuitBreaker", "CircuitOpenError"]

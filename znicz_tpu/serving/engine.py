@@ -44,6 +44,7 @@ import numpy
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import faults
 from znicz_tpu.core import telemetry
 
 
@@ -243,6 +244,12 @@ class InferenceEngine(Logger):
         self._load_lock = threading.Lock()
         self._version = 0
         self._ready = threading.Event()
+        #: per-bucket circuit breakers (serving/breaker.py), created
+        #: lazily on first dispatch of each bucket; they deliberately
+        #: survive hot reloads — backend flakiness is not a property of
+        #: one model generation
+        self._breakers = {}
+        self._breaker_lock = threading.Lock()
         if source is not None:
             self.load(source)
 
@@ -281,7 +288,7 @@ class InferenceEngine(Logger):
     def stats(self):
         """healthz payload: what is loaded, how warm, how big."""
         m = self._model
-        return {
+        payload = {
             "ready": self.ready,
             "model_version": self._version,
             "source": m.source if m else None,
@@ -292,6 +299,14 @@ class InferenceEngine(Logger):
             "buckets": list(self.buckets),
             "warm_buckets": list(self.warm_buckets),
         }
+        if self._breakers:
+            # snapshot under the creation lock: a first dispatch of a
+            # new bucket may be inserting concurrently
+            with self._breaker_lock:
+                items = sorted(self._breakers.items())
+            payload["breakers"] = {
+                str(bucket): breaker.status() for bucket, breaker in items}
+        return payload
 
     # -- loading ------------------------------------------------------------
     def load(self, source, sample_shape=None):
@@ -451,6 +466,40 @@ class InferenceEngine(Logger):
         raise ValueError("batch of %d rows exceeds max_batch %d"
                          % (n, self.max_batch))
 
+    def _bucket_breaker(self, bucket):
+        """The bucket's circuit breaker (None when
+        ``root.common.serving.breaker_threshold`` is 0).
+
+        Config is read on EVERY call: setting ``breaker_threshold=0``
+        at runtime bypasses existing breakers immediately (an open
+        bucket stops 503ing without a process restart), and live
+        threshold/cooldown/half-open changes are adopted in place
+        without resetting breaker state.
+        """
+        cfg = root.common.serving
+        threshold = int(cfg.get("breaker_threshold", 5) or 0)
+        if threshold <= 0:
+            return None
+        cooldown_s = float(cfg.get("breaker_cooldown_ms", 1000.0)) / 1e3
+        half_open_max = int(cfg.get("breaker_half_open_max", 1))
+        breaker = self._breakers.get(bucket)
+        if breaker is None:
+            from znicz_tpu.serving.breaker import CircuitBreaker
+            with self._breaker_lock:
+                breaker = self._breakers.get(bucket)
+                if breaker is None:
+                    breaker = CircuitBreaker(
+                        "serving.b%d" % bucket, threshold=threshold,
+                        cooldown_s=cooldown_s,
+                        half_open_max=half_open_max)
+                    self._breakers[bucket] = breaker
+                    return breaker
+        if (breaker.threshold != max(threshold, 1)
+                or breaker.cooldown_s != cooldown_s
+                or breaker.half_open_max != max(half_open_max, 1)):
+            breaker.reconfigure(threshold, cooldown_s, half_open_max)
+        return breaker
+
     def predict(self, x, request_ids=None):
         """Forward ``x`` (batch-first) through the loaded model.
 
@@ -484,6 +533,22 @@ class InferenceEngine(Logger):
             padded = numpy.zeros((bucket,) + x.shape[1:], dtype=m.dtype)
             padded[:n] = x
             x = padded
+        # graceful degradation: an open breaker rejects BEFORE any
+        # device work (CircuitOpenError -> HTTP 503 + Retry-After).
+        # Admitted dispatches report exactly one success/failure back,
+        # and the breaker-gated region retries TRANSIENT faults
+        # (RESOURCE_EXHAUSTED-class, injected or organic) with bounded
+        # backoff first — only an exhausted retry counts as a failure.
+        breaker = self._bucket_breaker(bucket)
+
+        def _dispatch():
+            if faults.enabled():
+                faults.check("serving.forward")
+            return m.fn(m.params, x)
+
+        def _forward():
+            return faults.retry_call(_dispatch, "serving.forward")
+
         # the one place a compile can happen: the first dispatch of
         # this (bucket, model-generation) pair.  Marked warm only AFTER
         # the dispatch succeeds — a failed trace must not make
@@ -497,18 +562,48 @@ class InferenceEngine(Logger):
                 profiler.register_jit_cost(
                     "serving.forward.b%d" % bucket, m.fn, (m.params, x),
                     bucket=bucket, model_version=m.version)
-        if not telemetry.enabled():
-            y = numpy.asarray(m.fn(m.params, x))[:n]
-        else:
-            attrs = {"rows": n, "bucket": bucket}
-            if request_ids:
-                attrs["request_ids"] = list(request_ids)
-            with telemetry.span("serving.predict", **attrs):
-                y = numpy.asarray(m.fn(m.params, x))[:n]
-            # per-bucket traffic: which compiled executables earn their
-            # keep (read next to serving.compiles.<bucket> on /metrics)
-            telemetry.counter(telemetry.labeled(
-                "serving.predictions", bucket=bucket)).inc()
+        # admission immediately adjacent to the recorded region: an
+        # admitted call (half-open probe slot included) is ALWAYS
+        # answered by exactly one record_* below — nothing that can
+        # raise may sit between allow() and the try
+        probe_slot = breaker.allow() if breaker is not None else False
+        try:
+            if not telemetry.enabled():
+                y = numpy.asarray(_forward())[:n]
+            else:
+                attrs = {"rows": n, "bucket": bucket}
+                if request_ids:
+                    attrs["request_ids"] = list(request_ids)
+                with telemetry.span("serving.predict", **attrs):
+                    y = numpy.asarray(_forward())[:n]
+                # per-bucket traffic: which compiled executables earn
+                # their keep (next to serving.compiles.<bucket> on
+                # /metrics)
+                telemetry.counter(telemetry.labeled(
+                    "serving.predictions", bucket=bucket)).inc()
+        except (ValueError, TypeError):
+            # shape/dtype errors surfacing at trace time are the
+            # CLIENT's fault (server.py maps them to 400) — no evidence
+            # about backend health, so they must not push the breaker
+            # toward open (malformed traffic could otherwise deny
+            # service to valid requests)
+            if breaker is not None:
+                breaker.record_neutral(probe_slot)
+            raise
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        except BaseException:
+            # KeyboardInterrupt/SystemExit mid-dispatch (a notebook
+            # Ctrl-C) says nothing about backend health — release the
+            # (possibly half-open probe) slot, or the bucket wedges
+            # open forever with every probe slot consumed
+            if breaker is not None:
+                breaker.record_neutral(probe_slot)
+            raise
+        if breaker is not None:
+            breaker.record_success()
         if first:
             m.warm.add(bucket)
             if telemetry.enabled():

@@ -14,8 +14,11 @@ Endpoints:
   ``Content-Type: application/octet-stream``.  Replies in kind: JSON
   ``{"outputs": ..., "argmax": ..., "model_version": ...,
   "request_id": ...}`` or raw ``.npy`` bytes.  Status codes: 400
-  malformed, 429 queue full (backpressure), 503 not warmed up, 504
-  deadline expired.  Every reply (success or error) echoes the
+  malformed, 413 body over ``root.common.serving.max_body_bytes``
+  (refused before reading), 429 queue full (backpressure), 503 not
+  warmed up / draining / circuit open (the breaker 503 carries a
+  ``Retry-After`` header — serving/breaker.py), 504 deadline
+  expired.  Every reply (success or error) echoes the
   request's tracing id in the ``X-Request-Id`` header — the client's
   own id when it sent one, a generated one otherwise; the id
   propagates through the micro-batcher into the engine's dispatch
@@ -46,15 +49,19 @@ CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
 import argparse
 import io
 import json
+import math
 import uuid
 
 import numpy
 
 from znicz_tpu.core.config import root
-from znicz_tpu.core.status_server import HandlerBase, HttpServerBase
+from znicz_tpu.core.status_server import (BodyTooLargeError, HandlerBase,
+                                          HttpServerBase)
 from znicz_tpu.core import telemetry
-from znicz_tpu.serving.batcher import (MicroBatcher, QueueFullError,
+from znicz_tpu.serving.batcher import (BatcherStoppedError, MicroBatcher,
+                                       QueueFullError,
                                        RequestTimeoutError)
+from znicz_tpu.serving.breaker import CircuitOpenError
 from znicz_tpu.serving.engine import InferenceEngine
 
 
@@ -73,11 +80,35 @@ class ServingServer(HttpServerBase):
         self.engine = engine
         self._owns_batcher = batcher is None
         self.batcher = batcher or MicroBatcher(engine).start()
+        #: graceful-drain latch: once set, /predict answers 503
+        #: ("draining") and /healthz reports not-ready so load
+        #: balancers stop routing here while in-flight work flushes
+        self._draining = False
+        self._drained = False
 
     def stop(self):
         super(ServingServer, self).stop()
         if self._owns_batcher:
             self.batcher.stop()
+
+    def drain(self):
+        """Graceful shutdown (the SIGTERM path): stop admitting new
+        predictions, flush everything already queued through the
+        batcher, then stop the HTTP server.  Idempotent."""
+        if self._drained:
+            return
+        self._drained = True
+        self._draining = True
+        telemetry.record_event("serving.drain")
+        self.info("draining: refusing new work, flushing %d queued "
+                  "rows", self.batcher.queued_rows)
+        # flush=True serves the queue to completion before the worker
+        # exits — in-flight clients get their answers, not RSTs.  An
+        # externally-owned (possibly shared) batcher is left running,
+        # the same ownership contract stop() honors.
+        if self._owns_batcher:
+            self.batcher.stop(flush=True)
+        self.stop()
 
     def statusz(self):
         payload = dict(self.engine.stats())
@@ -122,6 +153,15 @@ class ServingServer(HttpServerBase):
     def _predict(self, handler):
         rid = self._request_id(handler)
         echo = {"X-Request-Id": rid}
+        if self._draining:
+            # graceful shutdown: honest fast 503 so the balancer
+            # re-routes; Retry-After hints "a replacement is coming"
+            handler._drain_body()
+            handler._send_json(
+                503, {"error": "server draining", "ready": False,
+                      "request_id": rid},
+                headers=dict(echo, **{"Retry-After": "1"}))
+            return
         if not self.engine.ready:
             handler._drain_body()  # keep-alive: no unread bytes behind
             handler._send_json(503, {"error": "model warming up",
@@ -130,6 +170,12 @@ class ServingServer(HttpServerBase):
             return
         try:
             x, timeout_ms, raw = self._parse_predict(handler)
+        except BodyTooLargeError as e:
+            # the unread oversized body already forced Connection:
+            # close in _read_body — answer honestly and drop the socket
+            handler._send_json(413, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
+            return
         except Exception as e:  # noqa: BLE001 - client error
             handler._send_json(400, {"error": repr(e),
                                      "request_id": rid}, headers=echo)
@@ -137,6 +183,14 @@ class ServingServer(HttpServerBase):
         try:
             y = self.batcher.predict(x, timeout_ms=timeout_ms,
                                      request_id=rid)
+        except BatcherStoppedError:
+            # the submit raced drain()/stop(): same honest 503 the
+            # pre-admission _draining check produces
+            handler._send_json(
+                503, {"error": "server draining", "ready": False,
+                      "request_id": rid},
+                headers=dict(echo, **{"Retry-After": "1"}))
+            return
         except QueueFullError as e:
             handler._send_json(429, {"error": str(e),
                                      "request_id": rid}, headers=echo)
@@ -144,6 +198,17 @@ class ServingServer(HttpServerBase):
         except RequestTimeoutError as e:
             handler._send_json(504, {"error": str(e),
                                      "request_id": rid}, headers=echo)
+            return
+        except CircuitOpenError as e:
+            # circuit breaking: the bucket's dispatch path is known-bad
+            # — reject fast with the cooldown as the Retry-After hint
+            # (no device work was attempted)
+            handler._send_json(
+                503, {"error": str(e), "request_id": rid,
+                      "retry_after_seconds": round(e.retry_after, 3)},
+                headers=dict(echo, **{
+                    "Retry-After":
+                        str(max(1, int(math.ceil(e.retry_after))))}))
             return
         except (ValueError, TypeError) as e:
             # shape/dtype mismatches surface at trace time as
@@ -173,6 +238,9 @@ class ServingServer(HttpServerBase):
         try:
             doc = json.loads(handler._read_body().decode() or "{}")
             path = doc["path"]
+        except BodyTooLargeError as e:
+            handler._send_json(413, {"error": str(e)})
+            return
         except Exception as e:  # noqa: BLE001 - client error
             handler._send_json(400, {"error": 'body needs {"path": '
                                               '"..."} (%r)' % e})
@@ -195,6 +263,10 @@ class ServingServer(HttpServerBase):
             def do_GET(self):
                 if self.path == "/healthz":
                     stats = server.engine.stats()
+                    if server._draining:
+                        # readiness flips FIRST so the balancer stops
+                        # routing while queued work flushes
+                        stats = dict(stats, ready=False, draining=True)
                     self._send_json(200 if stats["ready"] else 503,
                                     stats)
                 elif self.path == "/metrics":
@@ -276,13 +348,30 @@ def main(argv=None):
     print("serving %s on http://%s:%d/  (predict: POST /predict; "  # noqa
           "health: GET /healthz; metrics: GET /metrics)"
           % (model, server.host, server.port))
+    # graceful drain on SIGTERM (the orchestrator's shutdown signal):
+    # stop admitting, flush in-flight requests, then exit 0 — no
+    # client sees a dropped connection on a routine pod rotation
+    import signal
+    import threading
+    term = threading.Event()
+
+    def _on_term(signum, frame):
+        term.set()
+
     try:
-        while True:
-            server._thread.join(3600)
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # non-main thread (embedding) — CTRL-C only
+        pass
+    try:
+        while not term.wait(1.0):
+            if server._thread is None or not server._thread.is_alive():
+                break
     except KeyboardInterrupt:
         print("shutting down")  # noqa: T201 - CLI feedback
     finally:
-        server.stop()
+        if term.is_set():
+            print("SIGTERM: draining in-flight requests")  # noqa: T201
+        server.drain()
     return 0
 
 

@@ -223,7 +223,16 @@ class DecisionGD(DecisionBase):
         self.max_err_y_sums = [0] * 3
         self.autoencoder = False
         self.exports = ["epoch_n_err", "epoch_n_err_pt", "best_n_err_pt",
-                        "snapshot_suffix", "improved_epoch_number"]
+                        "snapshot_suffix", "improved_epoch_number",
+                        # the FULL bookkeeping rides along so a
+                        # mid-epoch resume replays improve/stop
+                        # decisions exactly (fault-tolerant training,
+                        # docs/deployment.md)
+                        "epoch_n_evaluated_samples",
+                        "best_n_err_pt_epoch_number",
+                        "best_minimax_n_err_pt",
+                        "best_minimax_n_err_pt_epoch_number",
+                        "confusion_matrixes", "max_err_y_sums"]
         self.demand("minibatch_size")
 
     def on_run(self):
@@ -385,6 +394,8 @@ class DecisionMSE(DecisionGD):
         self.best_metrics = [None] * 3
         self.minibatch_metrics = None  # linked from evaluator ("metrics")
         self.demand("minibatch_metrics")
+        self.exports = list(self.exports) + ["epoch_metrics",
+                                             "best_metrics"]
 
     def on_last_minibatch(self):
         super(DecisionMSE, self).on_last_minibatch()

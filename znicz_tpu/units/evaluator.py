@@ -104,6 +104,14 @@ class EvaluatorSoftmax(EvaluatorBase):
         #: identically inside the compiled window (fused._eval_stats)
         self.stats_source = None
         self.demand("labels", "max_idx")
+        #: segment-partial host accumulators ride snapshots so a
+        #: MID-epoch resume (snapshotter window_interval) continues the
+        #: fold exactly where the interrupted run left it — in async
+        #: windowed mode these are zero mid-segment (the partials live
+        #: in the trainer's device epoch_acc), in sync/per-minibatch
+        #: mode they carry the segment so far
+        self.exports = ["n_err", "confusion_matrix",
+                        "max_err_output_sum"]
 
     def initialize(self, device=None, **kwargs):
         super(EvaluatorSoftmax, self).initialize(device=device, **kwargs)
@@ -212,6 +220,8 @@ class EvaluatorMSE(EvaluatorBase):
         #: softmax evaluator's stats_source
         self.stats_source = None
         self.demand("target")
+        #: mid-epoch resume: see EvaluatorSoftmax.exports
+        self.exports = ["metrics", "mse", "n_err"]
 
     def initialize(self, device=None, **kwargs):
         super(EvaluatorMSE, self).initialize(device=device, **kwargs)

@@ -38,6 +38,7 @@ from znicz_tpu.core.units import Unit
 from znicz_tpu.core.memory import Array
 from znicz_tpu.core.mutable import Bool
 from znicz_tpu.core.config import root
+from znicz_tpu.core import faults
 from znicz_tpu.core import health
 from znicz_tpu.core import profiler
 from znicz_tpu.core import prng
@@ -317,9 +318,13 @@ class FusedForwardBackward(Unit):
             self.demand("target")
         else:
             self.demand("labels")
+        self._pending_acc = None
         #: snapshot payload: params + optimizer state + dropout key +
-        #: live hyperparameters (bit-exact fused resume)
-        self.exports = ["fused_state"]
+        #: live hyperparameters (bit-exact fused resume), plus the
+        #: device-resident epoch accumulators drained to host — the
+        #: piece that makes MID-epoch snapshots resumable with
+        #: aggregates exactly equal to an uninterrupted run
+        self.exports = ["fused_state", "epoch_acc"]
 
     # -- head-width parity with link_forwards --------------------------------
     def _fix_head_width(self):
@@ -404,6 +409,9 @@ class FusedForwardBackward(Unit):
         if self._pending_state is not None:
             self._apply_state(self._pending_state)
             self._pending_state = None
+        if self._pending_acc is not None:
+            self.net.set_window_acc(self._pending_acc)
+            self._pending_acc = None
 
     # -- device-resident dataset (windowed TPU-first data path) -------------
     def _loader_qualifies_for_device_data(self):
@@ -558,6 +566,16 @@ class FusedForwardBackward(Unit):
             health.check_training_step(
                 self, steps=n, params=self.net.params,
                 updates=self.net.state, context="fused_window")
+        # mid-epoch checkpointing (snapshotter window_interval): fired
+        # only on NON-segment-final windows — boundaries already have
+        # the decision-gated snapshot — and always at a window
+        # boundary, so an interrupted run resumed from the capture
+        # re-partitions the remaining minibatches into the exact same
+        # windows the uninterrupted run dispatches
+        snap = getattr(self.workflow, "snapshotter", None)
+        if snap is not None and getattr(snap, "window_interval", 0) \
+                and not bool(self.loader_unit.last_minibatch):
+            snap.window_tick()
 
     def _run_train_window_inner(self, probe=None):
         """Collect up to ``window`` TRAIN minibatches (driving the loader
@@ -690,6 +708,14 @@ class FusedForwardBackward(Unit):
         # instead, so it never compiles (or pays) the final variant.
         pull_output = bool(loader.last_minibatch)
         dispatch_final = pull_output and self.async_windows
+        if faults.enabled():
+            # window-dispatch injection site (transient XlaRuntimeError
+            # / RESOURCE_EXHAUSTED class, or a hard crash standing in
+            # for preemption).  Deliberately NOT retried here: a failed
+            # dispatch under donation cannot re-use its arguments — the
+            # supervised launcher's restart + mid-epoch resume is the
+            # recovery path (launcher.run_supervised).
+            faults.check("fused.dispatch")
         if self._use_device_data:
             if self.loss == "mse":
                 stats = self.net.run_window_mse_sliced(
@@ -862,6 +888,8 @@ class FusedForwardBackward(Unit):
             self.input.map_read()
             x = self.input.mem
             idx = None
+            if train and faults.enabled():
+                faults.check("fused.dispatch")
             if self.loss == "mse":
                 self.target.map_read()
                 if train:
@@ -935,6 +963,25 @@ class FusedForwardBackward(Unit):
             self._pending_state = value
         else:
             self._apply_state(value)
+
+    @property
+    def epoch_acc(self):
+        """The device-resident epoch accumulators drained to host (the
+        existing one-readback machinery — :meth:`FusedNet.host_fetch`
+        waits on every in-flight window, so the capture is consistent
+        under the async pipeline and under a data mesh, where the
+        leaves are the sharded ``(S, ...)`` partials).  None at segment
+        boundaries (nothing mid-flight to save)."""
+        if self.net is None:
+            return self._pending_acc
+        return self.net.window_acc_host()
+
+    @epoch_acc.setter
+    def epoch_acc(self, value):
+        if self.net is None:
+            self._pending_acc = value
+        else:
+            self.net.set_window_acc(value)
 
     def _refresh_weight_views(self):
         for i, view in self.weight_views:

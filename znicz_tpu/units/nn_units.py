@@ -557,7 +557,9 @@ class NNSnapshotterBase(SnapshotterToFile):
         for uname, ustate in state.items():
             for attr, value in ustate.items():
                 self._log_attr("%s.%s" % (uname, attr), value)
-        return super(NNSnapshotterBase, self).export()
+        # pass the collected state through: the epoch_acc export's
+        # host_fetch drains the async pipeline — one drain per capture
+        return super(NNSnapshotterBase, self).export(units_state=state)
 
     def run(self):
         if self.skip is not None and bool(self.skip):
