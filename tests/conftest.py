@@ -60,6 +60,7 @@ def _engine_flags_isolated():
     hinterval = root.common.health.get("interval", 1)
     pen = root.common.profiler.get("enabled", False)
     fen = root.common.faults.get("enabled", False)
+    cen = root.common.compile_cache.get("enabled", False)
     yield
     root.common.timings.sync_each_run = sync
     root.common.telemetry.enabled = tel
@@ -75,4 +76,10 @@ def _engine_flags_isolated():
                        Config("root.common.faults.rules"))
     from znicz_tpu.core import faults
     faults.reset()
+    # persistent-compile-cache isolation: a test that wired the cache
+    # must not leave later tests' jit compiles writing to its tempdir
+    root.common.compile_cache.enabled = cen
+    from znicz_tpu.core import compile_cache
+    if compile_cache.enabled():
+        compile_cache.disable()
 

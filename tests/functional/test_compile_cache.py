@@ -1,0 +1,229 @@
+"""Persistent compile cache (znicz_tpu/core/compile_cache.py) — the
+serving cold-start acceptance pin: a replica RESTARTED against a warm
+cache reaches ready and serves its first mixed-size request sweep with
+ZERO fresh XLA compiles (every warmup "compile" is a cache
+deserialization), numerically identical to the first replica.  Plus
+the warmup-manifest contract: exports record the bucket ladder, and a
+loading engine adopts it unless the caller pinned buckets explicitly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import compile_cache, telemetry
+from znicz_tpu import export
+from znicz_tpu.serving.engine import InferenceEngine, default_buckets
+
+
+#: the replica lifecycle under test, run in a FRESH process (a cold
+#: start by construction): wire the cache, build a two-model registry
+#: (full warmup), then serve a mixed-size sweep over every bucket of
+#: both models; print the compile accounting and an output digest.
+_REPLICA = r"""
+import hashlib, json, sys
+import numpy
+from znicz_tpu.core import compile_cache, telemetry
+from znicz_tpu.serving import ModelRegistry
+
+telemetry.enable()
+compile_cache.enable(sys.argv[1])
+watch = compile_cache.watch()
+
+def fc(seed, n_in, n_out):
+    r = numpy.random.RandomState(seed)
+    manifest = {
+        "format": 1,
+        "layers": [
+            {"type": "all2all_tanh", "name": "fc0",
+             "arrays": {"weights": "w0.npy", "bias": "b0.npy"},
+             "include_bias": True, "weights_transposed": True},
+            {"type": "softmax", "name": "out",
+             "arrays": {"weights": "w1.npy", "bias": "b1.npy"},
+             "include_bias": True, "weights_transposed": True}],
+        "input_sample_shape": [n_in]}
+    arrays = {"w0.npy": r.randn(n_in, 8).astype("f4"),
+              "b0.npy": r.randn(8).astype("f4"),
+              "w1.npy": r.randn(8, n_out).astype("f4"),
+              "b1.npy": r.randn(n_out).astype("f4")}
+    return manifest, arrays
+
+registry = ModelRegistry(models={"alpha": fc(1, 4, 3),
+                                 "beta": fc(2, 6, 2)}, max_batch=8)
+assert registry.ready
+warmup = watch.delta()
+warmup_fresh = watch.fresh_compiles()
+
+sweep_watch = compile_cache.watch()
+digest = hashlib.sha256()
+rng = numpy.random.RandomState(7)
+for name, width in (("alpha", 4), ("beta", 6)):
+    engine = registry.engine(name)
+    for n in (1, 2, 3, 4, 5, 8):   # every bucket, off-sizes included
+        x = rng.uniform(-1, 1, (n, width)).astype(numpy.float32)
+        digest.update(numpy.ascontiguousarray(
+            engine.predict(x)).tobytes())
+print("REPLICA " + json.dumps({
+    "warmup_fresh_compiles": warmup_fresh,
+    "warmup": warmup,
+    "sweep_fresh_compiles": sweep_watch.fresh_compiles(),
+    "sweep_backend_compiles": sweep_watch.delta()["backend_compiles"],
+    "digest": digest.hexdigest(),
+    "cache": compile_cache.stats(),
+}))
+"""
+
+
+def _run_replica(cache_dir, tmp_path):
+    script = tmp_path / "replica.py"
+    script.write_text(_REPLICA)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, str(script), str(cache_dir)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=repo)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("REPLICA ")]
+    assert proc.returncode == 0 and lines, proc.stderr[-2000:]
+    return json.loads(lines[-1][len("REPLICA "):])
+
+
+def test_warm_restart_serves_with_zero_fresh_compiles(tmp_path):
+    """THE cold-start acceptance pin: replica 2, a fresh process
+    sharing replica 1's persistent cache, warms every bucket of both
+    models and serves a full mixed-size sweep with ZERO fresh XLA
+    compiles — and answers byte-identically to replica 1."""
+    cache_dir = tmp_path / "xla_cache"
+    first = _run_replica(cache_dir, tmp_path)
+    # the cold replica really compiled (the pin below means something)
+    assert first["warmup_fresh_compiles"] > 0
+    # ... and its post-warmup sweep never compiled (warmup covers the
+    # whole ladder — the PR 2 contract, preserved per model)
+    assert first["sweep_backend_compiles"] == 0
+    assert first["cache"]["entries"] > 0
+
+    second = _run_replica(cache_dir, tmp_path)
+    # zero FRESH compiles across the entire restarted lifecycle:
+    # every backend_compile event was a persistent-cache load
+    assert second["warmup_fresh_compiles"] == 0, second["warmup"]
+    assert second["warmup"]["persistent_cache_hits"] == \
+        second["warmup"]["backend_compiles"]
+    assert second["sweep_backend_compiles"] == 0
+    # the warm replica is the same replica: byte-identical outputs
+    assert second["digest"] == first["digest"]
+
+
+def test_watch_counts_fresh_compiles_not_cache_loads():
+    """fresh = backend_compiles - persistent_cache_hits: the installed
+    jax ticks backend_compiles around the whole compile-OR-load step,
+    so the watch must subtract the loads."""
+    telemetry.enable()
+    w = compile_cache.watch()
+    telemetry.counter("jax.backend_compiles").inc(5)
+    telemetry.counter("jax.persistent_cache_hits").inc(3)
+    assert w.delta()["backend_compiles"] == 5
+    assert w.fresh_compiles() == 2
+
+
+def test_enable_disable_and_config_gate(tmp_path, monkeypatch):
+    monkeypatch.setattr(root.common.dirs, "cache", str(tmp_path))
+    try:
+        assert not compile_cache.enabled()
+        assert compile_cache.maybe_enable() is None  # gate off
+        monkeypatch.setattr(root.common.compile_cache, "enabled", True)
+        d = compile_cache.maybe_enable()
+        assert d == os.path.join(str(tmp_path), "xla_cache")
+        assert compile_cache.enabled()
+        assert os.path.isdir(d)
+        assert compile_cache.stats()["dir"] == d
+        explicit = tmp_path / "elsewhere"
+        assert compile_cache.enable(str(explicit)) == str(explicit)
+        assert compile_cache.active_dir() == str(explicit)
+    finally:
+        compile_cache.disable()
+    assert not compile_cache.enabled()
+    assert compile_cache.stats()["enabled"] is False
+
+
+def test_export_records_warmup_manifest(monkeypatch):
+    monkeypatch.setattr(root.common.serving, "max_batch", 16)
+    mf = export.serving_manifest((13,))
+    assert mf["sample_shape"] == [13]
+    assert mf["max_batch"] == 16
+    assert mf["buckets"] == list(default_buckets(16))
+
+
+def _source_with_manifest(buckets):
+    manifest = {
+        "format": 1,
+        "layers": [{"type": "dropout", "name": "d0", "arrays": {}}],
+        "input_sample_shape": [5],
+        "serving": {"buckets": list(buckets),
+                    "max_batch": max(buckets),
+                    "sample_shape": [5]},
+    }
+    return manifest, {}
+
+
+def test_engine_adopts_recorded_warmup_manifest():
+    """A source that recorded its bucket ladder at export time warms
+    EXACTLY that ladder on load — the replica compiles the executable
+    set the exporter's cluster serves, nothing else."""
+    engine = InferenceEngine(_source_with_manifest((1, 2)),
+                             warmup=False)
+    assert engine.buckets == (1, 2)
+    assert engine.max_batch == 2
+    assert engine.stats()["warmup_manifest"]["buckets"] == [1, 2]
+
+
+def test_failed_reload_keeps_the_surviving_ladder():
+    """Review regression: manifest-ladder adoption happens before the
+    model swap, so a reload that FAILS at warmup must roll the serving
+    limits back with the model — the surviving generation keeps its
+    max_batch, and request sizes that were valid a second ago stay
+    valid."""
+    import numpy
+    good = _source_with_manifest((1, 2, 4))
+    engine = InferenceEngine(good)          # warmup ok (dropout)
+    assert engine.buckets == (1, 2, 4) and engine.max_batch == 4
+    # a source whose manifest shrinks the ladder AND whose model dies
+    # at warmup (weights mismatch the declared sample shape -> trace
+    # error, past structural validation)
+    bad_manifest = {
+        "format": 1,
+        "layers": [
+            {"type": "all2all", "name": "l0",
+             "arrays": {"weights": "w.npy", "bias": "b.npy"},
+             "include_bias": True, "weights_transposed": True}],
+        "input_sample_shape": [5],
+        "serving": {"buckets": [1], "max_batch": 1,
+                    "sample_shape": [5]},
+    }
+    bad_arrays = {"w.npy": numpy.eye(3, dtype=numpy.float32),
+                  "b.npy": numpy.zeros(3, numpy.float32)}
+    with pytest.raises(Exception):
+        engine.load((bad_manifest, bad_arrays))
+    # still serving generation 1 with ITS limits
+    assert engine.version == 1
+    assert engine.buckets == (1, 2, 4)
+    assert engine.max_batch == 4
+    assert engine.stats()["warmup_manifest"]["buckets"] == [1, 2, 4]
+    y = engine.predict(numpy.zeros((3, 5), numpy.float32))
+    assert y.shape == (3, 5)
+
+
+def test_explicit_buckets_beat_recorded_manifest():
+    """An operator's explicit ladder choice must not be overridden by
+    the source's recorded manifest."""
+    engine = InferenceEngine(_source_with_manifest((1, 2)),
+                             max_batch=4, warmup=False)
+    assert engine.buckets == default_buckets(4)
+    engine = InferenceEngine(_source_with_manifest((1, 2)),
+                             buckets=(1, 4), warmup=False)
+    assert engine.buckets == (1, 4)

@@ -1,0 +1,379 @@
+"""Multi-model serving control plane over REAL HTTP
+(znicz_tpu/serving/registry.py + continuous.py + server.py): per-model
+routing bit-identical to each engine's in-process forward, unknown
+model 404, LRU eviction + lazy re-warm under a device-memory budget,
+failed-reload rollback scoped to one model, per-model /healthz truth,
+admin add/remove, and per-model telemetry labels on /metrics."""
+
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+import zipfile
+
+import numpy
+import pytest
+
+from znicz_tpu.core import telemetry
+from znicz_tpu.serving import (ModelRegistry, ServingServer,
+                               UnknownModelError)
+
+
+def _fc_source(n_in, n_out, seed, n_hidden=8):
+    """A deterministic little tanh->softmax FC model as an in-memory
+    ``(manifest, arrays)`` engine source."""
+    r = numpy.random.RandomState(seed)
+    manifest = {
+        "format": 1,
+        "layers": [
+            {"type": "all2all_tanh", "name": "fc0",
+             "arrays": {"weights": "w0.npy", "bias": "b0.npy"},
+             "include_bias": True, "weights_transposed": True},
+            {"type": "softmax", "name": "out",
+             "arrays": {"weights": "w1.npy", "bias": "b1.npy"},
+             "include_bias": True, "weights_transposed": True},
+        ],
+        "input_sample_shape": [n_in],
+    }
+    arrays = {
+        "w0.npy": r.randn(n_in, n_hidden).astype(numpy.float32),
+        "b0.npy": r.randn(n_hidden).astype(numpy.float32),
+        "w1.npy": r.randn(n_hidden, n_out).astype(numpy.float32),
+        "b1.npy": r.randn(n_out).astype(numpy.float32),
+    }
+    return manifest, arrays
+
+
+def _write_package(path, source):
+    """Write an in-memory source as a deployment-package zip (the
+    on-disk form the admin add/reload endpoints take)."""
+    manifest, arrays = source
+    with zipfile.ZipFile(str(path), "w") as zf:
+        zf.writestr("manifest.json", json.dumps(manifest))
+        for fname, arr in arrays.items():
+            buf = io.BytesIO()
+            numpy.save(buf, arr)
+            zf.writestr(fname, buf.getvalue())
+    return str(path)
+
+
+@pytest.fixture
+def two_model_server():
+    """A warmed two-model registry behind a ServingServer (owned
+    continuous batcher), with telemetry on."""
+    telemetry.enable()
+    telemetry.reset()
+    registry = ModelRegistry(
+        models={"alpha": _fc_source(4, 3, seed=1),
+                "beta": _fc_source(6, 2, seed=2)},
+        max_batch=8)
+    server = ServingServer(registry=registry).start()
+    try:
+        yield server, registry, "http://%s:%d" % (server.host,
+                                                  server.port)
+    finally:
+        server.stop()
+
+
+def _request(url, doc=None, method=None, headers=None):
+    """(status, payload) with error replies decoded, not raised."""
+    data = json.dumps(doc).encode() if doc is not None else None
+    req = urllib.request.Request(
+        url, data,
+        dict({"Content-Type": "application/json"}, **(headers or {})),
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_mixed_model_traffic_bit_identical(two_model_server):
+    """Interleaved mixed-model traffic answers BIT-identically to each
+    engine's own in-process forward (serial phase — each request
+    dispatches at its own shape bucket, the apples-to-apples
+    executable), then a concurrent storm pins routing under
+    coalescing: outputs carry each model's own head width and match
+    the in-process forward to f32 resolution (a coalesced request may
+    ride a LARGER bucket's executable, where XLA's vectorization can
+    legally shift the last ulp)."""
+    server, registry, base = two_model_server
+    rng = numpy.random.RandomState(3)
+    inputs = {"alpha": [rng.uniform(-1, 1, (1 + i % 5, 4))
+                        .astype(numpy.float32) for i in range(12)],
+              "beta": [rng.uniform(-1, 1, (1 + i % 7, 6))
+                       .astype(numpy.float32) for i in range(12)]}
+    expected = {m: [registry.engine(m).predict(x) for x in xs]
+                for m, xs in inputs.items()}
+    # phase 1: serial, alternating models and routing styles
+    for i in range(12):
+        for m in ("alpha", "beta"):
+            x = inputs[m][i]
+            if i % 2 == 0:
+                status, doc = _request(base + "/predict/" + m,
+                                       {"inputs": x.tolist()})
+            else:
+                status, doc = _request(base + "/predict",
+                                       {"inputs": x.tolist(),
+                                        "model": m})
+            assert status == 200, doc
+            assert doc["model"] == m
+            assert numpy.array_equal(
+                numpy.asarray(doc["outputs"], numpy.float32),
+                expected[m][i]), (m, i)
+    # phase 2: concurrent storm — coalescing across requests, never
+    # across models (each reply has its model's head width)
+    results = {}
+    errors = []
+
+    def client(model, i):
+        try:
+            status, doc = _request(
+                base + "/predict/" + model,
+                {"inputs": inputs[model][i].tolist()})
+            assert status == 200, doc
+            results[(model, i)] = numpy.asarray(doc["outputs"],
+                                                numpy.float32)
+        except Exception as e:  # noqa: BLE001 - asserted below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(m, i))
+               for m in ("alpha", "beta") for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    for m, width in (("alpha", 3), ("beta", 2)):
+        for i in range(12):
+            got = results[(m, i)]
+            assert got.shape == (len(inputs[m][i]), width), (m, i)
+            numpy.testing.assert_allclose(
+                got, expected[m][i], rtol=2e-6, atol=1e-7,
+                err_msg="%s[%d]" % (m, i))
+
+
+def test_unknown_model_404(two_model_server):
+    server, registry, base = two_model_server
+    x = [[0.0, 0.0, 0.0, 0.0]]
+    status, doc = _request(base + "/predict/ghost", {"inputs": x})
+    assert status == 404 and "ghost" in doc["error"]
+    status, doc = _request(base + "/predict",
+                           {"inputs": x, "model": "ghost"})
+    assert status == 404
+    status, _ = _request(base + "/healthz/ghost")
+    assert status == 404
+    status, _ = _request(base + "/models/ghost", method="DELETE")
+    assert status == 404
+    # in-process resolution throws the typed error
+    with pytest.raises(UnknownModelError):
+        registry.engine("ghost")
+
+
+def test_lru_eviction_and_lazy_rewarm(two_model_server):
+    """Under a budget that fits ONE model, serving model B evicts cold
+    model A (device params + executables released); the next request
+    to A lazily restores it on the routing path — bit-identical
+    answers, re-warmed buckets, and the eviction metered."""
+    server, registry, base = two_model_server
+    rng = numpy.random.RandomState(4)
+    xa = rng.uniform(-1, 1, (3, 4)).astype(numpy.float32)
+    xb = rng.uniform(-1, 1, (3, 6)).astype(numpy.float32)
+    want_a = registry.engine("alpha").predict(xa)
+    want_b = registry.engine("beta").predict(xb)
+    one_model = max(registry._entries[n].engine.device_bytes
+                    for n in ("alpha", "beta"))
+    registry._budget_override = one_model + 1
+    # serving beta makes alpha the LRU victim
+    status, doc = _request(base + "/predict/beta",
+                           {"inputs": xb.tolist()})
+    assert status == 200
+    ea = registry._entries["alpha"].engine
+    eb = registry._entries["beta"].engine
+    assert not ea.resident and ea.warm_buckets == ()
+    assert eb.resident
+    assert registry.stats()["memory"]["evictions"] >= 1
+    assert telemetry.counter(
+        "serving.evictions.model_alpha").value >= 1
+    # evicted model still counts as loaded (version intact) but not
+    # ready — /healthz reports the degraded truth (see dedicated test)
+    assert ea.version == 1 and not ea.ready
+    # lazy re-warm: a request to the evicted model restores it on the
+    # routing path and answers bit-identically
+    status, doc = _request(base + "/predict/alpha",
+                           {"inputs": xa.tolist()})
+    assert status == 200
+    assert numpy.array_equal(
+        numpy.asarray(doc["outputs"], numpy.float32), want_a)
+    assert ea.resident and len(ea.warm_buckets) == len(ea.buckets)
+    # ... and the restore pushed beta out (the budget still holds)
+    assert not eb.resident
+    status, doc = _request(base + "/predict/beta",
+                           {"inputs": xb.tolist()})
+    assert status == 200
+    assert numpy.array_equal(
+        numpy.asarray(doc["outputs"], numpy.float32), want_b)
+
+
+def test_failed_reload_rolls_back_scoped(two_model_server, tmp_path):
+    """A failed hot-reload of ONE model leaves that model serving its
+    previous generation and never touches the other — over the same
+    admin HTTP surface an operator would use."""
+    server, registry, base = two_model_server
+    rng = numpy.random.RandomState(5)
+    xa = rng.uniform(-1, 1, (2, 4)).astype(numpy.float32)
+    xb = rng.uniform(-1, 1, (2, 6)).astype(numpy.float32)
+    want_a = registry.engine("alpha").predict(xa)
+    want_b = registry.engine("beta").predict(xb)
+    v_alpha = registry.engine("alpha").version
+    v_beta = registry.engine("beta").version
+
+    # reload alpha from garbage: not a zip, not a snapshot
+    bad = tmp_path / "garbage.zip"
+    bad.write_bytes(b"this is not a model")
+    status, doc = _request(base + "/models/alpha",
+                           {"path": str(bad)})
+    assert status == 400
+
+    # alpha still serves its old generation, bit-identically
+    assert registry.engine("alpha").version == v_alpha
+    status, doc = _request(base + "/predict/alpha",
+                           {"inputs": xa.tolist()})
+    assert status == 200
+    assert numpy.array_equal(
+        numpy.asarray(doc["outputs"], numpy.float32), want_a)
+    # beta untouched
+    assert registry.engine("beta").version == v_beta
+    status, doc = _request(base + "/predict/beta",
+                           {"inputs": xb.tolist()})
+    assert status == 200
+    assert numpy.array_equal(
+        numpy.asarray(doc["outputs"], numpy.float32), want_b)
+    # the registry's health never flinched
+    assert registry.ready
+    status, doc = _request(base + "/healthz")
+    assert status == 200 and doc["ready"] and not doc["degraded"]
+
+
+def test_healthz_per_model_truth(two_model_server):
+    """One broken (here: evicted, not yet restored) model must read
+    as DEGRADED — 200 with the per-model map — not as globally
+    healthy, and not as globally dead."""
+    server, registry, base = two_model_server
+    status, doc = _request(base + "/healthz")
+    assert status == 200 and doc["ready"] is True
+    assert doc["models"] == {"alpha": True, "beta": True}
+    # per-model probe endpoints
+    status, doc = _request(base + "/healthz/alpha")
+    assert status == 200 and doc["ready"]
+    # break exactly one model
+    registry._entries["alpha"].engine.evict()
+    status, doc = _request(base + "/healthz")
+    assert status == 200, "one broken model must not read globally dead"
+    assert doc["ready"] is False, \
+        "one broken model must not read globally healthy"
+    assert doc["degraded"] is True
+    assert doc["models"] == {"alpha": False, "beta": True}
+    status, doc = _request(base + "/healthz/alpha")
+    assert status == 503
+    # break the second too: NOW the replica is globally dead
+    registry._entries["beta"].engine.evict()
+    status, doc = _request(base + "/healthz")
+    assert status == 503 and doc["degraded"] is False
+
+
+def test_admin_add_remove_over_http(two_model_server, tmp_path):
+    """POST /models/<name> hot-adds a packaged model (routable only
+    after load + warmup); DELETE removes it; /models lists the
+    registry with memory + compile-cache stats."""
+    server, registry, base = two_model_server
+    pkg = _write_package(tmp_path / "gamma.zip",
+                         _fc_source(5, 4, seed=9))
+    status, doc = _request(base + "/models/gamma", {"path": pkg})
+    assert status == 200 and doc["model_version"] == 1
+    assert sorted(doc["models"]) == ["alpha", "beta", "gamma"]
+    x = numpy.random.RandomState(6).uniform(
+        -1, 1, (2, 5)).astype(numpy.float32)
+    status, doc = _request(base + "/predict/gamma",
+                           {"inputs": x.tolist()})
+    assert status == 200
+    want = registry.engine("gamma").predict(x)
+    assert numpy.array_equal(
+        numpy.asarray(doc["outputs"], numpy.float32), want)
+    # the listing carries per-model stats + the registry-level blocks
+    status, doc = _request(base + "/models")
+    assert status == 200
+    assert set(doc["models"]) == {"alpha", "beta", "gamma"}
+    assert doc["models"]["gamma"]["ready"] is True
+    assert "memory" in doc and "compile_cache" in doc
+    # remove it: routing 404s, the others keep serving
+    status, doc = _request(base + "/models/gamma", method="DELETE")
+    assert status == 200
+    status, _ = _request(base + "/predict/gamma",
+                         {"inputs": x.tolist()})
+    assert status == 404
+    assert registry.names() == ["alpha", "beta"]
+
+
+def test_per_model_metrics_do_not_collide(two_model_server):
+    """The satellite contract: prediction counters / model-version
+    gauges / journal events carry the model label, so two models'
+    series never collide on one /metrics page."""
+    server, registry, base = two_model_server
+    rng = numpy.random.RandomState(8)
+    for model, width in (("alpha", 4), ("beta", 6)):
+        x = rng.uniform(-1, 1, (2, width)).astype(numpy.float32)
+        status, _ = _request(base + "/predict/" + model,
+                             {"inputs": x.tolist()})
+        assert status == 200
+    with urllib.request.urlopen(base + "/metrics",
+                                timeout=30) as resp:
+        text = resp.read().decode()
+    assert "model_alpha" in text and "model_beta" in text
+    # both models' bucket-2 prediction counters exist independently
+    a = telemetry.counter(telemetry.labeled(
+        "serving.predictions", bucket=2, model="alpha")).value
+    b = telemetry.counter(telemetry.labeled(
+        "serving.predictions", bucket=2, model="beta")).value
+    assert a >= 1 and b >= 1
+    # journal events name the model
+    events = [e for e in telemetry.journal_events()
+              if e.get("kind") == "registry.add"]
+    assert {e.get("model") for e in events} >= {"alpha", "beta"}
+
+
+def test_statusz_carries_registry_and_cache_blocks(two_model_server):
+    server, registry, base = two_model_server
+    status, doc = _request(base + "/statusz")
+    assert status == 200
+    assert set(doc["registry"]["models"]) == {"alpha", "beta"}
+    assert "memory" in doc["registry"]
+    assert "compile_cache" in doc["registry"]
+    assert "queued_rows" in doc
+    assert doc["ready"] is True
+
+
+def test_registry_membership_rules():
+    """Name validation, default-model management, duplicate handling —
+    the in-process registry contract (no HTTP needed)."""
+    registry = ModelRegistry(max_batch=4)
+    with pytest.raises(ValueError, match="URL-routable"):
+        registry.add("bad/name", _fc_source(3, 2, seed=1))
+    with pytest.raises(UnknownModelError):
+        registry.engine()            # empty registry has no default
+    assert not registry.ready        # zero models is NOT ready
+    registry.add("a", _fc_source(3, 2, seed=1))
+    assert registry.default == "a"
+    registry.add("b", _fc_source(3, 2, seed=2))
+    assert registry.default == "a"   # first added stays default
+    assert len(registry) == 2 and "a" in registry
+    registry.default = "b"
+    assert registry.engine().name == "b"
+    with pytest.raises(UnknownModelError):
+        registry.default = "ghost"
+    registry.remove("b")             # default re-points
+    assert registry.default == "a"
+    registry.remove("a")
+    assert registry.default is None

@@ -1,0 +1,312 @@
+"""ContinuousBatcher contract (znicz_tpu/serving/continuous.py):
+slot-based admission (no barrier windows), cross-model round-robin
+fairness, coalescing within a (model, shape) lane, the carried-over
+backpressure/deadline/drain contracts, and slot survival of a failing
+dispatch."""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from znicz_tpu.serving.batcher import (BatcherStoppedError,
+                                       QueueFullError,
+                                       RequestTimeoutError)
+from znicz_tpu.serving.continuous import ContinuousBatcher
+
+
+class RecordingModel(object):
+    """Fake engine: y = x + 1, recording (rows, thread) per dispatch.
+    ``gate`` (when cleared) blocks dispatches so tests can pile up a
+    queue deterministically."""
+
+    def __init__(self, max_batch=8, fail=False):
+        self.max_batch = max_batch
+        self.sample_shape = None
+        self.batches = []
+        self.order = []
+        self.gate = threading.Event()
+        self.gate.set()
+        self.fail = fail
+        self.lock = threading.Lock()
+
+    def bucket_for(self, n):
+        return self.max_batch
+
+    def predict(self, x):
+        self.gate.wait(10)
+        if self.fail:
+            raise RuntimeError("dispatch boom")
+        with self.lock:
+            self.batches.append(len(x))
+        return numpy.asarray(x) + 1.0
+
+
+class FakeRegistry(object):
+    """Just enough of ModelRegistry for the batcher: named engines +
+    a default."""
+
+    def __init__(self, engines, default=None):
+        self.engines = engines
+        self.default = default if default is not None else \
+            sorted(engines)[0]
+        self.resolved = []
+
+    def names(self):
+        return sorted(self.engines)
+
+    def engine(self, name=None):
+        key = name if name is not None else self.default
+        from znicz_tpu.serving.registry import UnknownModelError
+        if key not in self.engines:
+            raise UnknownModelError(key, self.engines)
+        self.resolved.append(key)
+        return self.engines[key]
+
+
+def _rows(n, width=3, base=0.0):
+    return numpy.arange(n * width, dtype=numpy.float64).reshape(
+        n, width) + base
+
+
+def test_idle_request_dispatches_immediately():
+    """Continuous batching's defining behavior: an idle server serves
+    a lone request NOW (batch of 1) — there is no barrier window to
+    wait out.  A 10 s window-style delay would time this test out."""
+    model = RecordingModel()
+    b = ContinuousBatcher(model, max_inflight=2, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        t0 = time.monotonic()
+        y = b.submit(_rows(1)).result(timeout=5)
+        assert time.monotonic() - t0 < 2.0
+        assert numpy.array_equal(y, _rows(1) + 1.0)
+        assert model.batches == [1]
+    finally:
+        b.stop()
+
+
+def test_queued_requests_coalesce_when_slots_busy():
+    """While every slot is busy, arrivals pool in the lane and the
+    next free slot takes them as ONE batch (scattered back
+    per-request)."""
+    model = RecordingModel(max_batch=8)
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        model.gate.clear()
+        first = b.submit(_rows(1, base=100.0))  # occupies the slot
+        time.sleep(0.05)
+        rest = [b.submit(_rows(1, base=float(i))) for i in range(4)]
+        time.sleep(0.05)
+        model.gate.set()
+        assert numpy.array_equal(first.result(timeout=5),
+                                 _rows(1, base=100.0) + 1.0)
+        for i, f in enumerate(rest):
+            assert numpy.array_equal(f.result(timeout=5),
+                                     _rows(1, base=float(i)) + 1.0)
+        # first dispatch ran alone; the 4 queued ones coalesced
+        assert model.batches[0] == 1
+        assert sum(model.batches) == 5
+        assert len(model.batches) == 2
+    finally:
+        b.stop()
+
+
+def test_round_robin_fairness_across_models():
+    """A flood against one model cannot starve another: the next free
+    slot picks models cyclically, so model b's lone request rides the
+    very next dispatch after the flood's current one."""
+    order = []
+
+    class TaggedModel(RecordingModel):
+        def __init__(self, tag):
+            super(TaggedModel, self).__init__()
+            self.tag = tag
+
+        def predict(self, x):
+            y = super(TaggedModel, self).predict(x)
+            order.append(self.tag)
+            return y
+
+    slow = TaggedModel("flood")
+    quick = TaggedModel("lone")
+    reg = FakeRegistry({"flood": slow, "lone": quick})
+    b = ContinuousBatcher(reg, max_inflight=1, queue_limit=1024,
+                          timeout_ms=0).start()
+    try:
+        slow.gate.clear()
+        quick.gate.clear()
+        floods = [b.submit(_rows(1), model="flood")
+                  for _ in range(20)]
+        time.sleep(0.05)
+        lone = b.submit(_rows(1), model="lone")
+        time.sleep(0.05)
+        slow.gate.set()
+        quick.gate.set()
+        lone.result(timeout=5)
+        for f in floods:
+            f.result(timeout=5)
+        # the first dispatch took a flood request (the lane was empty
+        # when it arrived); the round-robin hands the NEXT free slot
+        # to "lone".  Strict cross-model FIFO would drain all 19
+        # queued flood rows (3 more dispatches) first.
+        assert "lone" in order
+        assert order.index("lone") <= 2, order
+    finally:
+        b.stop()
+
+
+def test_shape_lanes_never_mix():
+    """Different trailing shapes stay in separate lanes — a dispatch
+    never concatenates 3-wide with 5-wide requests."""
+    seen = []
+
+    def predict(x):
+        seen.append(numpy.asarray(x).shape)
+        return numpy.asarray(x)
+
+    predict.max_batch = 8
+    b = ContinuousBatcher(predict, max_inflight=1, queue_limit=64,
+                          timeout_ms=0)
+    b.start()
+    try:
+        f1 = b.submit(_rows(2, width=3))
+        f2 = b.submit(_rows(2, width=5))
+        f1.result(timeout=5)
+        f2.result(timeout=5)
+        assert sorted(s[1] for s in seen) == [3, 5]
+    finally:
+        b.stop()
+
+
+def test_queue_limit_rejects():
+    model = RecordingModel()
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=4,
+                          timeout_ms=0).start()
+    try:
+        model.gate.clear()
+        b.submit(_rows(1))          # in the slot or queued
+        time.sleep(0.05)
+        b.submit(_rows(4))          # fills the queue
+        with pytest.raises(QueueFullError):
+            b.submit(_rows(1))
+        model.gate.set()
+    finally:
+        b.stop()
+
+
+def test_deadline_expires_in_queue():
+    """A request whose deadline passed while queued gets 504-class
+    rejection without wasting a dispatch on it."""
+    model = RecordingModel()
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        model.gate.clear()
+        blocker = b.submit(_rows(1))
+        time.sleep(0.05)
+        doomed = b.submit(_rows(1), timeout_ms=30.0)
+        time.sleep(0.2)             # deadline passes while queued
+        model.gate.set()
+        blocker.result(timeout=5)
+        with pytest.raises(RequestTimeoutError):
+            doomed.result(timeout=5)
+        # the expired request never reached the model
+        assert sum(model.batches) == 1
+    finally:
+        b.stop()
+
+
+def test_failing_dispatch_fails_batch_not_worker():
+    """A dispatch exception fails that batch's futures; the slot
+    thread survives and serves the next request."""
+    model = RecordingModel()
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        model.fail = True
+        with pytest.raises(RuntimeError, match="dispatch boom"):
+            b.submit(_rows(1)).result(timeout=5)
+        model.fail = False
+        y = b.submit(_rows(2)).result(timeout=5)
+        assert numpy.array_equal(y, _rows(2) + 1.0)
+    finally:
+        b.stop()
+
+
+def test_stop_flush_serves_queue_submit_after_raises():
+    """stop(flush=True) — the graceful-drain path — serves everything
+    queued before the workers exit; a submit racing the stop raises
+    BatcherStoppedError (the server's honest 503)."""
+    model = RecordingModel()
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    model.gate.clear()
+    futures = [b.submit(_rows(1, base=float(i))) for i in range(5)]
+    stopper = threading.Thread(target=b.stop, kwargs={"flush": True})
+    stopper.start()
+    time.sleep(0.05)
+    model.gate.set()
+    stopper.join(timeout=10)
+    assert not stopper.is_alive()
+    for i, f in enumerate(futures):
+        assert numpy.array_equal(f.result(timeout=1),
+                                 _rows(1, base=float(i)) + 1.0)
+    with pytest.raises(BatcherStoppedError):
+        b.submit(_rows(1))
+
+
+def test_unknown_model_raises_at_submit():
+    from znicz_tpu.serving.registry import UnknownModelError
+    reg = FakeRegistry({"only": RecordingModel()})
+    b = ContinuousBatcher(reg, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        with pytest.raises(UnknownModelError):
+            b.submit(_rows(1), model="ghost")
+        # default routing still works
+        y = b.submit(_rows(1)).result(timeout=5)
+        assert numpy.array_equal(y, _rows(1) + 1.0)
+    finally:
+        b.stop()
+
+
+def test_stale_lane_cap_never_wedges_a_slot():
+    """Review regression: a queued request larger than its lane's
+    (stale — the engine's cap shrank under it) coalescing cap must
+    still be TAKEN — dispatched alone and answered — not left wedging
+    the slot in an empty-take spin with its future never resolving."""
+    model = RecordingModel(max_batch=8)
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        model.gate.clear()
+        blocker = b.submit(_rows(1))        # occupies the slot
+        time.sleep(0.05)
+        big = b.submit(_rows(6))            # valid under cap 8, queued
+        model.max_batch = 4                 # hot shrink (reload)
+        small = b.submit(_rows(1))          # refreshes the lane cap
+        model.gate.set()
+        blocker.result(timeout=5)
+        # the 6-row head exceeds the refreshed cap 4: it must still be
+        # served (alone), and the request behind it must not starve
+        assert numpy.array_equal(big.result(timeout=5),
+                                 _rows(6) + 1.0)
+        assert numpy.array_equal(small.result(timeout=5),
+                                 _rows(1) + 1.0)
+        assert 6 in model.batches
+    finally:
+        b.stop()
+
+
+def test_oversize_request_rejected_loudly():
+    model = RecordingModel(max_batch=4)
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        with pytest.raises(ValueError, match="max_batch"):
+            b.submit(_rows(5))
+    finally:
+        b.stop()

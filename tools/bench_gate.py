@@ -41,8 +41,18 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: stamped throughput keys gated when present in both rounds
+#: (higher is better; a drop past the threshold fails)
 GATED = ("value", "f32_images_per_sec", "cifar_caffe_images_per_sec",
-         "wide_conv_images_per_sec")
+         "wide_conv_images_per_sec",
+         # the serving block (ISSUE 8): loadgen steady req/s and
+         # goodput under 3x overload regress CI exactly like training
+         # throughput does
+         "serving_loadgen_requests_per_sec",
+         "serving_goodput_under_overload_pct")
+
+#: latency-style keys (lower is better): a RISE past the threshold
+#: fails; zero/missing when the previous round had a number fails too
+GATED_INVERSE = ("serving_loadgen_p99_ms",)
 
 
 def _payload(doc):
@@ -94,6 +104,30 @@ def compare(new, old, threshold=0.10):
                        "FAIL" if failed else "ok",
                        "new": nv, "old": ov,
                        "ratio": round(ratio, 4)})
+    for key in GATED_INVERSE:
+        nv, ov = new.get(key), old.get(key)
+        if not ov:
+            checks.append({"metric": key, "status": "skipped",
+                           "new": nv, "old": ov})
+            continue
+        if not nv:
+            # the serving tier stopped producing a latency number —
+            # same 100%-regression rule as the throughput keys
+            ok = False
+            checks.append({"metric": key, "status": "FAIL",
+                           "new": nv, "old": ov, "ratio": 0.0})
+            continue
+        # lower is better: gate the RISE.  Latency is noisier than
+        # throughput (shared hosts), so the band is 2x the throughput
+        # threshold — a >2x-threshold p99 regression still fails.
+        ratio = float(nv) / float(ov)
+        failed = ratio > 1.0 + 2 * threshold
+        ok = ok and not failed
+        checks.append({"metric": key, "status":
+                       "FAIL" if failed else "ok",
+                       "new": nv, "old": ov,
+                       "ratio": round(ratio, 4),
+                       "direction": "lower_is_better"})
     return ok, {"threshold": threshold, "checks": checks,
                 "ok": ok}
 
@@ -123,17 +157,44 @@ def selftest(threshold=0.10):
     ok_wobble, _ = compare(wobble, old, threshold)
     improved = {k: v * 1.2 for k, v in base.items()}
     ok_up, _ = compare(improved, old, threshold)
-    if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up:
+    # the serving block's gates, proven on a synthetic round (older
+    # committed rounds predate the serving stamps): a req/s drop, a
+    # p99 RISE and a zeroed p99 must all fail; small wobble passes
+    serving_old = {"serving_loadgen_requests_per_sec": 500.0,
+                   "serving_loadgen_p99_ms": 20.0,
+                   "serving_goodput_under_overload_pct": 60.0}
+    srv_drop, _ = compare(
+        dict(serving_old, serving_loadgen_requests_per_sec=400.0),
+        serving_old, threshold)
+    srv_p99_up, _ = compare(
+        dict(serving_old, serving_loadgen_p99_ms=20.0 *
+             (1.0 + 2 * threshold) * 1.5),
+        serving_old, threshold)
+    srv_p99_zero, _ = compare(
+        dict(serving_old, serving_loadgen_p99_ms=0.0),
+        serving_old, threshold)
+    srv_wobble, _ = compare(
+        dict(serving_old,
+             serving_loadgen_requests_per_sec=500.0 * 0.95,
+             serving_loadgen_p99_ms=20.0 * (1.0 + threshold)),
+        serving_old, threshold)
+    if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
+            or srv_drop or srv_p99_up or srv_p99_zero \
+            or not srv_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
-              "improvement_passed=%s"
+              "improvement_passed=%s serving_drop_rejected=%s "
+              "serving_p99_rise_rejected=%s "
+              "serving_p99_zero_rejected=%s serving_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
-                 ok_up))
+                 ok_up, not srv_drop, not srv_p99_up,
+                 not srv_p99_zero, srv_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
-          "improvement pass (threshold %.0f%%)"
-          % (os.path.basename(path), key, 100 * threshold))
+          "improvement pass; serving req/s drop, p99 rise and p99 "
+          "zero-stamp rejected, serving wobble passes (threshold "
+          "%.0f%%)" % (os.path.basename(path), key, 100 * threshold))
     return 0
 
 

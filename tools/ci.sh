@@ -27,7 +27,7 @@ echo "== bench gate selftest (injected >10% drop must fail the gate)"
 python tools/bench_gate.py --selftest
 echo "== chaos smoke (SIGKILL mid-epoch -> resume bit-identical; breaker opens -> recovers)"
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
-echo "== serving smoke (wine snapshot over HTTP, 64 concurrent, 0 recompiles)"
+echo "== serving smoke (wine over HTTP, 64 concurrent, 0 recompiles; then 2-model registry, interleaved traffic + seeded loadgen SLO assertion)"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 if [ "$1" = "full" ]; then
     echo "== tests (full lane)"

@@ -1,0 +1,402 @@
+"""Open-loop load generator for the serving control plane.
+
+Closed-loop clients (bench.py's original ``--serving`` harness, the
+serving smoke) can never observe overload: each client waits for its
+answer before sending the next request, so the offered rate gracefully
+degrades to whatever the server sustains and p99 looks flattering.
+Production traffic does not wait.  This generator is **open-loop**: a
+seeded Poisson process schedules arrivals ahead of time and fires them
+at their scheduled instants whether or not earlier requests completed —
+when the server falls behind, latency (measured from the *scheduled*
+arrival, client-side queueing included) and the error mix show it
+honestly.
+
+* **Seeded** (``--seed``): the arrival schedule, the model mix and the
+  batch-size mix are all drawn from one ``numpy.random.RandomState`` —
+  two runs with the same seed offer byte-identical traffic, so CI can
+  assert an SLO on a fixed workload.
+* **Mixed models**: each arrival routes to one of the registry's
+  models (weighted draw), exercising cross-model fairness and the
+  per-model metric labels.
+* **Mixed batch shapes**: request sizes draw log-uniformly over
+  ``1..max_batch``, sweeping the engine's whole bucket ladder.
+* **SLO report**: requests per second offered vs achieved, latency
+  p50/p90/p99, and **goodput** — completed-OK responses within
+  ``slo_ms`` (``root.common.serving.slo_ms``) per second.  Under
+  overload goodput is the number that matters: a server answering
+  everything late has throughput but no goodput.
+
+Two runners share the report:
+
+* :func:`run` drives any ``submit(model, x, timeout_ms) -> Future``
+  (in process — ``bench.py --serving`` wires it straight into a
+  :class:`~znicz_tpu.serving.continuous.ContinuousBatcher`);
+* the CLI drives a live server over HTTP, discovering the model fleet
+  and sample shapes from ``GET /models``::
+
+      python tools/loadgen.py http://127.0.0.1:8899 \\
+          --rate 200 --duration 10 --seed 7 --assert-goodput-pct 90
+
+Exit codes (CLI): 0 = ran (and SLO assertion held, when given),
+1 = ``--assert-goodput-pct`` violated, 2 = usage error.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class ModelSpec(object):
+    """One routable target: ``name`` (None = the server's default
+    route), per-sample input shape, the largest request to draw, and
+    its share of the traffic mix."""
+
+    __slots__ = ("name", "sample_shape", "max_batch", "weight")
+
+    def __init__(self, name, sample_shape, max_batch=8, weight=1.0):
+        self.name = name
+        self.sample_shape = tuple(int(d) for d in sample_shape)
+        self.max_batch = max(1, int(max_batch))
+        self.weight = float(weight)
+
+
+def make_plan(rate_rps, duration_s, seed, models):
+    """The deterministic traffic tape: ``[(t, model_index, rows)]``
+    sorted by arrival time ``t`` (seconds from start).  Poisson
+    arrivals at ``rate_rps``; the model is a weighted draw; ``rows``
+    is log-uniform over ``1..max_batch`` (every bucket sees traffic,
+    small requests dominate — the realistic shape mix)."""
+    rng = numpy.random.RandomState(int(seed))
+    weights = numpy.array([m.weight for m in models], dtype=float)
+    weights = weights / weights.sum()
+    plan = []
+    t = float(rng.exponential(1.0 / rate_rps))
+    while t < duration_s:
+        mi = int(rng.choice(len(models), p=weights))
+        # one octave past the ladder top, then clamp: the clamp mass
+        # is what gives max_batch (the largest bucket) its share
+        hi = math.log2(models[mi].max_batch) if \
+            models[mi].max_batch > 1 else 0.0
+        rows = int(2 ** rng.uniform(0.0, hi + 1.0))
+        rows = max(1, min(rows, models[mi].max_batch))
+        plan.append((t, mi, rows))
+        t += float(rng.exponential(1.0 / rate_rps))
+    return plan
+
+
+def make_inputs(models, seed):
+    """One ``(max_batch,) + sample_shape`` array per model (seeded);
+    a request of ``rows`` rows is a leading slice — the generator
+    measures the serving stack, not ``numpy.random``."""
+    rng = numpy.random.RandomState(int(seed) + 1)
+    return [rng.uniform(-1.0, 1.0, (m.max_batch,) + m.sample_shape)
+            .astype(numpy.float32) for m in models]
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    return float(numpy.percentile(numpy.asarray(values), q))
+
+
+def _classify(exc):
+    """HTTP-status classification of a failure — in-process exceptions
+    map exactly as the ServingServer's error handlers map them; HTTP
+    errors carry their status verbatim."""
+    from znicz_tpu.serving.batcher import (BatcherStoppedError,
+                                           QueueFullError,
+                                           RequestTimeoutError)
+    from znicz_tpu.serving.breaker import CircuitOpenError
+    from znicz_tpu.serving.registry import UnknownModelError
+    if isinstance(exc, _HttpStatusError):
+        return exc.code
+    if isinstance(exc, QueueFullError):
+        return 429
+    if isinstance(exc, RequestTimeoutError):
+        return 504
+    if isinstance(exc, (CircuitOpenError, BatcherStoppedError)):
+        return 503
+    if isinstance(exc, UnknownModelError):
+        return 404
+    if isinstance(exc, (ValueError, TypeError)):
+        return 400
+    return 500
+
+
+def run(plan, models, submit, slo_ms, duration_s, seed,
+        timeout_ms=None, settle_s=30.0):
+    """Fire ``plan`` open-loop through ``submit(model_name, x,
+    timeout_ms) -> concurrent.futures.Future`` and return the SLO
+    report.  Latency is measured from each request's SCHEDULED arrival
+    — a dispatcher running late (server backpressure propagating into
+    the client) counts against the request, exactly as a real user
+    would experience it."""
+    inputs = make_inputs(models, seed)
+    lock = threading.Lock()
+    records = []          # (model_index, rows, latency_s, status)
+    outstanding = threading.Semaphore(0)
+    n_async = 0
+
+    def _finish(rec_base, scheduled_wall, future):
+        done = time.monotonic()
+        exc = future.exception()
+        status = 200 if exc is None else _classify(exc)
+        with lock:
+            records.append(rec_base + (done - scheduled_wall, status))
+        outstanding.release()
+
+    t0 = time.monotonic()
+    behind_max = 0.0
+    for t, mi, rows in plan:
+        scheduled_wall = t0 + t
+        now = time.monotonic()
+        if scheduled_wall > now:
+            time.sleep(scheduled_wall - now)
+        else:
+            behind_max = max(behind_max, now - scheduled_wall)
+        x = inputs[mi][:rows]
+        try:
+            future = submit(models[mi].name, x, timeout_ms)
+        except Exception as e:  # noqa: BLE001 - synchronous rejection
+            with lock:
+                records.append(
+                    (mi, rows, time.monotonic() - scheduled_wall,
+                     _classify(e)))
+            continue
+        n_async += 1
+        future.add_done_callback(
+            lambda f, rec=(mi, rows), sw=scheduled_wall:
+            _finish(rec, sw, f))
+    deadline = time.monotonic() + settle_s
+    for _ in range(n_async):
+        if not outstanding.acquire(timeout=max(
+                0.0, deadline - time.monotonic())):
+            break
+    wall_s = time.monotonic() - t0
+    return report(records, len(plan), duration_s, slo_ms, seed,
+                  models, behind_max, wall_s=wall_s)
+
+
+def report(records, scheduled, duration_s, slo_ms, seed, models,
+           dispatch_behind_max_s=0.0, wall_s=None):
+    """Aggregate per-request records into the SLO report dict.
+
+    ``achieved_rps``/``goodput_rps`` divide by the OFFERED window
+    ``duration_s`` (the open-loop convention); ``wall_rps`` divides by
+    the wall time to the LAST completion — under overload a backlog
+    drains after the offered window closes, and wall_rps is the honest
+    sustained-throughput number (use it to calibrate capacity)."""
+    slo_s = float(slo_ms) / 1e3
+    ok_lat = [r[2] for r in records if r[3] == 200]
+    good = sum(1 for r in records if r[3] == 200 and r[2] <= slo_s)
+    errors = {}
+    for r in records:
+        if r[3] != 200:
+            errors[str(r[3])] = errors.get(str(r[3]), 0) + 1
+    per_model = {}
+    for i, m in enumerate(models):
+        mine = [r for r in records if r[0] == i]
+        m_ok = [r[2] for r in mine if r[3] == 200]
+        per_model[m.name or "<default>"] = {
+            "requests": len(mine),
+            "ok": len(m_ok),
+            "rows": int(sum(r[1] for r in mine)),
+            "p50_ms": (round(_percentile(m_ok, 50) * 1e3, 3)
+                       if m_ok else None),
+            "p99_ms": (round(_percentile(m_ok, 99) * 1e3, 3)
+                       if m_ok else None),
+        }
+    out = {
+        "seed": int(seed),
+        "duration_s": round(float(duration_s), 3),
+        "slo_ms": float(slo_ms),
+        "scheduled": int(scheduled),
+        "completed": len(records),
+        "ok": len(ok_lat),
+        "errors": errors,
+        "offered_rps": round(scheduled / duration_s, 2),
+        "achieved_rps": round(len(ok_lat) / duration_s, 2),
+        "wall_s": (round(wall_s, 3) if wall_s else None),
+        "wall_rps": (round(len(ok_lat) / wall_s, 2)
+                     if wall_s else None),
+        "goodput_rps": round(good / duration_s, 2),
+        "goodput_pct": (round(100.0 * good / scheduled, 2)
+                        if scheduled else None),
+        "latency_ms": {
+            "p50": (round(_percentile(ok_lat, 50) * 1e3, 3)
+                    if ok_lat else None),
+            "p90": (round(_percentile(ok_lat, 90) * 1e3, 3)
+                    if ok_lat else None),
+            "p99": (round(_percentile(ok_lat, 99) * 1e3, 3)
+                    if ok_lat else None),
+            "max": (round(max(ok_lat) * 1e3, 3) if ok_lat else None),
+        },
+        "rows_ok": int(sum(r[1] for r in records if r[3] == 200)),
+        "dispatch_behind_max_ms": round(
+            dispatch_behind_max_s * 1e3, 3),
+        "per_model": per_model,
+    }
+    return out
+
+
+# -- HTTP mode -------------------------------------------------------------
+class DaemonPool(object):
+    """Minimal fixed-width thread pool over DAEMON threads returning
+    Futures.  concurrent.futures' ThreadPoolExecutor joins its
+    non-daemon workers at interpreter exit — a wedged server would
+    hang the CLI for the full HTTP timeout after the report printed.
+    Daemon workers let the process exit the moment main() returns."""
+
+    def __init__(self, max_workers):
+        import queue
+        self._q = queue.Queue()
+        for i in range(int(max_workers)):
+            t = threading.Thread(target=self._worker,
+                                 name="loadgen-%d" % i, daemon=True)
+            t.start()
+
+    def _worker(self):
+        while True:
+            fn, args, future = self._q.get()
+            if not future.set_running_or_notify_cancel():
+                continue
+            try:
+                future.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001 - to the future
+                future.set_exception(e)
+
+    def submit(self, fn, *args):
+        from concurrent.futures import Future
+        future = Future()
+        self._q.put((fn, args, future))
+        return future
+
+
+def discover_models(base_url, timeout=10.0):
+    """ModelSpecs from a live server's ``GET /models`` (the registry
+    stats payload).  A single-engine server reports one pseudo-model
+    named ``default`` — route it without a path segment."""
+    import urllib.request
+    with urllib.request.urlopen(base_url.rstrip("/") + "/models",
+                                timeout=timeout) as resp:
+        doc = json.loads(resp.read())
+    specs = []
+    for name in sorted(doc.get("models", {})):
+        stats = doc["models"][name]
+        shape = stats.get("sample_shape")
+        if not shape:
+            continue
+        buckets = stats.get("buckets") or [8]
+        specs.append(ModelSpec(
+            None if name == "default" else name, shape,
+            max_batch=int(buckets[-1])))
+    if not specs:
+        raise SystemExit(
+            "loadgen: %s/models reports no servable model with a "
+            "recorded sample shape" % base_url)
+    return specs
+
+
+def http_submit(base_url, pool):
+    """A ``submit(model, x, timeout_ms) -> Future`` over HTTP: each
+    request runs on the pool (open-loop up to the pool width; a full
+    pool shows up as scheduled-latency, never as a lost arrival)."""
+    import urllib.error
+    import urllib.request
+
+    def _do(model, x, timeout_ms):
+        path = "/predict" if model is None else "/predict/" + model
+        body = {"inputs": x.tolist()}
+        if timeout_ms:
+            body["timeout_ms"] = timeout_ms
+        req = urllib.request.Request(
+            base_url.rstrip("/") + path, json.dumps(body).encode(),
+            {"Content-Type": "application/json"})
+        wait = (timeout_ms / 1e3 + 65.0) if timeout_ms else 120.0
+        try:
+            with urllib.request.urlopen(req, timeout=wait) as resp:
+                json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            e.read()
+            raise _HttpStatusError(e.code)
+        return True
+
+    def submit(model, x, timeout_ms):
+        return pool.submit(_do, model, x, timeout_ms)
+
+    return submit
+
+
+class _HttpStatusError(Exception):
+    def __init__(self, code):
+        self.code = int(code)
+        super(_HttpStatusError, self).__init__("HTTP %d" % code)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python tools/loadgen.py",
+        description="Open-loop (Poisson) load generator against a "
+                    "znicz_tpu serving server; prints the SLO report "
+                    "as one JSON line.")
+    parser.add_argument("url", help="server base url, e.g. "
+                                    "http://127.0.0.1:8899")
+    parser.add_argument("--rate", type=float, default=100.0,
+                        help="offered arrivals per second")
+    parser.add_argument("--duration", type=float, default=10.0)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--slo-ms", type=float, default=None,
+                        help="goodput latency bound (default: "
+                             "root.common.serving.slo_ms)")
+    parser.add_argument("--timeout-ms", type=float, default=None,
+                        help="per-request deadline forwarded to the "
+                             "server")
+    parser.add_argument("--models", default=None,
+                        help="comma list restricting the discovered "
+                             "fleet (default: every servable model)")
+    parser.add_argument("--concurrency", type=int, default=64,
+                        help="HTTP worker pool width (the open-loop "
+                             "outstanding-request bound)")
+    parser.add_argument("--assert-goodput-pct", type=float,
+                        default=None,
+                        help="exit 1 when goodput%% lands below this "
+                             "(the CI SLO assertion)")
+    args = parser.parse_args(argv)
+
+    from znicz_tpu.core.config import root
+    slo_ms = (args.slo_ms if args.slo_ms is not None
+              else float(root.common.serving.get("slo_ms", 100.0)))
+    models = discover_models(args.url)
+    if args.models:
+        want = {m.strip() for m in args.models.split(",")}
+        models = [m for m in models if (m.name or "default") in want]
+        if not models:
+            parser.error("--models %r matched nothing" % args.models)
+    plan = make_plan(args.rate, args.duration, args.seed, models)
+    pool = DaemonPool(args.concurrency)
+    out = run(plan, models, http_submit(args.url, pool), slo_ms,
+              args.duration, args.seed, timeout_ms=args.timeout_ms)
+    out["url"] = args.url
+    out["models"] = [m.name or "<default>" for m in models]
+    print(json.dumps(out))
+    if args.assert_goodput_pct is not None:
+        if (out["goodput_pct"] or 0.0) < args.assert_goodput_pct:
+            print("loadgen: goodput %.2f%% below the %.2f%% SLO "
+                  "assertion" % (out["goodput_pct"] or 0.0,
+                                 args.assert_goodput_pct),
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

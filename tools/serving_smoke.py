@@ -1,6 +1,8 @@
-"""CI smoke: the serving tier end to end — train a tiny wine model,
-snapshot it, bring up the HTTP front end, fire 64 CONCURRENT requests
-of mixed batch sizes, and assert the subsystem's acceptance contract:
+"""CI smoke: the serving tier end to end, in two acts.
+
+**Act 1 — single engine (the PR 2 contract):** train a tiny wine
+model, snapshot it, bring up the HTTP front end, fire 64 CONCURRENT
+requests of mixed batch sizes, and assert:
 
 * every request answers 200 with a well-formed prediction,
 * request latency was recorded (p99 observable from the
@@ -9,6 +11,17 @@ of mixed batch sizes, and assert the subsystem's acceptance contract:
   telemetry counter is quiescent across the whole request storm),
 * requests coalesced into micro-batches (batch counter < request
   count).
+
+**Act 2 — the control plane (ISSUE 8):** the SAME wine snapshot plus
+a second (packaged, different-shape) model behind a ModelRegistry +
+ContinuousBatcher, interleaved concurrent traffic against both:
+
+* per-model routing answers with each model's own head width,
+* zero recompiles across the interleaved storm,
+* /healthz carries the per-model readiness map,
+* per-model labeled series landed on /metrics,
+* a short seeded ``tools/loadgen.py`` run (open-loop Poisson, fixed
+  seed) through the real CLI holds the goodput SLO assertion.
 
 Run by ``tools/ci.sh`` (fast lane).  Exit code 0 = pass.
 """
@@ -130,6 +143,121 @@ def main():
               % (N_REQUESTS, batches, summary["latency_p50_ms"],
                  summary["latency_p99_ms"], compiles0,
                  list(engine.buckets)))
+    finally:
+        server.stop()
+    registry_smoke(tmp, snapshot)
+
+
+def _second_model_package(tmp):
+    """A deterministic synthetic FC package (20 -> 8 -> 4) written to
+    disk — exercises the zip load path next to wine's snapshot path."""
+    import io
+    import zipfile
+    r = numpy.random.RandomState(42)
+    manifest = {
+        "format": 1,
+        "layers": [
+            {"type": "all2all_tanh", "name": "fc0",
+             "arrays": {"weights": "w0.npy", "bias": "b0.npy"},
+             "include_bias": True, "weights_transposed": True},
+            {"type": "softmax", "name": "out",
+             "arrays": {"weights": "w1.npy", "bias": "b1.npy"},
+             "include_bias": True, "weights_transposed": True}],
+        "input_sample_shape": [20],
+    }
+    arrays = {"w0.npy": r.randn(20, 8).astype(numpy.float32),
+              "b0.npy": r.randn(8).astype(numpy.float32),
+              "w1.npy": r.randn(8, 4).astype(numpy.float32),
+              "b1.npy": r.randn(4).astype(numpy.float32)}
+    path = os.path.join(tmp, "synth.zip")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("manifest.json", json.dumps(manifest))
+        for fname, arr in arrays.items():
+            buf = io.BytesIO()
+            numpy.save(buf, arr)
+            zf.writestr(fname, buf.getvalue())
+    return path
+
+
+def registry_smoke(tmp, snapshot):
+    """Act 2: two models, one server — interleaved traffic + loadgen."""
+    import subprocess
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+
+    telemetry.reset()
+    registry = ModelRegistry(
+        models={"wine": snapshot,
+                "synth": _second_model_package(tmp)},
+        max_batch=MAX_BATCH)
+    server = ServingServer(registry=registry).start()
+    url = "http://127.0.0.1:%d" % server.port
+    widths = {"wine": (13, 3), "synth": (20, 4)}
+    compiles0 = telemetry.counter("jax.backend_compiles").value
+    statuses, errors = [], []
+
+    def client(seed):
+        try:
+            model = ("wine", "synth")[seed % 2]
+            n_in, n_out = widths[model]
+            r = numpy.random.RandomState(seed)
+            x = r.uniform(-1, 1, (1 + seed % MAX_BATCH, n_in))
+            req = urllib.request.Request(
+                url + "/predict/" + model,
+                json.dumps({"inputs": x.tolist()}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                doc = json.loads(resp.read())
+            assert doc["model"] == model
+            assert len(doc["outputs"]) == len(x)
+            assert len(doc["outputs"][0]) == n_out
+            statuses.append(resp.status)
+        except Exception as e:  # noqa: BLE001 - asserted below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_REQUESTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errors, "request failures: %s" % errors[:5]
+        assert statuses.count(200) == N_REQUESTS
+        recompiles = telemetry.counter(
+            "jax.backend_compiles").value - compiles0
+        assert recompiles == 0, \
+            "%d recompiles across the interleaved storm" % recompiles
+        with urllib.request.urlopen(url + "/healthz",
+                                    timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["ready"] is True
+        assert health["models"] == {"wine": True, "synth": True}
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert "model_wine" in metrics and "model_synth" in metrics, \
+            "per-model labels missing from /metrics"
+        # the seeded open-loop SLO check, through the real CLI
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "loadgen.py"),
+             url, "--rate", "40", "--duration", "3", "--seed", "7",
+             "--slo-ms", "2000", "--assert-goodput-pct", "70"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, \
+            "loadgen SLO assertion failed:\n%s\n%s" % (
+                proc.stdout[-1000:], proc.stderr[-1000:])
+        report = json.loads(proc.stdout.splitlines()[-1])
+        print("registry smoke OK: %d interleaved requests over 2 "
+              "models, 0 recompiles; loadgen %.0f req/s offered -> "
+              "%.1f%% goodput, p99 %.1f ms (seed %d)"
+              % (N_REQUESTS, report["offered_rps"],
+                 report["goodput_pct"],
+                 report["latency_ms"]["p99"] or -1.0,
+                 report["seed"]))
     finally:
         server.stop()
 

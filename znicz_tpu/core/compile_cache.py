@@ -1,0 +1,160 @@
+"""Persistent XLA compilation cache — the serving cold-start story.
+
+A fresh serving replica pays one XLA compile per (model topology,
+shape bucket) before it can flip ready.  For a registry of several
+models with 7-bucket ladders that is dozens of compiles — minutes of
+cold start on real hardware.  This module wires jax's *persistent*
+compilation cache (``jax_compilation_cache_dir``) so those executables
+are compiled ONCE per cluster, not once per replica: the first replica
+to warm a bucket writes the serialized executable to the cache
+directory (a shared volume / NFS mount in production), and every later
+replica's warmup deserializes it in milliseconds instead of
+recompiling.
+
+**Accounting — what "zero fresh compiles" means.**  The installed jax
+records a ``backend_compile`` duration event around the whole
+compile-*or-load* step, so ``jax.backend_compiles`` ticks even when
+the executable came from the persistent cache; the cache hit
+additionally fires ``jax.persistent_cache_hits`` (PR 1 wired both).  A
+**fresh** compile — actual XLA work — is therefore
+``backend_compiles - persistent_cache_hits``, and that is the number a
+warm cold start must hold at ZERO (pinned by
+``tests/functional/test_compile_cache.py``).  :class:`watch` snapshots
+the three counters and exposes the delta.
+
+The cache key covers the serialized computation + jaxlib version +
+compile options, NOT array values — so the engine's params-as-argument
+design (serving/engine.py) means every model version bump and every
+replica of the same topology share one cache entry per bucket.
+
+Pairs with the **warmup manifest** (``export.serving_manifest``): every
+deployment package / snapshot topology records the bucket ladder and
+sample shape it should be warmed for, so a replica knows its full
+compile set ahead of the first request.  Cold start then is: read
+manifest -> warm every bucket -> every compile is a persistent-cache
+hit -> ready in seconds.
+
+Disabled by default (``root.common.compile_cache.enabled``); the
+``serve`` CLI and the serving bench enable it.  Training is untouched
+unless explicitly enabled — the off path is one config read.
+"""
+
+import glob
+import os
+import threading
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import telemetry
+
+_lock = threading.Lock()
+#: the active cache directory (None = not wired into jax)
+_dir = None
+
+
+def configured_dir():
+    """The directory config selects: ``root.common.compile_cache.dir``
+    or ``<cache>/xla_cache``."""
+    cfg = root.common.compile_cache
+    explicit = cfg.get("dir", None)
+    if explicit:
+        return os.fspath(explicit)
+    return os.path.join(root.common.dirs.cache, "xla_cache")
+
+
+def enabled():
+    """True once :func:`enable` wired a cache directory."""
+    return _dir is not None
+
+
+def active_dir():
+    return _dir
+
+
+def enable(cache_dir=None):
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (default: :func:`configured_dir`).  Idempotent; calling again with
+    a different directory re-points the cache.  Returns the directory.
+
+    ``min_compile_time_secs``/``min_entry_size_bytes`` default to
+    cache-everything (0 / -1): serving executables are small and the
+    whole point is that NO bucket recompiles on restart.
+    """
+    global _dir
+    import jax
+    cfg = root.common.compile_cache
+    with _lock:
+        d = os.path.abspath(os.fspath(cache_dir) if cache_dir
+                            else configured_dir())
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(cfg.get("min_compile_time_secs", 0.0)))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          int(cfg.get("min_entry_size_bytes", -1)))
+        _dir = d
+    telemetry.record_event("compile_cache.enable", dir=d)
+    return d
+
+
+def disable():
+    """Unwire the cache (tests): jit compiles stop touching disk."""
+    global _dir
+    import jax
+    with _lock:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _dir = None
+
+
+def maybe_enable():
+    """Honor ``root.common.compile_cache.enabled`` (the declarative
+    path — ``serve`` CLI, bench, and subprocess replicas all call
+    this); returns the directory or None."""
+    if root.common.compile_cache.get("enabled", False):
+        return enable()
+    return None
+
+
+def _counter_values():
+    return {
+        "backend_compiles":
+            telemetry.counter("jax.backend_compiles").value,
+        "persistent_cache_hits":
+            telemetry.counter("jax.persistent_cache_hits").value,
+        "persistent_cache_misses":
+            telemetry.counter("jax.persistent_cache_misses").value,
+    }
+
+
+class watch(object):
+    """Snapshot of the compile counters; ``fresh_compiles()`` is the
+    number of ACTUAL XLA compiles since construction (compile-or-load
+    events minus persistent-cache loads).  Requires telemetry to be
+    enabled — the counters only tick then."""
+
+    def __init__(self):
+        self._at = _counter_values()
+
+    def delta(self):
+        now = _counter_values()
+        return {k: int(now[k] - self._at[k]) for k in now}
+
+    def fresh_compiles(self):
+        d = self.delta()
+        return d["backend_compiles"] - d["persistent_cache_hits"]
+
+
+def stats():
+    """The cache's observable state — stamped into serving ``stats()``
+    and the bench cold-start block."""
+    out = {
+        "enabled": enabled(),
+        "dir": _dir,
+    }
+    if _dir and os.path.isdir(_dir):
+        entries = [p for p in glob.glob(os.path.join(_dir, "*"))
+                   if os.path.isfile(p) and not p.endswith("-atime")]
+        out["entries"] = len(entries)
+        out["bytes"] = sum(os.path.getsize(p) for p in entries)
+    if telemetry.enabled():
+        out.update(_counter_values())
+    return out

@@ -202,6 +202,28 @@ root.common.update({
         "breaker_half_open_max": 1,  # concurrent half-open probes
         "max_body_bytes": 16 << 20,  # request bodies over this get 413
                                      # (0 disables the cap)
+        # continuous batching (serving/continuous.py): dispatch slots
+        # that admit queued requests the moment capacity frees —
+        # max_inflight concurrent engine dispatches across all models
+        "max_inflight": 2,
+        # multi-model registry (serving/registry.py): device-memory
+        # budget for resident models; the least-recently-used cold
+        # model's executables + device params are evicted when the
+        # resident total exceeds it (0 = unlimited, never evict)
+        "registry_memory_budget_bytes": 0,
+        # latency SLO used by tools/loadgen.py goodput accounting and
+        # stamped by bench.py --serving
+        "slo_ms": 100.0,
+    },
+    # persistent XLA compilation cache (core/compile_cache.py) — the
+    # serving cold-start story: executables compile once per cluster,
+    # restarted replicas deserialize them from `dir` instead of
+    # recompiling.  Off by default; `serve`/bench enable it.
+    "compile_cache": {
+        "enabled": False,
+        "dir": None,              # default: <cache dir>/xla_cache
+        "min_compile_time_secs": 0.0,   # cache even instant compiles
+        "min_entry_size_bytes": -1,     # ... and tiny executables
     },
 })
 

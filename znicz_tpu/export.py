@@ -135,7 +135,27 @@ def forward_manifest(workflow):
     shape = input_sample_shape(workflow)
     if shape is not None:
         manifest["input_sample_shape"] = list(shape)
+        manifest["serving"] = serving_manifest(shape)
     return manifest, files
+
+
+def serving_manifest(sample_shape):
+    """The ahead-of-time **warmup manifest** recorded at export /
+    snapshot time: the shape-bucket ladder a serving replica should
+    precompile for this model (from the serving config active at
+    export), plus the per-sample input shape.  A cold replica reads it
+    and warms the EXACT executable set the exporter's cluster serves —
+    with the persistent compilation cache (core/compile_cache.py)
+    every one of those warms is a cache load, not a compile, so the
+    replica is ready in seconds with zero fresh XLA work."""
+    from znicz_tpu.core.config import root
+    from znicz_tpu.serving.engine import default_buckets
+    max_batch = int(root.common.serving.get("max_batch", 64))
+    return {
+        "buckets": list(default_buckets(max_batch)),
+        "max_batch": max_batch,
+        "sample_shape": list(sample_shape),
+    }
 
 
 def forward_topology(workflow):
@@ -172,6 +192,7 @@ def forward_topology(workflow):
     shape = input_sample_shape(workflow)
     if shape is not None:
         topology["input_sample_shape"] = list(shape)
+        topology["serving"] = serving_manifest(shape)
     return topology
 
 

@@ -29,9 +29,14 @@ from znicz_tpu.serving.batcher import (  # noqa: F401 - re-export
     RequestTimeoutError)
 from znicz_tpu.serving.breaker import (  # noqa: F401 - re-export
     CircuitBreaker, CircuitOpenError)
+from znicz_tpu.serving.continuous import (  # noqa: F401 - re-export
+    ContinuousBatcher)
+from znicz_tpu.serving.registry import (  # noqa: F401 - re-export
+    ModelRegistry, UnknownModelError)
 from znicz_tpu.serving.server import ServingServer  # noqa: F401
 
-__all__ = ["InferenceEngine", "MicroBatcher", "ServingServer",
+__all__ = ["InferenceEngine", "MicroBatcher", "ContinuousBatcher",
+           "ModelRegistry", "UnknownModelError", "ServingServer",
            "BatcherStoppedError", "QueueFullError",
            "RequestTimeoutError", "default_buckets",
            "CircuitBreaker", "CircuitOpenError"]

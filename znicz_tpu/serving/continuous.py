@@ -1,0 +1,442 @@
+"""Continuous batching — admission as capacity frees, not on barriers.
+
+The PR 2 :class:`~znicz_tpu.serving.batcher.MicroBatcher` closes a
+batching *window* (size-or-deadline) and dispatches it with ONE worker
+— while a dispatch runs, arrivals wait for the whole window cycle, and
+trickle traffic always pays ``max_delay_ms``.  Continuous batching
+inverts the control flow:
+
+* requests land in per-``(model, sample-shape)`` FIFO queues the
+  moment they arrive;
+* ``max_inflight`` dispatch slots (worker threads) each grab the next
+  coalescible run of requests THE MOMENT they free up — a request
+  admits into the next in-flight shape bucket as soon as there is
+  capacity, with zero scheduled delay.  Idle server + one request =
+  immediate batch-of-1 (no window wait); saturated server = arrivals
+  coalesce naturally while every slot is busy, so dispatches run full
+  without ever scheduling a timer;
+* slots pick the next MODEL round-robin (and the oldest-waiting shape
+  queue within it), so a burst against one model cannot starve the
+  others — cross-model fairness is positional, not probabilistic.
+
+The PR 2 contracts carry over unchanged: a bounded global queue
+(``queue_limit`` rows) rejects with :class:`QueueFullError` → 429;
+per-request deadlines expire queued requests with
+:class:`RequestTimeoutError` → 504 without wasting a dispatch;
+``stop(flush=True)`` (the SIGTERM drain path) serves every queued
+request before the workers exit, and a submit racing the stop raises
+:class:`BatcherStoppedError` → 503-draining.  A failing dispatch fails
+only its own batch's futures — the slots never die.
+
+Telemetry: the aggregate serving series of the micro-batcher
+(``serving.request_seconds``, ``serving.queue_wait_seconds``,
+``serving.batches``, ``serving.queue_depth``, ...) PLUS per-model
+labeled variants (``...model_<name>``) and a ``serving.inflight``
+gauge (busy dispatch slots — the continuous-batching utilization
+signal).
+"""
+
+import collections
+import threading
+import time
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import telemetry
+import numpy
+
+from znicz_tpu.serving.batcher import (_DISPATCH_GRACE, _Request,
+                                       BatcherStoppedError,
+                                       QueueFullError,
+                                       RequestTimeoutError)
+
+
+class _Queue(object):
+    """One (model, trailing-shape) admission lane."""
+
+    __slots__ = ("reqs", "max_batch")
+
+    def __init__(self, max_batch):
+        self.reqs = collections.deque()
+        self.max_batch = max_batch
+
+
+class ContinuousBatcher(Logger):
+    """Continuous batching over one engine or a whole registry.
+
+    ``models`` is a :class:`~znicz_tpu.serving.registry.ModelRegistry`
+    (multi-model routing via ``submit(..., model=...)``), a single
+    engine, or any ``callable(batch) -> batch``.  Unset knobs come
+    from ``root.common.serving`` (``max_inflight``, ``queue_limit``,
+    ``timeout_ms``).
+    """
+
+    def __init__(self, models, max_inflight=None, queue_limit=None,
+                 timeout_ms=None):
+        super(ContinuousBatcher, self).__init__(
+            logger_name="ContinuousBatcher")
+        cfg = root.common.serving
+        self._registry = models if hasattr(models, "engine") and \
+            hasattr(models, "names") else None
+        self._single = None if self._registry is not None else models
+        self.max_inflight = int(
+            max_inflight if max_inflight is not None
+            else cfg.get("max_inflight", 2))
+        if self.max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else cfg.get("queue_limit", 256))
+        timeout_ms = (timeout_ms if timeout_ms is not None
+                      else cfg.get("timeout_ms", 1000.0))
+        self.timeout = float(timeout_ms) / 1e3 if timeout_ms else None
+        self._queues = {}          # (model, shape) -> _Queue
+        self._rows_queued = 0
+        self._last_model = None    # round-robin cursor
+        self._cond = threading.Condition()
+        self._running = False
+        self._threads = []
+        self._inflight = 0
+        #: request-id propagation is opt-in by signature (the
+        #: micro-batcher's rule): cached per model name — engines
+        #: persist across reloads, so the answer is stable
+        self._rid_aware = {}
+
+    # -- model resolution ---------------------------------------------------
+    def _resolve(self, model):
+        """The engine (or plain callable) serving ``model``; raises
+        ``UnknownModelError`` for an unroutable name.  Registry
+        resolution marks the model used and lazily restores it when
+        the LRU budget had evicted it — DISPATCH-time only."""
+        if self._registry is not None:
+            return self._registry.engine(model)
+        return self._single
+
+    def _peek(self, model):
+        """Admission-time lookup: shape/max_batch metadata without
+        side effects.  A request that is about to be 429'd must not
+        mark its model used (rejected floods would keep a cold model
+        resident under the LRU budget) nor pay a blocking restore."""
+        if self._registry is not None:
+            peek = getattr(self._registry, "peek", None)
+            if peek is not None:
+                return peek(model)
+            return self._registry.engine(model)
+        return self._single
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+            self._threads = [
+                threading.Thread(target=self._worker,
+                                 name="continuous-%d" % i, daemon=True)
+                for i in range(self.max_inflight)]
+            for t in self._threads:
+                t.start()
+        return self
+
+    def stop(self, flush=True):
+        """Stop the dispatch slots.  ``flush=True`` serves every queued
+        request first (the graceful-drain contract); ``flush=False``
+        fails pending futures."""
+        with self._cond:
+            if not self._running and not self._threads:
+                return
+            self._running = False
+            if not flush:
+                for q in self._queues.values():
+                    while q.reqs:
+                        q.reqs.popleft().future.set_exception(
+                            RuntimeError("batcher stopped"))
+                self._queues.clear()
+                self._rows_queued = 0
+            self._cond.notify_all()
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join(timeout=30)
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, x, model=None, timeout_ms=None, request_id=None):
+        """Enqueue; returns a Future of the output rows.  ``model``
+        routes within a registry (None = default model)."""
+        if not self._running:
+            raise BatcherStoppedError("batcher is not running")
+        engine = self._peek(model)
+        x = numpy.asarray(x)
+        sample = getattr(engine, "sample_shape", None)
+        if sample is not None:
+            from znicz_tpu.serving.engine import matches_sample_shape
+            if matches_sample_shape(x.shape, sample):
+                x = x[None]
+        if x.ndim < 2:
+            x = numpy.atleast_2d(x)
+        rows = x.shape[0]
+        if rows == 0:
+            raise ValueError("empty request")
+        max_batch = int(getattr(engine, "max_batch", 0) or
+                        root.common.serving.get("max_batch", 64))
+        if rows > max_batch:
+            raise ValueError(
+                "request of %d rows exceeds max_batch %d — split it "
+                "client-side" % (rows, max_batch))
+        now = time.monotonic()
+        timeout = (self.timeout if timeout_ms is None
+                   else (float(timeout_ms) / 1e3 or None))
+        deadline = now + timeout if timeout else None
+        from concurrent.futures import Future
+        future = Future()
+        req = _Request(x, rows, future, now, deadline, rid=request_id)
+        key = (model, x.shape[1:])
+        with self._cond:
+            if not self._running:
+                raise BatcherStoppedError("batcher is not running")
+            if self._rows_queued + rows > self.queue_limit:
+                if telemetry.enabled():
+                    telemetry.counter("serving.rejected").inc()
+                    if model is not None:
+                        telemetry.counter(telemetry.labeled(
+                            "serving.rejected", model=model)).inc()
+                raise QueueFullError(
+                    "queue full (%d rows queued, limit %d)"
+                    % (self._rows_queued, self.queue_limit))
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = _Queue(max_batch)
+            else:
+                # keep the lane's coalescing cap in sync with the live
+                # engine — a hot reload may have grown the ladder while
+                # requests were queued
+                q.max_batch = max_batch
+            q.reqs.append(req)
+            self._rows_queued += rows
+            if telemetry.enabled():
+                telemetry.gauge("serving.queue_depth").set(
+                    self._rows_queued)
+            self._cond.notify()
+        return future
+
+    def predict(self, x, model=None, timeout_ms=None, request_id=None):
+        """Blocking submit; the wait is bounded at deadline + dispatch
+        grace when the request carries one (same contract as the
+        micro-batcher)."""
+        import concurrent.futures
+        timeout = (self.timeout if timeout_ms is None
+                   else (float(timeout_ms) / 1e3 or None))
+        future = self.submit(x, model=model, timeout_ms=timeout_ms,
+                             request_id=request_id)
+        if timeout is None:
+            return future.result()
+        try:
+            return future.result(timeout=timeout + _DISPATCH_GRACE)
+        except concurrent.futures.TimeoutError:
+            raise RequestTimeoutError(
+                "request did not complete within %.1f s (deadline "
+                "%.1f s + %.0f s dispatch grace)"
+                % (timeout + _DISPATCH_GRACE, timeout,
+                   _DISPATCH_GRACE))
+
+    @property
+    def queued_rows(self):
+        return self._rows_queued
+
+    @property
+    def inflight(self):
+        return self._inflight
+
+    # -- the dispatch slots -------------------------------------------------
+    def _worker(self):
+        while True:
+            taken = self._take()
+            if taken is None:
+                return
+            model, batch = taken
+            with self._cond:
+                self._inflight += 1
+                if telemetry.enabled():
+                    telemetry.gauge("serving.inflight").set(
+                        self._inflight)
+            try:
+                self._run_batch(model, batch)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    if telemetry.enabled():
+                        telemetry.gauge("serving.inflight").set(
+                            self._inflight)
+
+    def _next_key(self):
+        """Round-robin fairness: the next model (cyclically after the
+        last-served one) with pending work; within the model, the
+        shape lane whose HEAD request has waited longest.  Called
+        under the condition lock."""
+        pending = {}
+        for key, q in self._queues.items():
+            if q.reqs:
+                pending.setdefault(key[0], []).append(key)
+        if not pending:
+            return None
+        models = sorted(pending, key=lambda m: (m is None, m))
+        if self._last_model in models:
+            i = models.index(self._last_model) + 1
+            models = models[i:] + models[:i]
+        model = models[0]
+        key = min(pending[model],
+                  key=lambda k: self._queues[k].reqs[0].arrived)
+        self._last_model = model
+        return key
+
+    def _take(self):
+        """Block until work exists; pop one coalescible run (same
+        model, same trailing shape, FIFO, up to the lane's max_batch).
+        None = stopped and drained."""
+        with self._cond:
+            while self._running and not any(
+                    q.reqs for q in self._queues.values()):
+                self._cond.wait()
+            key = self._next_key()
+            if key is None:
+                return None  # stopped, nothing left to flush
+            q = self._queues[key]
+            batch, rows = [], 0
+            while q.reqs and rows + q.reqs[0].rows <= q.max_batch:
+                r = q.reqs.popleft()
+                batch.append(r)
+                rows += r.rows
+            if not batch:
+                # the head alone exceeds the lane's (possibly stale —
+                # shrunk by a reload) cap: take it by itself anyway.
+                # The dispatch will answer it honestly (the engine
+                # rejects oversize); an empty take would spin this
+                # slot forever with the request wedged at the head
+                r = q.reqs.popleft()
+                batch.append(r)
+                rows = r.rows
+            if not q.reqs:
+                del self._queues[key]
+            self._rows_queued -= rows
+            if telemetry.enabled():
+                telemetry.gauge("serving.queue_depth").set(
+                    self._rows_queued)
+            return key[0], batch
+
+    def _run_batch(self, model, batch):
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                if telemetry.enabled():
+                    telemetry.counter("serving.timeouts").inc()
+                    if model is not None:
+                        telemetry.counter(telemetry.labeled(
+                            "serving.timeouts", model=model)).inc()
+                r.future.set_exception(RequestTimeoutError(
+                    "request expired after %.1f ms in queue"
+                    % ((now - r.arrived) * 1e3)))
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        rids = [r.rid for r in live if r.rid]
+        try:
+            # the whole dispatch — resolution (an unknown/evicted
+            # model, a restore failure), assembly, the forward — fails
+            # THIS batch's futures; a slot thread must never die
+            engine = self._resolve(model)
+            predict = getattr(engine, "predict", engine)
+            bucket_for = getattr(engine, "bucket_for", None)
+            bucket = bucket_for(rows) if bucket_for else rows
+            if telemetry.enabled():
+                telemetry.counter("serving.batches").inc()
+                telemetry.histogram("serving.batch_rows").observe(rows)
+                telemetry.histogram("serving.batch_fill").observe(
+                    rows / float(bucket))
+            t_asm = time.monotonic()
+            x = (live[0].arr if len(live) == 1 else
+                 numpy.concatenate([r.arr for r in live], axis=0))
+            asm_dt = time.monotonic() - t_asm
+            span_attrs = {"rows": rows, "requests": len(live)}
+            if model is not None:
+                span_attrs["model"] = model
+            rid_aware = self._rid_aware.get(model)
+            if rid_aware is None:
+                import inspect
+                try:
+                    rid_aware = "request_ids" in \
+                        inspect.signature(predict).parameters
+                except (TypeError, ValueError):
+                    rid_aware = False
+                self._rid_aware[model] = rid_aware
+            with telemetry.span("serving.batch", **span_attrs):
+                t_dev = time.monotonic()
+                if rid_aware:
+                    y = predict(x, request_ids=rids or None)
+                else:
+                    y = predict(x)  # plain callable (tests)
+                dev_dt = time.monotonic() - t_dev
+        except Exception as e:  # noqa: BLE001 - fail the batch, not us
+            if telemetry.enabled():
+                telemetry.counter("serving.errors").inc()
+                if model is not None:
+                    telemetry.counter(telemetry.labeled(
+                        "serving.errors", model=model)).inc()
+            self.warning("batch of %d rows (model %s) failed: %r",
+                         rows, model or "<default>", e)
+            for r in live:
+                r.future.set_exception(e)
+            return
+        done = time.monotonic()
+        if telemetry.enabled():
+            telemetry.histogram("serving.assembly_seconds").observe(
+                asm_dt)
+            telemetry.histogram("serving.pad_overhead").observe(
+                (bucket - rows) / float(bucket))
+        latency = queue_wait = device_time = None
+        m_latency = m_queue_wait = None
+        if telemetry.enabled():
+            latency = telemetry.histogram("serving.request_seconds")
+            queue_wait = telemetry.histogram(
+                "serving.queue_wait_seconds")
+            device_time = telemetry.histogram("serving.device_seconds")
+            if model is not None:
+                # the per-model view (satellite: multi-model metrics
+                # must not collide): latency + queue wait labeled
+                m_latency = telemetry.histogram(telemetry.labeled(
+                    "serving.request_seconds", model=model))
+                m_queue_wait = telemetry.histogram(telemetry.labeled(
+                    "serving.queue_wait_seconds", model=model))
+        slow_ms = float(root.common.serving.get("slow_request_ms",
+                                                1000.0) or 0.0)
+        offset = 0
+        for r in live:
+            total = done - r.arrived
+            waited = max(now - r.arrived, 0.0)
+            if latency is not None:
+                latency.observe(total)
+                queue_wait.observe(waited)
+                device_time.observe(dev_dt)
+                if m_latency is not None:
+                    m_latency.observe(total)
+                    m_queue_wait.observe(waited)
+            if slow_ms > 0.0 and total * 1e3 > slow_ms:
+                self.warning(
+                    "slow request%s: total %.1f ms (queue %.1f ms, "
+                    "assembly %.2f ms, device %.1f ms; %d rows in a "
+                    "%d-row batch, bucket %d, model %s)",
+                    " " + r.rid if r.rid else "", total * 1e3,
+                    waited * 1e3, asm_dt * 1e3, dev_dt * 1e3, r.rows,
+                    rows, bucket, model or "<default>")
+                telemetry.record_event(
+                    "serving.slow_request", rid=r.rid, model=model,
+                    total_ms=round(total * 1e3, 3),
+                    queue_ms=round(waited * 1e3, 3),
+                    assembly_ms=round(asm_dt * 1e3, 3),
+                    device_ms=round(dev_dt * 1e3, 3),
+                    rows=r.rows, batch_rows=rows, bucket=bucket)
+            # resolve LAST: the caller's view of the trace must already
+            # be complete when it wakes
+            r.future.set_result(
+                numpy.asarray(y)[offset:offset + r.rows])
+            offset += r.rows

@@ -173,13 +173,19 @@ class _Model(object):
     an in-flight predict on the outgoing model during a topology-
     changing reload must mark the OLD generation's buckets, never the
     new one's (which would make warmup skip a bucket that was never
-    compiled for the new function)."""
+    compiled for the new function).
+
+    ``host_params`` keeps the pre-upload numpy arrays so
+    :meth:`InferenceEngine.evict` can release the device copies (and
+    the executables) and :meth:`~InferenceEngine.restore` can bring
+    them back without re-reading the source."""
 
     __slots__ = ("layers", "params", "fn", "key", "dtype",
-                 "sample_shape", "source", "version", "warm")
+                 "sample_shape", "source", "version", "warm",
+                 "host_params", "dev_bytes")
 
     def __init__(self, layers, params, fn, key, dtype, sample_shape,
-                 source, version, warm):
+                 source, version, warm, host_params=None):
         self.layers = layers
         self.params = params
         self.fn = fn
@@ -189,6 +195,12 @@ class _Model(object):
         self.source = source
         self.version = version
         self.warm = warm
+        self.host_params = host_params
+        #: resident param footprint, computed ONCE — the registry's
+        #: budget sweep reads this per request and must not walk the
+        #: whole pytree each time (sizes never change for a generation)
+        self.dev_bytes = sum(
+            int(v.nbytes) for p in (params or []) for v in p.values())
 
 
 def _build_forward(layers):
@@ -220,10 +232,17 @@ class InferenceEngine(Logger):
     """
 
     def __init__(self, source=None, max_batch=None, buckets=None,
-                 sample_shape=None, warmup=None):
+                 sample_shape=None, warmup=None, name=None):
         super(InferenceEngine, self).__init__(
             logger_name="InferenceEngine")
         cfg = root.common.serving
+        #: registry model name; when set, every telemetry series /
+        #: breaker / journal event this engine emits carries a
+        #: ``model_<name>`` label so multi-model metrics never collide
+        self.name = name
+        #: True when the caller pinned the bucket ladder — a source's
+        #: recorded warmup manifest must not override an explicit choice
+        self._buckets_explicit = bool(buckets) or max_batch is not None
         if buckets:
             self.buckets = tuple(sorted(int(b) for b in buckets))
             if max_batch is not None and \
@@ -236,6 +255,8 @@ class InferenceEngine(Logger):
                 max_batch if max_batch is not None
                 else cfg.get("max_batch", 64))
         self.max_batch = self.buckets[-1]
+        self._warmup_manifest = None
+        self._evictions = 0
         self._warmup_wanted = (bool(cfg.get("warmup", True))
                                if warmup is None else bool(warmup))
         self._sample_shape_override = (
@@ -285,6 +306,32 @@ class InferenceEngine(Logger):
         m = self._model
         return tuple(sorted(m.warm)) if m is not None else ()
 
+    @property
+    def resident(self):
+        """True when the model's params live on the device (False
+        after :meth:`evict`, before the lazy :meth:`restore`)."""
+        m = self._model
+        return m is not None and m.params is not None
+
+    @property
+    def device_bytes(self):
+        """Device footprint of the resident params (0 when evicted or
+        unloaded) — the quantity the registry's LRU budget meters.
+        A cached per-generation constant, safe on the hot path."""
+        m = self._model
+        if m is None or m.params is None:
+            return 0
+        return m.dev_bytes
+
+    def _label(self, series, **labels):
+        """Per-model telemetry naming: unnamed engines keep the exact
+        historical series names; named (registry-hosted) engines get a
+        ``model_<name>`` label so several models' metrics coexist on
+        one /metrics page."""
+        if self.name is not None:
+            labels["model"] = self.name
+        return telemetry.labeled(series, **labels)
+
     def stats(self):
         """healthz payload: what is loaded, how warm, how big."""
         m = self._model
@@ -298,7 +345,14 @@ class InferenceEngine(Logger):
             "dtype": str(numpy.dtype(m.dtype)) if m else None,
             "buckets": list(self.buckets),
             "warm_buckets": list(self.warm_buckets),
+            "resident": self.resident,
+            "device_bytes": self.device_bytes,
+            "evictions": self._evictions,
         }
+        if self.name is not None:
+            payload["model"] = self.name
+        if self._warmup_manifest is not None:
+            payload["warmup_manifest"] = self._warmup_manifest
         if self._breakers:
             # snapshot under the creation lock: a first dispatch of a
             # new bucket may be inserting concurrently
@@ -317,10 +371,10 @@ class InferenceEngine(Logger):
         the warm-bucket set) carry over, so a reload costs zero
         recompiles.
         """
-        layers, arrays_list, label, src_shape = \
+        layers, arrays_list, label, src_shape, serving_mf = \
             self._load_source(source)
         _validate_layers(layers)
-        params = []
+        host_params = []
         dtype = None
         for arrs in arrays_list:
             p = {}
@@ -330,13 +384,13 @@ class InferenceEngine(Logger):
                         numpy.issubdtype(value.dtype, numpy.floating):
                     dtype = value.dtype
                 p[attr] = value
-            params.append(p)
+            host_params.append(p)
         dtype = dtype or numpy.float32
         # pin the params device-resident ONCE — dispatches must not pay
         # a host->device upload per request (jit's cache key only sees
         # shape/dtype, so this changes nothing else)
         import jax
-        params = jax.device_put(params)
+        params = jax.device_put(host_params)
         if sample_shape is not None:
             shape = tuple(sample_shape)
         else:
@@ -348,9 +402,33 @@ class InferenceEngine(Logger):
             [layers, [{a: [str(v.dtype)] + list(v.shape)
                        for a, v in p.items()} for p in params]],
             sort_keys=True, default=str)
+        # manifest-ladder adoption happens LAST before the swap —
+        # nothing below here raises until warmup, whose failure
+        # handler restores these limits with the model.  (Adopting any
+        # earlier would let a load that dies at device_put/shape
+        # derivation leave the surviving generation with the failed
+        # source's ladder: a shrunk max_batch 400ing request sizes
+        # that were valid a second ago.)
+        old_limits = (self.buckets, self.max_batch,
+                      self._warmup_manifest)
+        if serving_mf is not None:
+            self._warmup_manifest = serving_mf
+            if not self._buckets_explicit and serving_mf.get("buckets"):
+                # adopt the ahead-of-time warmup manifest recorded at
+                # export/snapshot time: the replica warms the EXACT
+                # bucket ladder the exporter's serving config pinned
+                ladder = tuple(sorted(
+                    int(b) for b in serving_mf["buckets"]))
+                if ladder and ladder[0] >= 1:
+                    self.buckets = ladder
+                    self.max_batch = ladder[-1]
         with self._load_lock:
             old = self._model
-            reused = old is not None and old.key == key
+            old_bytes = self.device_bytes
+            # an evicted old generation has no fn to carry over —
+            # rebuild even when the topology key matches
+            reused = old is not None and old.key == key and \
+                old.fn is not None
             if reused:
                 # unchanged topology: the compiled executables AND the
                 # warm-bucket set carry over to the new generation
@@ -360,16 +438,20 @@ class InferenceEngine(Logger):
                 self._ready.clear()
             self._version += 1
             model = _Model(layers, params, fn, key, dtype, shape,
-                           label, self._version, warm)
+                           label, self._version, warm,
+                           host_params=host_params)
             self._model = model
             if telemetry.enabled():
-                telemetry.gauge("serving.model_version").set(
-                    self._version)
-                telemetry.gauge("serving.warm_buckets").set(
-                    len(model.warm))
-        telemetry.record_event("serving.reload", version=self._version,
-                               source=label,
-                               topology_changed=not reused)
+                telemetry.gauge(self._label(
+                    "serving.model_version")).set(self._version)
+                telemetry.gauge(self._label(
+                    "serving.warm_buckets")).set(len(model.warm))
+        self._ledger_swap(old_bytes, self.device_bytes)
+        event = {"version": self._version, "source": label,
+                 "topology_changed": not reused}
+        if self.name is not None:
+            event["model"] = self.name
+        telemetry.record_event("serving.reload", **event)
         self.info("model v%d <- %s (%d layers, dtype %s, "
                   "sample shape %s)", self._version, label,
                   len(layers), numpy.dtype(dtype).name, shape)
@@ -386,10 +468,14 @@ class InferenceEngine(Logger):
                 if self._model is model:
                     self._model = old
                     self._version = old.version if old else 0
+                    # ... with ITS serving limits — the failed
+                    # source's adopted ladder must not survive it
+                    (self.buckets, self.max_batch,
+                     self._warmup_manifest) = old_limits
                     if telemetry.enabled():
                         # keep the gauge on the version that SERVES
-                        telemetry.gauge("serving.model_version").set(
-                            self._version)
+                        telemetry.gauge(self._label(
+                            "serving.model_version")).set(self._version)
             if old is not None:
                 self._ready.set()
                 self.warning("reload of %s failed at warmup; still "
@@ -398,8 +484,8 @@ class InferenceEngine(Logger):
         return self._version
 
     def _load_source(self, source):
-        """Normalize any source into
-        (layers, per-layer arrays, label, sample_shape)."""
+        """Normalize any source into (layers, per-layer arrays, label,
+        sample_shape, warmup-manifest-or-None)."""
         if isinstance(source, tuple) and len(source) == 2:
             manifest, arrays = source
             return self._from_manifest(manifest, arrays, "<in-memory>")
@@ -425,7 +511,8 @@ class InferenceEngine(Logger):
             arrays_list.append(p)
         shape = manifest.get("input_sample_shape")
         shape = tuple(int(d) for d in shape) if shape else None
-        return layers, arrays_list, label, shape
+        return layers, arrays_list, label, shape, \
+            manifest.get("serving")
 
     def _from_snapshot(self, state, label):
         topology = state.get("topology")
@@ -452,7 +539,8 @@ class InferenceEngine(Logger):
                                label)
         shape = topology.get("input_sample_shape")
         shape = tuple(int(d) for d in shape) if shape else None
-        return layers, arrays_list, label, shape
+        return layers, arrays_list, label, shape, \
+            topology.get("serving")
 
     # -- buckets / prediction ----------------------------------------------
     def bucket_for(self, n):
@@ -488,8 +576,11 @@ class InferenceEngine(Logger):
             with self._breaker_lock:
                 breaker = self._breakers.get(bucket)
                 if breaker is None:
+                    bname = ("serving.b%d" % bucket
+                             if self.name is None else
+                             "serving.%s.b%d" % (self.name, bucket))
                     breaker = CircuitBreaker(
-                        "serving.b%d" % bucket, threshold=threshold,
+                        bname, threshold=threshold,
                         cooldown_s=cooldown_s,
                         half_open_max=half_open_max)
                     self._breakers[bucket] = breaker
@@ -512,6 +603,28 @@ class InferenceEngine(Logger):
         m = self._model
         if m is None:
             raise RuntimeError("no model loaded")
+        # snapshot the callable + params: a concurrent evict() nulls
+        # them on the generation in place, and an admitted dispatch
+        # must keep the executable alive through its own forward (the
+        # local refs do) instead of crashing mid-flight.  Bounded
+        # retry: under budget thrash another request's evict can land
+        # between our restore and the re-read — loop a few times, then
+        # fail as the server error it is (NOT a client 400)
+        fn = params = None
+        for _ in range(3):
+            fn, params = m.fn, m.params
+            if fn is not None and params is not None:
+                break
+            # evicted by the registry's LRU budget: lazy re-warm —
+            # params re-upload + executable rebuild (a persistent-
+            # cache load when compile_cache is wired)
+            self.restore()
+            m = self._model
+        else:
+            raise RuntimeError(
+                "model%s evicted faster than it restores — the "
+                "registry memory budget is thrashing"
+                % (" %r" % self.name if self.name else ""))
         x = numpy.asarray(x, dtype=m.dtype)
         if m.sample_shape is not None:
             sample = tuple(m.sample_shape)
@@ -544,7 +657,7 @@ class InferenceEngine(Logger):
         def _dispatch():
             if faults.enabled():
                 faults.check("serving.forward")
-            return m.fn(m.params, x)
+            return fn(params, x)
 
         def _forward():
             return faults.retry_call(_dispatch, "serving.forward")
@@ -559,8 +672,12 @@ class InferenceEngine(Logger):
             if profiler.enabled():
                 # cost registry: this bucket's forward executable
                 # (lowered pre-dispatch — the dispatch reuses the trace)
+                cost_name = ("serving.forward.b%d" % bucket
+                             if self.name is None else
+                             "serving.forward.%s.b%d"
+                             % (self.name, bucket))
                 profiler.register_jit_cost(
-                    "serving.forward.b%d" % bucket, m.fn, (m.params, x),
+                    cost_name, fn, (params, x),
                     bucket=bucket, model_version=m.version)
         # admission immediately adjacent to the recorded region: an
         # admitted call (half-open probe slot included) is ALWAYS
@@ -572,14 +689,16 @@ class InferenceEngine(Logger):
                 y = numpy.asarray(_forward())[:n]
             else:
                 attrs = {"rows": n, "bucket": bucket}
+                if self.name is not None:
+                    attrs["model"] = self.name
                 if request_ids:
                     attrs["request_ids"] = list(request_ids)
                 with telemetry.span("serving.predict", **attrs):
                     y = numpy.asarray(_forward())[:n]
                 # per-bucket traffic: which compiled executables earn
                 # their keep (next to serving.compiles.<bucket> on
-                # /metrics)
-                telemetry.counter(telemetry.labeled(
+                # /metrics); named engines carry the model label
+                telemetry.counter(self._label(
                     "serving.predictions", bucket=bucket)).inc()
         except (ValueError, TypeError):
             # shape/dtype errors surfacing at trace time are the
@@ -607,8 +726,10 @@ class InferenceEngine(Logger):
         if first:
             m.warm.add(bucket)
             if telemetry.enabled():
-                telemetry.counter("serving.compiles.%d" % bucket).inc()
-                telemetry.gauge("serving.warm_buckets").set(len(m.warm))
+                telemetry.counter(self._label(
+                    "serving.compiles.%d" % bucket)).inc()
+                telemetry.gauge(self._label(
+                    "serving.warm_buckets")).set(len(m.warm))
         return y
 
     def warmup(self):
@@ -634,6 +755,79 @@ class InferenceEngine(Logger):
                                      dtype=m.dtype))
         self._ready.set()
         self.info("warm: buckets %s", list(self.buckets))
+
+    # -- eviction (registry LRU) --------------------------------------------
+    def _ledger_swap(self, old_bytes, new_bytes):
+        """Attribute this model's device params in the PR 4 memory
+        ledger (``serving.model.<name>``) so /debug/profiler and the
+        leak check see serving-side residency next to training Arrays.
+        """
+        from znicz_tpu.core import profiler
+        if not profiler.enabled() or old_bytes == new_bytes:
+            return
+        profiler.ledger_swap(
+            "serving.model.%s" % (self.name or "default"),
+            int(old_bytes), int(new_bytes))
+
+    def evict(self):
+        """Release the model's DEVICE footprint — params and compiled
+        executables — keeping the host-side copy so :meth:`restore`
+        (or the next :meth:`predict`) can bring it back without
+        touching the source.  The registry's LRU budget calls this for
+        the coldest model; readiness clears until the lazy re-warm.
+        Returns True when something was actually released."""
+        with self._load_lock:
+            m = self._model
+            if m is None or m.params is None:
+                return False
+            old_bytes = self.device_bytes
+            # dropping the jitted callable drops the executable refs;
+            # dropping the param arrays frees the device buffers — the
+            # host_params numpy copies stay for restore()
+            m.params = None
+            m.fn = None
+            m.warm.clear()
+            self._ready.clear()
+            self._evictions += 1
+        self._ledger_swap(old_bytes, 0)
+        if telemetry.enabled():
+            telemetry.counter(self._label("serving.evictions")).inc()
+            telemetry.gauge(self._label("serving.warm_buckets")).set(0)
+        event = {"version": self._version, "released_bytes": old_bytes}
+        if self.name is not None:
+            event["model"] = self.name
+        telemetry.record_event("serving.evict", **event)
+        self.info("evicted: released %d device bytes%s", old_bytes,
+                  " (model %s)" % self.name if self.name else "")
+        return True
+
+    def restore(self):
+        """Undo :meth:`evict`: re-upload the params and rebuild the
+        jitted forward, then re-warm (when warmup is wanted) — with the
+        persistent compilation cache wired every bucket's "compile" is
+        a cache load, so a restore costs an upload plus milliseconds.
+        Returns True when a restore actually happened."""
+        import jax
+        with self._load_lock:
+            m = self._model
+            if m is None:
+                raise RuntimeError("no model loaded")
+            if m.params is not None and m.fn is not None:
+                return False  # resident — nothing to do
+            m.params = jax.device_put(m.host_params)
+            m.fn = _build_forward(m.layers)
+            m.warm.clear()
+        self._ledger_swap(0, self.device_bytes)
+        event = {"version": self._version,
+                 "device_bytes": self.device_bytes}
+        if self.name is not None:
+            event["model"] = self.name
+        telemetry.record_event("serving.restore", **event)
+        if self._warmup_wanted and m.sample_shape is not None:
+            self.warmup()
+        else:
+            self._ready.set()
+        return True
 
 
 def matches_sample_shape(shape, sample):

@@ -1,0 +1,354 @@
+"""Multi-model registry — several inference engines behind one server.
+
+The PR 2 serving tier carried exactly one model per process.  A
+production replica hosts a *fleet*: the registry maps URL-routable
+model names to :class:`~znicz_tpu.serving.engine.InferenceEngine`
+instances and owns the cross-model policies the single-engine stack
+never needed:
+
+* **Hot add / remove / reload.**  ``add(name, source)`` on a new name
+  loads + warms a fresh engine; on an existing name it hot-reloads
+  that engine in place (same executable-reuse and warmup-rollback
+  semantics as ``POST /reload`` — a failed reload leaves THAT model
+  serving its previous generation and never touches the others).
+  ``remove(name)`` drops the engine; its device buffers free with the
+  last reference.
+* **LRU eviction under a device-memory budget.**  TPU HBM is the
+  scarce resource; a registry asked to host more params than the
+  budget (``root.common.serving.registry_memory_budget_bytes``, live
+  config read; 0 = unlimited) evicts the least-recently-USED model's
+  device state — params and compiled executables — via
+  ``engine.evict()``, keeping host copies.  The next request to an
+  evicted model lazily restores it (re-upload + re-warm; with the
+  persistent compilation cache of :mod:`znicz_tpu.core.compile_cache`
+  the re-warm is a cache load, not a recompile).  Residency is
+  attributed in the PR 4 device-memory ledger as
+  ``serving.model.<name>``.
+* **Per-model observability.**  Every engine is created with
+  ``name=``, so its predictions/compiles/warm-bucket series, breaker
+  names, spans and journal events all carry a ``model_<name>`` label —
+  two models' metrics never collide on one /metrics page.  The
+  registry adds ``serving.registry_models`` /
+  ``serving.registry_resident_bytes`` gauges, a
+  ``serving.registry_evictions`` counter and ``registry.add`` /
+  ``registry.remove`` journal events.
+
+Thread safety: all public methods are safe under concurrent HTTP
+traffic; the registry lock orders membership changes, while each
+engine's own load lock orders its generation swaps.
+"""
+
+import re
+import threading
+import time
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import compile_cache, telemetry
+from znicz_tpu.serving.engine import InferenceEngine
+
+#: URL-routable model names (they appear in /predict/<name> paths,
+#: metric series and journal events)
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+class UnknownModelError(KeyError):
+    """No such model in the registry (HTTP 404)."""
+
+    def __init__(self, name, known):
+        self.model = name
+        super(UnknownModelError, self).__init__(
+            "unknown model %r (serving: %s)"
+            % (name, sorted(known) or "none"))
+
+    def __str__(self):  # KeyError would repr() the message
+        return self.args[0]
+
+
+class _Entry(object):
+    __slots__ = ("engine", "last_used", "added")
+
+    def __init__(self, engine, now):
+        self.engine = engine
+        self.last_used = now
+        self.added = now
+
+
+class ModelRegistry(Logger):
+    """Named engines + routing + LRU residency (see module docstring).
+
+    ``models`` (optional) is a ``{name: source}`` dict loaded at
+    construction; ``memory_budget_bytes`` overrides the config budget
+    (None = follow live config); ``engine_defaults`` are passed to
+    every engine the registry creates (``max_batch=``, ``warmup=``,
+    ...).
+    """
+
+    def __init__(self, models=None, memory_budget_bytes=None,
+                 **engine_defaults):
+        super(ModelRegistry, self).__init__(
+            logger_name="ModelRegistry")
+        self._lock = threading.RLock()
+        self._entries = {}
+        self._default = None
+        self._budget_override = memory_budget_bytes
+        self._engine_defaults = dict(engine_defaults)
+        self._evictions = 0
+        if models:
+            for name in sorted(models):
+                self.add(name, models[name])
+
+    # -- membership ---------------------------------------------------------
+    def add(self, name, source, **engine_kwargs):
+        """Load (or hot-reload) model ``name`` from ``source``; returns
+        the engine's new version.
+
+        A NEW name builds + warms a fresh engine before it becomes
+        routable — a model that fails to load never enters the
+        registry.  An EXISTING name hot-reloads in place: the old
+        generation keeps serving until the new one warms, and a failed
+        reload rolls back scoped to this one model (engine.load's
+        contract) — every other model is untouched.
+        """
+        name = str(name)
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                "model name %r is not URL-routable (allowed: letters, "
+                "digits, '.', '_', '-'; max 64 chars)" % name)
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is not None:
+            # hot reload supports only what engine.load() takes; a
+            # constructor-only knob (max_batch, warmup, ...) must fail
+            # loudly, not be accepted-and-ignored — remove + re-add to
+            # change those
+            unsupported = set(engine_kwargs) - {"sample_shape"}
+            if unsupported:
+                raise ValueError(
+                    "model %r exists — a hot reload cannot change %s "
+                    "(remove the model and add it again)"
+                    % (name, sorted(unsupported)))
+            version = entry.engine.load(source, **engine_kwargs)
+            self._touch(name)
+            self._enforce_budget(protect=name)
+            return version
+        kwargs = dict(self._engine_defaults)
+        kwargs.update(engine_kwargs)
+        engine = InferenceEngine(source, name=name, **kwargs)
+        now = time.monotonic()
+        with self._lock:
+            if name in self._entries:
+                # lost a concurrent add race — keep the winner
+                raise ValueError("model %r was added concurrently"
+                                 % name)
+            self._entries[name] = _Entry(engine, now)
+            if self._default is None:
+                self._default = name
+            count = len(self._entries)
+        telemetry.record_event("registry.add", model=name,
+                               version=engine.version,
+                               source=str(engine.source))
+        if telemetry.enabled():
+            telemetry.gauge("serving.registry_models").set(count)
+        self.info("model %r added (v%d, %d model%s registered)",
+                  name, engine.version, count,
+                  "" if count == 1 else "s")
+        self._enforce_budget(protect=name)
+        return engine.version
+
+    def reload(self, name, source=None):
+        """Hot-reload ``name`` (default model when None) from
+        ``source``; ``source=None`` re-reads the engine's recorded
+        source path.  Rollback is scoped to this model."""
+        entry = self._entry(name)
+        src = source
+        if src is None:
+            src = entry.engine.source
+            if not src or str(src).startswith("<"):
+                raise ValueError(
+                    "model %r has no on-disk source to re-read — pass "
+                    "an explicit path" % (name or self._default))
+        version = entry.engine.load(src)
+        self._touch(name or self._default)
+        self._enforce_budget(protect=name or self._default)
+        return version
+
+    def remove(self, name):
+        """Drop model ``name``; its device buffers free with the last
+        in-flight reference.  The default model re-points to the
+        oldest remaining entry."""
+        with self._lock:
+            entry = self._entries.pop(name, None)
+            if entry is None:
+                raise UnknownModelError(name, self._entries)
+            if self._default == name:
+                remaining = sorted(self._entries.items(),
+                                   key=lambda kv: kv[1].added)
+                self._default = remaining[0][0] if remaining else None
+            count = len(self._entries)
+        telemetry.record_event("registry.remove", model=name)
+        if telemetry.enabled():
+            telemetry.gauge("serving.registry_models").set(count)
+            telemetry.gauge("serving.registry_resident_bytes").set(
+                self.resident_bytes)
+        self.info("model %r removed (%d left)", name, count)
+        return entry.engine
+
+    # -- resolution ---------------------------------------------------------
+    def _entry(self, name=None):
+        with self._lock:
+            key = name if name is not None else self._default
+            if key is None or key not in self._entries:
+                raise UnknownModelError(key, self._entries)
+            return self._entries[key]
+
+    def _touch(self, name):
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is not None:
+                entry.last_used = time.monotonic()
+
+    def engine(self, name=None):
+        """The engine serving ``name`` (default model when None),
+        marked most-recently-used.  An evicted model is restored HERE
+        — the lazy re-warm happens on the routing path, and restoring
+        it may push another cold model out under the budget.  The
+        budget is a LIVE config read, so it is also enforced here:
+        an operator tightening it at runtime sheds cold models on the
+        next request, not on the next reload."""
+        entry = self._entry(name)
+        key = name if name is not None else self._default
+        self._touch(key)
+        if not entry.engine.resident and entry.engine.version:
+            entry.engine.restore()
+            self._enforce_budget(protect=key)
+        elif self.budget_bytes() > 0:
+            self._enforce_budget(protect=key)
+        return entry.engine
+
+    def peek(self, name=None):
+        """The engine WITHOUT marking it used or restoring it — the
+        observation path.  Health probes and stats must never trigger
+        the lazy re-warm (a kubelet poll restoring an evicted model
+        would defeat the LRU budget)."""
+        return self._entry(name).engine
+
+    def names(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._entries
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def default(self):
+        return self._default
+
+    @default.setter
+    def default(self, name):
+        with self._lock:
+            if name is not None and name not in self._entries:
+                raise UnknownModelError(name, self._entries)
+            self._default = name
+
+    # -- readiness / stats --------------------------------------------------
+    def readiness(self):
+        """{model: ready} — the per-model truth /healthz reports."""
+        with self._lock:
+            items = list(self._entries.items())
+        return {name: entry.engine.ready for name, entry in items}
+
+    @property
+    def ready(self):
+        """True when EVERY registered model is ready (and there is at
+        least one) — 'some ready' is the degraded state, reported
+        per-model by /healthz."""
+        r = self.readiness()
+        return bool(r) and all(r.values())
+
+    @property
+    def resident_bytes(self):
+        with self._lock:
+            items = list(self._entries.values())
+        return sum(e.engine.device_bytes for e in items)
+
+    def budget_bytes(self):
+        """Live config read (``registry_memory_budget_bytes``) unless
+        the constructor pinned an override — the operator can widen or
+        tighten the budget at runtime."""
+        if self._budget_override is not None:
+            return int(self._budget_override)
+        return int(root.common.serving.get(
+            "registry_memory_budget_bytes", 0) or 0)
+
+    def memory_stats(self):
+        """Just the budget block — cheap enough for every /healthz
+        poll (cached per-generation byte counts, no per-model stats,
+        no cache-directory walk)."""
+        return {
+            "budget_bytes": self.budget_bytes(),
+            "resident_bytes": self.resident_bytes,
+            "evictions": self._evictions,
+        }
+
+    def stats(self):
+        """The registry block of /statusz and /healthz payloads."""
+        with self._lock:
+            items = sorted(self._entries.items())
+            default = self._default
+        return {
+            "models": {name: entry.engine.stats()
+                       for name, entry in items},
+            "default": default,
+            "memory": self.memory_stats(),
+            "compile_cache": compile_cache.stats(),
+        }
+
+    # -- the LRU budget -----------------------------------------------------
+    def _enforce_budget(self, protect=None):
+        """Evict least-recently-used RESIDENT models until the
+        resident params total fits the budget.  ``protect`` (the model
+        being added/served right now) is never evicted — the hot model
+        must not be sacrificed to fit a cold one."""
+        budget = self.budget_bytes()
+        if budget <= 0:
+            if telemetry.enabled():
+                telemetry.gauge("serving.registry_resident_bytes").set(
+                    self.resident_bytes)
+            return
+        while True:
+            with self._lock:
+                total = sum(e.engine.device_bytes
+                            for e in self._entries.values())
+                if total <= budget:
+                    break
+                victims = sorted(
+                    ((e.last_used, name, e) for name, e in
+                     self._entries.items()
+                     if name != protect and e.engine.resident),
+                    key=lambda t: t[0])
+                if not victims:
+                    self.warning(
+                        "registry over budget (%d > %d bytes) but "
+                        "nothing evictable", total, budget)
+                    break
+                _, victim_name, victim = victims[0]
+            # evict OUTSIDE the registry lock: it takes the engine's
+            # load lock and may race an in-flight predict on that
+            # engine, which must never deadlock against add()/stats()
+            if victim.engine.evict():
+                with self._lock:
+                    self._evictions += 1
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "serving.registry_evictions").inc()
+                self.info("LRU-evicted model %r (budget %d bytes)",
+                          victim_name, budget)
+        if telemetry.enabled():
+            telemetry.gauge("serving.registry_resident_bytes").set(
+                self.resident_bytes)

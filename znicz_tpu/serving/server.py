@@ -1,37 +1,56 @@
-"""Production HTTP front end for the inference engine.
+"""Production HTTP front end — single engine or a whole model registry.
 
 Built on the shared stdlib HTTP plumbing of
 :mod:`znicz_tpu.core.status_server` (``HttpServerBase``/``HandlerBase``
 — one ``ThreadingHTTPServer`` on a daemon thread).  Every request
-thread submits to the :class:`~znicz_tpu.serving.batcher.MicroBatcher`
-and blocks on its future, so concurrent HTTP clients coalesce into
-micro-batches without any extra machinery.
+thread submits to the batcher and blocks on its future, so concurrent
+HTTP clients coalesce without any extra machinery.  Two modes:
+
+* **single-engine** (the PR 2 contract, unchanged): ``engine=`` + a
+  :class:`~znicz_tpu.serving.batcher.MicroBatcher`;
+* **registry** (``registry=``): a
+  :class:`~znicz_tpu.serving.registry.ModelRegistry` of named engines
+  behind a
+  :class:`~znicz_tpu.serving.continuous.ContinuousBatcher` —
+  per-model routing, hot add/remove/reload over HTTP, LRU residency.
 
 Endpoints:
 
-* ``POST /predict`` — JSON body ``{"inputs": [[...], ...]}`` (or a bare
-  JSON array), or a raw ``.npy`` payload with
-  ``Content-Type: application/octet-stream``.  Replies in kind: JSON
-  ``{"outputs": ..., "argmax": ..., "model_version": ...,
-  "request_id": ...}`` or raw ``.npy`` bytes.  Status codes: 400
-  malformed, 413 body over ``root.common.serving.max_body_bytes``
-  (refused before reading), 429 queue full (backpressure), 503 not
-  warmed up / draining / circuit open (the breaker 503 carries a
-  ``Retry-After`` header — serving/breaker.py), 504 deadline
-  expired.  Every reply (success or error) echoes the
-  request's tracing id in the ``X-Request-Id`` header — the client's
-  own id when it sent one, a generated one otherwise; the id
-  propagates through the micro-batcher into the engine's dispatch
-  span, and requests over ``root.common.serving.slow_request_ms`` are
-  logged with their queue/assembly/device breakdown.
-* ``GET /healthz`` — readiness probe: 200 once warmup finished, 503
-  while compiling; body is the engine's stats dict.
-* ``POST /reload`` — ``{"path": "..."}`` hot-swaps the model from a new
-  snapshot/package path.  Unchanged topology reuses every compiled
-  bucket (zero recompiles); a changed one re-warms before flipping
-  readiness back.
-* ``GET /metrics`` — the telemetry registry in Prometheus text format.
-* ``GET /statusz`` (and ``/``) — JSON serving stats.
+* ``POST /predict`` and ``POST /predict/<model>`` — JSON body
+  ``{"inputs": [[...], ...], "model": optional}`` (or a bare JSON
+  array), or a raw ``.npy`` payload with
+  ``Content-Type: application/octet-stream``.  The path segment wins
+  over the body's ``model`` field; neither = the registry's default
+  model.  Replies in kind: JSON ``{"outputs": ..., "argmax": ...,
+  "model": ..., "model_version": ..., "request_id": ...}`` or raw
+  ``.npy`` bytes.  Status codes: 400 malformed, 404 unknown model,
+  413 body over ``root.common.serving.max_body_bytes`` (refused
+  before reading), 429 queue full (backpressure), 503 not warmed
+  up / draining / circuit open (the breaker 503 carries a
+  ``Retry-After`` header — serving/breaker.py), 504 deadline expired.
+  Every reply (success or error) echoes the request's tracing id in
+  the ``X-Request-Id`` header; requests over
+  ``root.common.serving.slow_request_ms`` are logged with their
+  queue/assembly/device breakdown.
+* ``GET /healthz`` — readiness probe.  Single-engine: 200 once warmup
+  finished, 503 while compiling.  Registry: **per-model readiness** —
+  the body carries ``{"models": {name: ready...}, "ready": all,
+  "degraded": some-but-not-all}``; the status code is 503 only when NO
+  model is ready (globally dead) — one broken model among healthy
+  ones answers 200 + ``degraded`` so the balancer keeps routing the
+  healthy traffic.  ``GET /healthz/<model>`` probes one model
+  (200/503; 404 unknown).
+* ``POST /models/<name>`` — admin: ``{"path": "..."}`` hot-ADDS a new
+  model (loaded + warmed before it becomes routable) or hot-RELOADS
+  an existing one (rollback scoped to that model).
+  ``DELETE /models/<name>`` removes it; ``GET /models`` lists the
+  registry (per-model stats + memory budget + compile-cache state).
+* ``POST /reload`` — back-compat single-model hot swap
+  (``{"path": "...", "model": optional}``).
+* ``GET /metrics`` — the telemetry registry in Prometheus text format
+  (per-model series carry ``model_<name>`` labels).
+* ``GET /statusz`` (and ``/``) — JSON serving stats (registry + queue
+  + compile-cache blocks).
 * ``GET /debug/health`` / ``GET /debug/events`` /
   ``GET /debug/profile?seconds=N`` / ``GET /debug/profiler`` — the
   health monitor status, the flight-recorder journal, on-demand
@@ -44,6 +63,8 @@ CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
     python -m znicz_tpu serve wine_current.0.pickle --port 8899
     python -m znicz_tpu serve --latest wine          # newest snapshot
     python -m znicz_tpu serve model.zip --max-batch 32 --max-delay-ms 2
+    # multi-model registry + continuous batching + persistent cache:
+    python -m znicz_tpu serve wine=wine.pickle mnist=mnist.zip
 """
 
 import argparse
@@ -57,29 +78,50 @@ import numpy
 from znicz_tpu.core.config import root
 from znicz_tpu.core.status_server import (BodyTooLargeError, HandlerBase,
                                           HttpServerBase)
-from znicz_tpu.core import telemetry
+from znicz_tpu.core import compile_cache, telemetry
 from znicz_tpu.serving.batcher import (BatcherStoppedError, MicroBatcher,
                                        QueueFullError,
                                        RequestTimeoutError)
 from znicz_tpu.serving.breaker import CircuitOpenError
 from znicz_tpu.serving.engine import InferenceEngine
+from znicz_tpu.serving.registry import ModelRegistry, UnknownModelError
 
 
 class ServingServer(HttpServerBase):
-    """HTTP front end over an engine + micro-batcher.
+    """HTTP front end over an engine + micro-batcher, or a registry +
+    continuous batcher.
 
     When ``batcher`` is None one is created (and owned: ``stop()``
-    stops it too) with the ``root.common.serving`` defaults.
+    stops it too) with the ``root.common.serving`` defaults — a
+    :class:`MicroBatcher` for ``engine=``, a
+    :class:`~znicz_tpu.serving.continuous.ContinuousBatcher` for
+    ``registry=``.
     """
 
-    def __init__(self, engine, batcher=None, port=0, host=None):
+    def __init__(self, engine=None, batcher=None, port=0, host=None,
+                 registry=None):
         cfg = root.common.serving
         super(ServingServer, self).__init__(
             port=port, host=host or cfg.get("host", "127.0.0.1"),
             logger_name="ServingServer")
+        if (engine is None) == (registry is None):
+            raise ValueError(
+                "pass exactly one of engine= (single-model) or "
+                "registry= (multi-model)")
         self.engine = engine
+        self.registry = registry
         self._owns_batcher = batcher is None
-        self.batcher = batcher or MicroBatcher(engine).start()
+        if batcher is None:
+            if registry is not None:
+                from znicz_tpu.serving.continuous import \
+                    ContinuousBatcher
+                batcher = ContinuousBatcher(registry).start()
+            else:
+                batcher = MicroBatcher(engine).start()
+        self.batcher = batcher
+        #: whether the batcher routes by model name (continuous
+        #: batcher / any batcher with a model kwarg)
+        self._routed_batcher = registry is not None
         #: graceful-drain latch: once set, /predict answers 503
         #: ("draining") and /healthz reports not-ready so load
         #: balancers stop routing here while in-flight work flushes
@@ -110,8 +152,24 @@ class ServingServer(HttpServerBase):
             self.batcher.stop(flush=True)
         self.stop()
 
+    def _engine_for(self, model=None):
+        """The engine serving ``model`` — registry resolution (raises
+        :class:`UnknownModelError` → 404) or the single engine (a
+        model name then only resolves if there is nothing to route
+        by)."""
+        if self.registry is not None:
+            return self.registry.engine(model)
+        if model is not None:
+            raise UnknownModelError(model, ())
+        return self.engine
+
     def statusz(self):
-        payload = dict(self.engine.stats())
+        if self.registry is not None:
+            payload = {"registry": self.registry.stats(),
+                       "ready": self.registry.ready}
+        else:
+            payload = dict(self.engine.stats())
+            payload["compile_cache"] = compile_cache.stats()
         payload["queued_rows"] = self.batcher.queued_rows
         if telemetry.enabled():
             serving = telemetry.serving_summary()
@@ -119,27 +177,60 @@ class ServingServer(HttpServerBase):
                 payload["serving"] = serving
         return payload
 
+    def healthz(self):
+        """(status code, payload) for /healthz — the per-model truth.
+
+        Registry mode: 503 only when NO model is ready (globally
+        dead); a mixed registry answers 200 with ``degraded: true``
+        and the per-model map, so one broken model neither reads as
+        global health nor pulls the healthy models out of rotation.
+        """
+        if self.registry is None:
+            stats = self.engine.stats()
+            if self._draining:
+                stats = dict(stats, ready=False, draining=True)
+            return (200 if stats["ready"] else 503), stats
+        readiness = self.registry.readiness()
+        any_ready = any(readiness.values())
+        all_ready = bool(readiness) and all(readiness.values())
+        payload = {
+            "ready": all_ready and not self._draining,
+            "degraded": any_ready and not all_ready,
+            "models": readiness,
+            "default": self.registry.default,
+            # the probe path stays cheap: the memory block alone (no
+            # per-model stats, ONE compile-cache directory walk)
+            "memory": self.registry.memory_stats(),
+            "compile_cache": compile_cache.stats(),
+        }
+        if self._draining:
+            payload["draining"] = True
+            return 503, payload
+        return (200 if any_ready else 503), payload
+
     # -- request plumbing ---------------------------------------------------
     def _parse_predict(self, handler):
-        """(array, timeout_ms, raw_reply) from the request body."""
+        """(array-or-None, timeout_ms, raw_reply, model) from the
+        request body; the array stays unparsed (None) until the model
+        is known — it must parse straight into THAT model's dtype."""
         body = handler._read_body()
         ctype = (handler.headers.get("Content-Type") or "").split(";")[0]
         if ctype == "application/octet-stream" or \
                 body[:6] == b"\x93NUMPY":
-            return numpy.load(io.BytesIO(body)), None, True
+            return numpy.load(io.BytesIO(body)), None, True, None
         doc = json.loads(body.decode() or "null")
         if isinstance(doc, dict):
             inputs = doc.get("inputs")
             timeout_ms = doc.get("timeout_ms")
+            model = doc.get("model")
         else:
-            inputs, timeout_ms = doc, None
+            inputs, timeout_ms, model = doc, None, None
         if inputs is None:
             raise ValueError('body needs {"inputs": [[...], ...]} '
                              "(or a raw .npy payload)")
-        # parse straight into the model's compute dtype — a float64
-        # intermediate would cost a second full-batch copy per dispatch
-        dtype = self.engine.dtype or numpy.float32
-        return numpy.asarray(inputs, dtype=dtype), timeout_ms, False
+        if model is not None and not isinstance(model, str):
+            raise ValueError('"model" must be a string')
+        return inputs, timeout_ms, False, model
 
     @staticmethod
     def _request_id(handler):
@@ -150,7 +241,7 @@ class ServingServer(HttpServerBase):
         rid = (handler.headers.get("X-Request-Id") or "").strip()
         return rid[:64] if rid else uuid.uuid4().hex[:12]
 
-    def _predict(self, handler):
+    def _predict(self, handler, model=None):
         rid = self._request_id(handler)
         echo = {"X-Request-Id": rid}
         if self._draining:
@@ -162,14 +253,9 @@ class ServingServer(HttpServerBase):
                       "request_id": rid},
                 headers=dict(echo, **{"Retry-After": "1"}))
             return
-        if not self.engine.ready:
-            handler._drain_body()  # keep-alive: no unread bytes behind
-            handler._send_json(503, {"error": "model warming up",
-                                     "ready": False,
-                                     "request_id": rid}, headers=echo)
-            return
         try:
-            x, timeout_ms, raw = self._parse_predict(handler)
+            inputs, timeout_ms, raw, body_model = \
+                self._parse_predict(handler)
         except BodyTooLargeError as e:
             # the unread oversized body already forced Connection:
             # close in _read_body — answer honestly and drop the socket
@@ -180,9 +266,41 @@ class ServingServer(HttpServerBase):
             handler._send_json(400, {"error": repr(e),
                                      "request_id": rid}, headers=echo)
             return
+        # the URL path segment wins over the body's "model" field
+        model = model if model is not None else body_model
         try:
-            y = self.batcher.predict(x, timeout_ms=timeout_ms,
-                                     request_id=rid)
+            engine = self._engine_for(model)
+        except UnknownModelError as e:
+            handler._send_json(404, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
+            return
+        if not engine.ready:
+            handler._send_json(503, {"error": "model warming up",
+                                     "ready": False, "model": model,
+                                     "request_id": rid}, headers=echo)
+            return
+        try:
+            # parse straight into the routed model's compute dtype — a
+            # float64 intermediate would cost a second full-batch copy
+            x = numpy.asarray(inputs,
+                              dtype=engine.dtype or numpy.float32)
+        except Exception as e:  # noqa: BLE001 - client error
+            handler._send_json(400, {"error": repr(e),
+                                     "request_id": rid}, headers=echo)
+            return
+        try:
+            if self._routed_batcher:
+                y = self.batcher.predict(x, model=model,
+                                         timeout_ms=timeout_ms,
+                                         request_id=rid)
+            else:
+                y = self.batcher.predict(x, timeout_ms=timeout_ms,
+                                         request_id=rid)
+        except UnknownModelError as e:
+            # the model was removed between resolution and dispatch
+            handler._send_json(404, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
+            return
         except BatcherStoppedError:
             # the submit raced drain()/stop(): same honest 503 the
             # pre-admission _draining check produces
@@ -228,13 +346,58 @@ class ServingServer(HttpServerBase):
                           buf.getvalue(), headers=echo)
         else:
             payload = {"outputs": y.tolist(),
-                       "model_version": self.engine.version,
+                       "model_version": engine.version,
                        "request_id": rid}
+            if model is not None:
+                payload["model"] = model
             if y.ndim == 2:
                 payload["argmax"] = [int(i) for i in y.argmax(axis=1)]
             handler._send_json(200, payload, headers=echo)
 
-    def _reload(self, handler):
+    def _reload(self, handler, model=None):
+        try:
+            doc = json.loads(handler._read_body().decode() or "{}")
+            path = doc["path"]
+            model = model if model is not None else doc.get("model")
+        except BodyTooLargeError as e:
+            handler._send_json(413, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - client error
+            handler._send_json(400, {"error": 'body needs {"path": '
+                                              '"..."} (%r)' % e})
+            return
+        try:
+            if self.registry is not None:
+                version = self.registry.reload(model, path)
+                engine = self.registry.engine(model)
+            else:
+                engine = self._engine_for(model)
+                version = engine.load(path)
+        except UnknownModelError as e:
+            handler._send_json(404, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - bad model file
+            # a failed (re)load rolled back scoped to this one model —
+            # the registry keeps serving every other model untouched
+            handler._send_json(400, {"error": repr(e)})
+            return
+        payload = {"model_version": version, "source": path,
+                   "ready": engine.ready}
+        if model is not None:
+            payload["model"] = model
+        handler._send_json(200, payload)
+
+    # -- registry admin -----------------------------------------------------
+    def _admin_add(self, handler, name):
+        """POST /models/<name>: hot add (new name) or hot reload
+        (existing name) — the model only becomes routable after load +
+        warmup succeed."""
+        if self.registry is None:
+            handler._drain_body()  # keep-alive hygiene
+            handler._send_json(400, {
+                "error": "this server hosts a single engine — start "
+                         "it with a ModelRegistry for admin routing"})
+            return
         try:
             doc = json.loads(handler._read_body().decode() or "{}")
             path = doc["path"]
@@ -245,14 +408,31 @@ class ServingServer(HttpServerBase):
             handler._send_json(400, {"error": 'body needs {"path": '
                                               '"..."} (%r)' % e})
             return
+        kwargs = {}
+        for key in ("max_batch", "sample_shape"):
+            if doc.get(key) is not None:
+                kwargs[key] = doc[key]
         try:
-            version = self.engine.load(path)
-        except Exception as e:  # noqa: BLE001 - bad model file
+            version = self.registry.add(name, path, **kwargs)
+        except Exception as e:  # noqa: BLE001 - bad model file/name
             handler._send_json(400, {"error": repr(e)})
             return
-        handler._send_json(200, {"model_version": version,
-                                 "source": path,
-                                 "ready": self.engine.ready})
+        handler._send_json(200, {
+            "model": name, "model_version": version, "source": path,
+            "models": self.registry.names()})
+
+    def _admin_remove(self, handler, name):
+        if self.registry is None:
+            handler._send_json(400, {
+                "error": "this server hosts a single engine"})
+            return
+        try:
+            self.registry.remove(name)
+        except UnknownModelError as e:
+            handler._send_json(404, {"error": str(e)})
+            return
+        handler._send_json(200, {"removed": name,
+                                 "models": self.registry.names()})
 
     def make_handler(self):
         server = self
@@ -261,17 +441,36 @@ class ServingServer(HttpServerBase):
             owner = server
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    stats = server.engine.stats()
-                    if server._draining:
-                        # readiness flips FIRST so the balancer stops
-                        # routing while queued work flushes
-                        stats = dict(stats, ready=False, draining=True)
-                    self._send_json(200 if stats["ready"] else 503,
-                                    stats)
-                elif self.path == "/metrics":
+                path = self.path.partition("?")[0]
+                if path == "/healthz":
+                    code, payload = server.healthz()
+                    self._send_json(code, payload)
+                elif path.startswith("/healthz/"):
+                    name = path[len("/healthz/"):]
+                    try:
+                        # observation only: a health probe must never
+                        # restore an evicted model (registry.peek) —
+                        # only real traffic pays the lazy re-warm
+                        engine = (server.registry.peek(name)
+                                  if server.registry is not None
+                                  else server._engine_for(name))
+                    except UnknownModelError as e:
+                        self._send_json(404, {"error": str(e)})
+                        return
+                    ready = engine.ready and not server._draining
+                    self._send_json(200 if ready else 503,
+                                    engine.stats())
+                elif path == "/models":
+                    if server.registry is not None:
+                        self._send_json(200, server.registry.stats())
+                    else:
+                        self._send_json(200, {
+                            "models": {"default":
+                                       server.engine.stats()},
+                            "default": "default"})
+                elif path == "/metrics":
                     self._send_metrics()
-                elif self.path in ("/", "/statusz"):
+                elif path in ("/", "/statusz"):
                     self._send_json(200, server.statusz())
                 elif self._handle_debug():
                     pass
@@ -279,12 +478,26 @@ class ServingServer(HttpServerBase):
                     self._send_json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path == "/predict":
+                path = self.path.partition("?")[0]
+                if path == "/predict":
                     server._predict(self)
-                elif self.path == "/reload":
+                elif path.startswith("/predict/"):
+                    server._predict(self, model=path[len("/predict/"):])
+                elif path == "/reload":
                     server._reload(self)
+                elif path.startswith("/models/"):
+                    server._admin_add(self, path[len("/models/"):])
                 else:
                     self._drain_body()  # keep-alive hygiene
+                    self._send_json(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                path = self.path.partition("?")[0]
+                if path.startswith("/models/"):
+                    self._drain_body()
+                    server._admin_remove(self, path[len("/models/"):])
+                else:
+                    self._drain_body()
                     self._send_json(404, {"error": "not found"})
 
         return Handler
@@ -295,12 +508,16 @@ def main(argv=None):
     cfg = root.common.serving
     parser = argparse.ArgumentParser(
         prog="python -m znicz_tpu serve",
-        description="Serve a trained model (snapshot pickle or "
-                    "deployment package zip) over HTTP with dynamic "
-                    "micro-batching.")
-    parser.add_argument("model",
-                        help="snapshot/.zip path — or, with --latest, "
-                             "a snapshot prefix (e.g. 'wine')")
+        description="Serve trained models (snapshot pickles or "
+                    "deployment package zips) over HTTP.  One bare "
+                    "PATH serves a single engine with dynamic "
+                    "micro-batching; one or more NAME=PATH specs "
+                    "serve a multi-model registry with continuous "
+                    "batching and per-model /predict/<name> routing.")
+    parser.add_argument("model", nargs="+",
+                        help="snapshot/.zip path, NAME=PATH spec(s) "
+                             "for a registry — or, with --latest, a "
+                             "snapshot prefix (e.g. 'wine')")
     parser.add_argument("--latest", action="store_true",
                         help="treat MODEL as a snapshotter prefix and "
                              "serve the newest matching snapshot")
@@ -313,6 +530,13 @@ def main(argv=None):
     parser.add_argument("--max-delay-ms", type=float, default=None)
     parser.add_argument("--queue-limit", type=int, default=None)
     parser.add_argument("--timeout-ms", type=float, default=None)
+    parser.add_argument("--max-inflight", type=int, default=None,
+                        help="concurrent dispatch slots (registry "
+                             "mode's continuous batcher)")
+    parser.add_argument("--memory-budget-bytes", type=int,
+                        default=None,
+                        help="registry LRU device-memory budget "
+                             "(0 = unlimited)")
     parser.add_argument("--sample-shape", default=None,
                         help="per-sample input shape override, e.g. "
                              "'28,28,1' (spatial packages without a "
@@ -320,34 +544,71 @@ def main(argv=None):
     parser.add_argument("--no-warmup", action="store_true",
                         help="serve immediately; first request per "
                              "bucket pays the compile")
+    parser.add_argument("--compile-cache", nargs="?", const="",
+                        default=None, metavar="DIR",
+                        help="wire the persistent XLA compilation "
+                             "cache (default dir: "
+                             "root.common.compile_cache.dir) so a "
+                             "restarted replica cold-starts with "
+                             "zero fresh compiles")
     args = parser.parse_args(argv)
 
     telemetry.enable()  # /metrics should work out of the box
-    model = args.model
-    if args.latest:
-        from znicz_tpu.launcher import newest_snapshot
-        directory = args.directory or root.common.dirs.snapshots
-        model = newest_snapshot(directory, args.model)
-        if model is None:
-            raise SystemExit("no snapshot with prefix %r under %s"
-                             % (args.model, directory))
+    if args.compile_cache is not None:
+        compile_cache.enable(args.compile_cache or None)
+    else:
+        compile_cache.maybe_enable()  # honor the config gate
+    specs = [(m.split("=", 1) if "=" in m else (None, m))
+             for m in args.model]
+    named = [s for s in specs if s[0] is not None]
+    if named and len(named) != len(specs):
+        parser.error("mix of NAME=PATH and bare PATH model specs — "
+                     "use one style")
+    if named and args.latest:
+        parser.error("--latest applies to single-model serving only")
+    if not named and len(specs) > 1:
+        parser.error("several models need NAME=PATH specs")
     sample_shape = None
     if args.sample_shape:
         sample_shape = tuple(int(d) for d in
                              args.sample_shape.split(","))
-    engine = InferenceEngine(model, max_batch=args.max_batch,
-                             sample_shape=sample_shape,
-                             warmup=not args.no_warmup)
-    batcher = MicroBatcher(engine, max_delay_ms=args.max_delay_ms,
-                           queue_limit=args.queue_limit,
-                           timeout_ms=args.timeout_ms).start()
-    server = ServingServer(engine, batcher,
+    registry = engine = None
+    if named:
+        registry = ModelRegistry(
+            memory_budget_bytes=args.memory_budget_bytes,
+            max_batch=args.max_batch, sample_shape=sample_shape,
+            warmup=not args.no_warmup)
+        for name, path in named:
+            registry.add(name, path)
+        from znicz_tpu.serving.continuous import ContinuousBatcher
+        batcher = ContinuousBatcher(
+            registry, max_inflight=args.max_inflight,
+            queue_limit=args.queue_limit,
+            timeout_ms=args.timeout_ms).start()
+        label = ", ".join(sorted(registry.names()))
+    else:
+        model = specs[0][1]
+        if args.latest:
+            from znicz_tpu.launcher import newest_snapshot
+            directory = args.directory or root.common.dirs.snapshots
+            model = newest_snapshot(directory, specs[0][1])
+            if model is None:
+                raise SystemExit("no snapshot with prefix %r under %s"
+                                 % (specs[0][1], directory))
+        engine = InferenceEngine(model, max_batch=args.max_batch,
+                                 sample_shape=sample_shape,
+                                 warmup=not args.no_warmup)
+        batcher = MicroBatcher(engine, max_delay_ms=args.max_delay_ms,
+                               queue_limit=args.queue_limit,
+                               timeout_ms=args.timeout_ms).start()
+        label = str(model)
+    server = ServingServer(engine, batcher, registry=registry,
                            port=(args.port if args.port is not None
                                  else cfg.get("port", 8899)),
                            host=args.host).start()
-    print("serving %s on http://%s:%d/  (predict: POST /predict; "  # noqa
-          "health: GET /healthz; metrics: GET /metrics)"
-          % (model, server.host, server.port))
+    print("serving %s on http://%s:%d/  (predict: POST /predict"  # noqa
+          "[/<model>]; health: GET /healthz; metrics: GET /metrics)"
+          % (label, server.host, server.port))
     # graceful drain on SIGTERM (the orchestrator's shutdown signal):
     # stop admitting, flush in-flight requests, then exit 0 — no
     # client sees a dropped connection on a routine pod rotation
