@@ -78,9 +78,10 @@ print("REPLICA " + json.dumps({
 """
 
 
-def _run_replica(cache_dir, tmp_path):
-    script = tmp_path / "replica.py"
-    script.write_text(_REPLICA)
+def _run_replica(cache_dir, tmp_path, script=None):
+    script_path = tmp_path / "replica.py"
+    script_path.write_text(script or _REPLICA)
+    script = script_path
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
@@ -116,6 +117,47 @@ def test_warm_restart_serves_with_zero_fresh_compiles(tmp_path):
         second["warmup"]["backend_compiles"]
     assert second["sweep_backend_compiles"] == 0
     # the warm replica is the same replica: byte-identical outputs
+    assert second["digest"] == first["digest"]
+
+
+#: the MIXED-PRECISION replica (ISSUE 10): the same two-model build,
+#: but alpha serves f32, beta serves int8 and a THIRD registration
+#: serves alpha's arrays again at bf16 — a registry spanning all three
+#: serving dtypes.  Printed digest covers a full mixed-size sweep of
+#: every model, so the warm restart proves the quantized/bf16
+#: executables deserialize from the shared cache exactly like f32.
+_REPLICA_MIXED = _REPLICA.replace(
+    'registry = ModelRegistry(models={"alpha": fc(1, 4, 3),\n'
+    '                                 "beta": fc(2, 6, 2)}, '
+    'max_batch=8)',
+    'registry = ModelRegistry(max_batch=8)\n'
+    'registry.add("alpha", fc(1, 4, 3))\n'
+    'registry.add("beta", fc(2, 6, 2), dtype="int8")\n'
+    'registry.add("gamma", fc(1, 4, 3), dtype="bf16")').replace(
+    'for name, width in (("alpha", 4), ("beta", 6)):',
+    'for name, width in (("alpha", 4), ("beta", 6), ("gamma", 4)):')
+
+
+def test_mixed_dtype_registry_warm_restart_zero_fresh_compiles(
+        tmp_path):
+    """ISSUE 10 acceptance pin: serving dtype joins the compile-cache
+    key — a warm restart of a MIXED-PRECISION registry (f32 + int8 +
+    bf16) still performs ZERO fresh compiles, byte-identical across
+    replicas, because the int8/bf16 executables persist and
+    deserialize exactly like the f32 ones."""
+    # both replace()s took: the mixed registry AND the widened sweep
+    # (a silent no-op here would quietly drop bf16 from the digest)
+    assert 'dtype="int8"' in _REPLICA_MIXED
+    assert '("gamma", 4)' in _REPLICA_MIXED
+    cache_dir = tmp_path / "xla_cache_mixed"
+    first = _run_replica(cache_dir, tmp_path, script=_REPLICA_MIXED)
+    assert first["warmup_fresh_compiles"] > 0
+    assert first["sweep_backend_compiles"] == 0
+    second = _run_replica(cache_dir, tmp_path, script=_REPLICA_MIXED)
+    assert second["warmup_fresh_compiles"] == 0, second["warmup"]
+    assert second["warmup"]["persistent_cache_hits"] == \
+        second["warmup"]["backend_compiles"]
+    assert second["sweep_backend_compiles"] == 0
     assert second["digest"] == first["digest"]
 
 

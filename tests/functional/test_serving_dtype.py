@@ -1,0 +1,298 @@
+"""Functional contract of the low-precision serving data path
+(ISSUE 10): per-bucket accuracy-delta pins for bf16/int8 on the wine
+and conv models, evict→restore bit-identity per dtype, the quantized
+package export→load round-trip, registry mixed-dtype accounting, and
+the dtype leg of the compile key / warmup manifest."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core import prng, telemetry
+from znicz_tpu.core.config import root
+from znicz_tpu.export import export_package, import_package
+from znicz_tpu.serving import InferenceEngine, ModelRegistry
+from znicz_tpu.serving import accuracy, quant
+
+MAX_BATCH = 8
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """A trained wine workflow + post-run snapshot (the same fixture
+    recipe test_serving.py pins bit-exactness with)."""
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    tmp = tmp_path_factory.mktemp("serving_dtype")
+    prng.get(1).seed(1024)
+    prng.get(2).seed(1025)
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 3, "fail_iterations": 20},
+        snapshotter_config={"prefix": "dtwine", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": str(tmp)})
+    wf.initialize()
+    wf.run()
+    wf.snapshotter.suffix = "final"
+    snapshot = wf.snapshotter.export()
+    return {"wf": wf, "snapshot": snapshot, "dir": tmp}
+
+
+@pytest.fixture(scope="module")
+def conv_package(tmp_path_factory):
+    """A trained spatial (conv/pool) workflow exported as a package —
+    the conv half of the accuracy pins."""
+    from znicz_tpu.core.backends import NumpyDevice
+    from znicz_tpu.samples import mnist
+
+    tmp = tmp_path_factory.mktemp("serving_dtype_conv")
+    prng.get(1).seed(1234)
+    prng.get(2).seed(5678)
+    wf = mnist.build(
+        layers=root.mnistr_caffe.layers,
+        loader_config={"synthetic_train": 60, "synthetic_valid": 30,
+                       "minibatch_size": 30},
+        decision_config={"max_epochs": 1, "fail_iterations": 5},
+        snapshotter_config={"prefix": "dtconv", "interval": 100,
+                            "time_interval": 1e9,
+                            "directory": str(tmp)})
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    pkg = str(tmp / "dtconv.zip")
+    export_package(wf, pkg)
+    return pkg
+
+
+def test_f32_mode_is_bit_identical_to_default(trained):
+    """dtype="f32" IS today's path: same executables, same bits."""
+    default = InferenceEngine(trained["snapshot"],
+                              max_batch=MAX_BATCH)
+    pinned = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH,
+                             dtype="f32")
+    assert pinned.serve_dtype == "f32"
+    assert pinned._model.key == default._model.key
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (5, 13)).astype(numpy.float32)
+    assert numpy.array_equal(pinned.predict(x), default.predict(x))
+
+
+def test_accuracy_pins_wine_per_bucket(trained):
+    """THE accuracy pin: bf16 and int8 hold the documented tolerances
+    on every bucket of the wine model."""
+    report = accuracy.dtype_delta_report(trained["snapshot"],
+                                         max_batch=MAX_BATCH,
+                                         n_rows=32)
+    assert report["buckets"] == [1, 2, 4, 8]
+    for dt in ("bf16", "int8"):
+        block = report["dtypes"][dt]
+        assert block["within_tolerance"], (dt, block)
+        assert set(block["per_bucket"]) == {"1", "2", "4", "8"}
+        # the deltas are real numbers, not zeros — the low-precision
+        # path actually ran (bit-identical would mean f32 leaked in)
+        assert block["max_delta"] > 0.0
+    ok, failures = accuracy.check(report)
+    assert ok, failures
+
+
+def test_accuracy_pins_conv(conv_package):
+    """The conv family holds the same pins: per-output-kernel scales
+    through conv_ops + pooling + softmax."""
+    report = accuracy.dtype_delta_report(conv_package, max_batch=4,
+                                         n_rows=8)
+    for dt in ("bf16", "int8"):
+        block = report["dtypes"][dt]
+        assert block["within_tolerance"], (dt, block)
+        assert block["max_delta"] > 0.0
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_evict_restore_bit_identical_replies(trained, dtype):
+    """The registry-residency contract per dtype: evict releases the
+    (smaller) low-precision footprint and the lazy restore re-uploads
+    the SAME converted arrays — replies are bit-identical across the
+    round-trip."""
+    f32 = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH,
+                             dtype=dtype)
+    assert 0 < engine.device_bytes < f32.device_bytes
+    x = numpy.random.RandomState(3).uniform(
+        -1, 1, (7, 13)).astype(numpy.float32)
+    y1 = engine.predict(x)
+    assert y1.dtype == numpy.float32
+    assert engine.evict()
+    assert not engine.resident and engine.device_bytes == 0
+    y2 = engine.predict(x)  # lazy restore on the predict path
+    assert engine.resident
+    assert numpy.array_equal(y1, y2)
+
+
+def test_quantized_package_roundtrip(trained, tmp_path):
+    """export_package(..., quantize=True): the int8 sidecar survives
+    import_package (scheme recorded, int8 + scale arrays validated),
+    an int8 engine adopts it VERBATIM (no load-time re-quantization),
+    and the f32 view of the package is untouched."""
+    wf = trained["wf"]
+    plain = str(tmp_path / "plain.zip")
+    quantized = str(tmp_path / "quant.zip")
+    export_package(wf, plain)
+    export_package(wf, quantized, quantize=True)
+
+    manifest, arrays = import_package(quantized)
+    assert manifest["quant_scheme"] == quant.QUANT_SCHEME
+    q_layers = [e for e in manifest["layers"]
+                if "quant_weights_q8" in e.get("arrays", {})]
+    assert len(q_layers) == 2  # both FC layers carry the sidecar
+    for entry in q_layers:
+        assert entry["quant_scheme"] == quant.QUANT_SCHEME
+        q = arrays[entry["arrays"]["quant_weights_q8"]]
+        scale = arrays[entry["arrays"]["quant_weights_scale"]]
+        w = arrays[entry["arrays"]["weights"]]
+        assert q.dtype == numpy.int8 and q.shape == w.shape
+        assert scale.dtype == numpy.float32
+        # the sidecar IS the quantization of the shipped weights
+        expect_q, expect_s = quant.quantize_weights(
+            w, quant.quant_axis(entry))
+        assert numpy.array_equal(q, expect_q)
+        assert numpy.array_equal(scale, expect_s)
+    # manifest.txt (the C++ runtime's view) never sees the sidecar
+    import zipfile
+    with zipfile.ZipFile(quantized) as zf:
+        assert "quant" not in zf.read("manifest.txt").decode()
+
+    # an int8 engine adopts the sidecar verbatim: loading must never
+    # call the quantizer (monkeypatching it to explode proves it)
+    real = quant.quantize_weights
+    try:
+        def boom(*a, **k):
+            raise AssertionError("load-time quantization ran despite "
+                                 "the export-time sidecar")
+        quant.quantize_weights = boom
+        engine = InferenceEngine(quantized, max_batch=MAX_BATCH,
+                                 dtype="int8")
+    finally:
+        quant.quantize_weights = real
+    x = numpy.random.RandomState(5).uniform(
+        -1, 1, (4, 13)).astype(numpy.float32)
+    # ... and serves exactly what lazy load-time quantization serves
+    lazy = InferenceEngine(plain, max_batch=MAX_BATCH, dtype="int8")
+    assert numpy.array_equal(engine.predict(x), lazy.predict(x))
+    # the f32 view of the quantized package is bit-identical to the
+    # plain package (the sidecar must be dropped, not uploaded)
+    f32_q = InferenceEngine(quantized, max_batch=MAX_BATCH)
+    f32_p = InferenceEngine(plain, max_batch=MAX_BATCH)
+    assert f32_q.device_bytes == f32_p.device_bytes
+    assert numpy.array_equal(f32_q.predict(x), f32_p.predict(x))
+
+
+def test_registry_mixed_dtype_accounting(trained):
+    """One registry, one model, two precisions: per-model serve_dtype
+    truth in stats, the int8 twin charges its quantized bytes against
+    the LRU budget, and a hot reload cannot silently change a model's
+    precision (constructor-only, remove + re-add)."""
+    registry = ModelRegistry(max_batch=MAX_BATCH)
+    registry.add("wf32", trained["snapshot"])
+    registry.add("wq8", trained["snapshot"], dtype="int8")
+    assert registry.peek("wf32").serve_dtype == "f32"
+    assert registry.peek("wq8").serve_dtype == "int8"
+    stats = registry.stats()["models"]
+    assert stats["wf32"]["serve_dtype"] == "f32"
+    assert stats["wq8"]["serve_dtype"] == "int8"
+    f32_bytes = registry.peek("wf32").device_bytes
+    q_bytes = registry.peek("wq8").device_bytes
+    assert 0 < q_bytes < f32_bytes
+    assert registry.resident_bytes == f32_bytes + q_bytes
+    with pytest.raises(ValueError, match="cannot change"):
+        registry.add("wq8", trained["snapshot"], dtype="bf16")
+
+
+def _manifest_with_dtype(dtype):
+    manifest = {
+        "format": 1,
+        "layers": [{"type": "all2all_tanh", "name": "fc",
+                    "arrays": {"weights": "w.npy", "bias": "b.npy"},
+                    "include_bias": True,
+                    "weights_transposed": False}],
+        "input_sample_shape": [4],
+        "serving": {"buckets": [1, 2], "max_batch": 2,
+                    "sample_shape": [4], "dtype": dtype},
+    }
+    r = numpy.random.RandomState(11)
+    arrays = {"w.npy": r.normal(0, 0.3, (3, 4)).astype("f4"),
+              "b.npy": numpy.zeros(3, "f4")}
+    return manifest, arrays
+
+
+def test_warmup_manifest_selects_dtype_and_pin_wins():
+    """The dtype leg of the warmup manifest: a package exported for
+    int8 serving serves int8 wherever it lands — unless the operator
+    pinned an explicit dtype, which always wins."""
+    adopted = InferenceEngine(_manifest_with_dtype("int8"))
+    assert adopted.serve_dtype == "int8"
+    assert adopted._model.params[0]["weights_q8"].dtype == numpy.int8
+    pinned = InferenceEngine(_manifest_with_dtype("int8"),
+                             dtype="f32")
+    assert pinned.serve_dtype == "f32"
+    assert "weights" in pinned._model.params[0]
+    # a manifest with an unknown dtype fails loudly at load
+    with pytest.raises(ValueError, match="unknown serving dtype"):
+        InferenceEngine(_manifest_with_dtype("fp4"))
+
+
+def test_dtype_is_part_of_the_compile_key(trained):
+    """Reloading the same source at the same dtype reuses every
+    executable (zero recompiles); the dtype lives in the compile key
+    so distinct precisions can never alias."""
+    telemetry.enable()
+    engine = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH,
+                             dtype="int8")
+    key1 = engine._model.key
+    assert '"int8"' in key1  # the dtype leg, literally
+    compiles0 = telemetry.counter("jax.backend_compiles").value
+    engine.load(trained["snapshot"])  # same source, same dtype
+    assert engine.version == 2
+    assert engine._model.key == key1
+    assert telemetry.counter("jax.backend_compiles").value == compiles0
+    # distinct dtypes -> distinct keys (never alias in any cache)
+    f32 = InferenceEngine(trained["snapshot"], max_batch=MAX_BATCH)
+    assert f32._model.key != key1
+
+
+def test_serving_manifest_records_config_dtype(monkeypatch):
+    """export.serving_manifest stamps the serving dtype knob — f32 by
+    default, the configured mode when the exporting cluster serves
+    low precision."""
+    from znicz_tpu import export
+    assert export.serving_manifest((5,))["dtype"] == "f32"
+    monkeypatch.setattr(root.common.serving, "dtype", "int8")
+    assert export.serving_manifest((5,))["dtype"] == "int8"
+
+
+def test_continuous_batcher_lane_key_carries_dtype(trained):
+    """The dispatch lanes separate by serve dtype: the same trailing
+    shape against two precision twins of one model never coalesces
+    into a mixed dispatch."""
+    from znicz_tpu.serving import ContinuousBatcher
+    registry = ModelRegistry(max_batch=MAX_BATCH)
+    registry.add("a", trained["snapshot"])
+    registry.add("b", trained["snapshot"], dtype="int8")
+    batcher = ContinuousBatcher(registry)
+    # no started workers: submissions stay queued for inspection
+    batcher._running = True
+    x = numpy.zeros((2, 13), numpy.float32)
+    batcher.submit(x, model="a")
+    batcher.submit(x, model="b")
+    keys = sorted(batcher._queues)
+    assert keys == [("a", (13,), "f32"), ("b", (13,), "int8")]
+    batcher._running = False
+    for q in batcher._queues.values():
+        while q.reqs:
+            q.reqs.popleft().future.cancel()

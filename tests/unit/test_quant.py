@@ -1,0 +1,202 @@
+"""Unit contract of the low-precision serving tier
+(znicz_tpu/serving/quant.py) and the config precision map (ISSUE 10
+satellite): quantization math, dtype normalization, host-param
+conversion for every serving mode, and ``config.dtype_map`` growing
+``bfloat16`` with loud unknown-string rejection."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core import config
+from znicz_tpu.serving import quant
+
+
+# -- normalize_dtype --------------------------------------------------------
+
+def test_normalize_dtype_aliases():
+    assert quant.normalize_dtype(None) == "f32"
+    for alias in ("f32", "float32", "float", "F32", " Float32 "):
+        assert quant.normalize_dtype(alias) == "f32"
+    for alias in ("bf16", "bfloat16", "BF16"):
+        assert quant.normalize_dtype(alias) == "bf16"
+    for alias in ("int8", "i8", "INT8"):
+        assert quant.normalize_dtype(alias) == "int8"
+
+
+def test_normalize_dtype_unknown_is_loud():
+    with pytest.raises(ValueError, match="unknown serving dtype"):
+        quant.normalize_dtype("fp8")
+    with pytest.raises(ValueError, match="fp4"):
+        quant.normalize_dtype("fp4")
+
+
+# -- quantize_weights -------------------------------------------------------
+
+def test_quantize_weights_bound_and_shapes():
+    r = numpy.random.RandomState(7)
+    w = r.normal(0, 0.3, (12, 34)).astype(numpy.float32)
+    q, scale = quant.quantize_weights(w, axis=0)
+    assert q.dtype == numpy.int8 and scale.dtype == numpy.float32
+    assert q.shape == w.shape and scale.shape == (12, 1)
+    # symmetric: the full [-127, 127] range, -128 never used
+    assert q.min() >= -127 and q.max() <= 127
+    # per-channel error bound: |deq - w| <= scale/2 elementwise
+    err = numpy.abs(quant.dequantize_weights(q, scale) - w)
+    assert (err <= scale / 2 + 1e-7).all()
+    # the max |w| element of each channel quantizes to exactly +-127
+    assert (numpy.abs(q).max(axis=1) == 127).all()
+
+
+def test_quantize_weights_axis1():
+    r = numpy.random.RandomState(8)
+    w = r.normal(0, 0.3, (6, 9)).astype(numpy.float32)
+    q, scale = quant.quantize_weights(w, axis=1)
+    assert scale.shape == (1, 9)
+    err = numpy.abs(quant.dequantize_weights(q, scale) - w)
+    assert (err <= scale / 2 + 1e-7).all()
+
+
+def test_quantize_weights_zero_channel():
+    w = numpy.zeros((3, 4), numpy.float32)
+    w[0] = [1, -2, 3, -4]
+    q, scale = quant.quantize_weights(w, axis=0)
+    # all-zero channels get scale 1.0, never a division by zero
+    assert scale[1, 0] == 1.0 and scale[2, 0] == 1.0
+    assert (q[1:] == 0).all()
+    assert numpy.allclose(quant.dequantize_weights(q, scale)[0], w[0],
+                          atol=float(scale[0, 0]) / 2)
+
+
+def test_quant_axis_follows_stored_layout():
+    assert quant.quant_axis({"type": "all2all"}) == 0
+    assert quant.quant_axis({"type": "all2all",
+                             "weights_transposed": True}) == 1
+
+
+# -- convert_host_params ----------------------------------------------------
+
+def _fc_layer(transposed=False):
+    return {"type": "all2all_tanh", "name": "fc",
+            "include_bias": True, "weights_transposed": transposed}
+
+
+def test_convert_f32_is_identity_minus_sidecar():
+    w = numpy.arange(6, dtype=numpy.float32).reshape(2, 3)
+    b = numpy.ones(2, numpy.float32)
+    params = [{"weights": w, "bias": b,
+               "quant_weights_q8": numpy.zeros((2, 3), numpy.int8),
+               "quant_weights_scale": numpy.ones((2, 1),
+                                                 numpy.float32)}]
+    out = quant.convert_host_params([_fc_layer()], params, "f32")
+    # bit-identical arrays, sidecar dropped (an f32 engine must not
+    # upload int8 arrays it never reads)
+    assert set(out[0]) == {"weights", "bias"}
+    assert out[0]["weights"] is w and out[0]["bias"] is b
+
+
+def test_convert_bf16_casts_floats_only():
+    layers = [_fc_layer(), {"type": "dropout", "name": "d"}]
+    params = [{"weights": numpy.ones((2, 3), numpy.float32),
+               "bias": numpy.ones(2, numpy.float32)}, {}]
+    out = quant.convert_host_params(layers, params, "bf16")
+    bf16 = quant.bfloat16_dtype()
+    assert out[0]["weights"].dtype == bf16
+    assert out[0]["bias"].dtype == bf16
+    assert out[1] == {}
+
+
+def test_convert_int8_replaces_weights_keeps_bias():
+    r = numpy.random.RandomState(3)
+    w = r.normal(0, 0.2, (4, 6)).astype(numpy.float32)
+    b = r.normal(0, 0.1, 4).astype(numpy.float32)
+    out = quant.convert_host_params(
+        [_fc_layer()], [{"weights": w, "bias": b}], "int8")
+    p = out[0]
+    assert set(p) == {"weights_q8", "weights_scale", "bias"}
+    assert p["weights_q8"].dtype == numpy.int8
+    assert p["bias"].dtype == numpy.float32  # biases stay f32
+    deq = quant.dequantize_weights(p["weights_q8"],
+                                   p["weights_scale"])
+    assert numpy.abs(deq - w).max() <= p["weights_scale"].max() / 2
+
+
+def test_convert_int8_adopts_sidecar_verbatim():
+    w = numpy.ones((2, 3), numpy.float32)
+    side_q = numpy.full((2, 3), 5, numpy.int8)
+    side_s = numpy.full((2, 1), 0.25, numpy.float32)
+    out = quant.convert_host_params(
+        [_fc_layer()],
+        [{"weights": w, "quant_weights_q8": side_q,
+          "quant_weights_scale": side_s}], "int8")
+    # export-time sidecar is authoritative — no re-quantization
+    assert numpy.array_equal(out[0]["weights_q8"], side_q)
+    assert numpy.array_equal(out[0]["weights_scale"], side_s)
+
+
+def test_convert_int8_sidecar_shape_mismatch_is_loud():
+    with pytest.raises(ValueError, match="sidecar shape"):
+        quant.convert_host_params(
+            [_fc_layer()],
+            [{"weights": numpy.ones((2, 3), numpy.float32),
+              "quant_weights_q8": numpy.zeros((3, 3), numpy.int8),
+              "quant_weights_scale": numpy.ones((3, 1),
+                                                numpy.float32)}],
+            "int8")
+
+
+def test_convert_canonicalizes_transposed_layout():
+    """Low-precision weights stored transposed ((in, out)) transpose
+    ONCE at conversion to the row-major (out, in) layout — contiguous
+    per-output-channel bytes the dot's contraction streams — and the
+    entry's flag clears so the forward agrees."""
+    r = numpy.random.RandomState(4)
+    w = r.normal(0, 0.2, (6, 4)).astype(numpy.float32)  # (in, out)
+    entry = _fc_layer(transposed=True)
+    out = quant.convert_host_params([entry], [{"weights": w}], "int8")
+    assert entry["weights_transposed"] is False
+    assert out[0]["weights_q8"].shape == (4, 6)
+    assert out[0]["weights_scale"].shape == (4, 1)
+    deq = quant.dequantize_weights(out[0]["weights_q8"],
+                                   out[0]["weights_scale"])
+    assert numpy.abs(deq - w.T).max() <= \
+        out[0]["weights_scale"].max() / 2
+    # bf16 canonicalizes the same way (f32 NEVER does — bit-identity)
+    entry2 = _fc_layer(transposed=True)
+    out2 = quant.convert_host_params([entry2], [{"weights": w}],
+                                     "bf16")
+    assert entry2["weights_transposed"] is False
+    assert out2[0]["weights"].shape == (4, 6)
+    entry3 = _fc_layer(transposed=True)
+    out3 = quant.convert_host_params([entry3], [{"weights": w}],
+                                     "f32")
+    assert entry3["weights_transposed"] is True
+    assert out3[0]["weights"].shape == (6, 4)
+
+
+def test_input_dtype():
+    assert quant.input_dtype("f32", numpy.float32) == numpy.float32
+    assert quant.input_dtype("int8", numpy.float32) == numpy.float32
+    assert quant.input_dtype("bf16", numpy.float32) == \
+        quant.bfloat16_dtype()
+
+
+# -- config.dtype_map (satellite) -------------------------------------------
+
+def test_dtype_map_known_precisions(monkeypatch):
+    eng = config.root.common.engine
+    monkeypatch.setattr(eng, "precision_type", "float")
+    assert config.dtype_map() == numpy.float32
+    monkeypatch.setattr(eng, "precision_type", "double")
+    assert config.dtype_map() == numpy.float64
+    monkeypatch.setattr(eng, "precision_type", "bfloat16")
+    import ml_dtypes
+    assert config.dtype_map() == numpy.dtype(ml_dtypes.bfloat16)
+    monkeypatch.setattr(eng, "precision_type", "bf16")
+    assert config.dtype_map() == numpy.dtype(ml_dtypes.bfloat16)
+
+
+def test_dtype_map_unknown_is_loud(monkeypatch):
+    monkeypatch.setattr(config.root.common.engine, "precision_type",
+                        "half")
+    with pytest.raises(ValueError, match="precision_type 'half'"):
+        config.dtype_map()

@@ -1,0 +1,153 @@
+"""Per-dtype serving accuracy report — the CLI face of
+``znicz_tpu/serving/accuracy.py``.
+
+Runs the same eval rows through an f32 engine and its bf16/int8
+twins, PER SHAPE BUCKET (the executables that actually serve
+traffic), and prints one JSON report with max/mean output delta and
+top-1 flip rate per dtype per bucket.  Exits nonzero when any dtype
+breaks its documented tolerance pin (docs/serving.md "Precision
+modes") — wired into ``tools/ci.sh`` both directly (``--selftest``)
+and through ``tools/serving_smoke.py`` act 3, so a quantizer
+regression fails CI like any other contract break.
+
+Usage:
+    python tools/accuracy_delta.py MODEL [--dtypes bf16,int8]
+           [--rows N] [--max-batch B] [--seed S] [--report]
+    python tools/accuracy_delta.py --selftest
+
+* MODEL is a snapshot pickle or a deployment package zip.
+* ``--report`` prints the JSON without asserting (exploration mode);
+  the default asserts the tolerance pins.
+* ``--selftest`` builds a deterministic synthetic FC package, runs
+  the full report, asserts both dtypes hold their pins, and proves
+  the failure path works: a sabotaged int8 scale (the off-by-axis
+  bug this tool exists to catch) must be REJECTED.
+
+Exit codes: 0 = within tolerance, 1 = tolerance broken, 2 = usage.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy  # noqa: E402
+
+
+def _synthetic_package():
+    """A deterministic two-layer FC model (20 -> 16 -> 4) as an
+    in-memory (manifest, arrays) source."""
+    r = numpy.random.RandomState(1234)
+    manifest = {
+        "format": 1,
+        "layers": [
+            {"type": "all2all_tanh", "name": "fc0",
+             "arrays": {"weights": "w0.npy", "bias": "b0.npy"},
+             "include_bias": True, "weights_transposed": True},
+            {"type": "softmax", "name": "out",
+             "arrays": {"weights": "w1.npy", "bias": "b1.npy"},
+             "include_bias": True, "weights_transposed": True}],
+        "input_sample_shape": [20],
+    }
+    arrays = {"w0.npy": r.normal(0, 0.3, (20, 16)).astype("f4"),
+              "b0.npy": r.normal(0, 0.1, 16).astype("f4"),
+              "w1.npy": r.normal(0, 0.3, (16, 4)).astype("f4"),
+              "b1.npy": r.normal(0, 0.1, 4).astype("f4")}
+    return manifest, arrays
+
+
+def selftest():
+    from znicz_tpu.serving import accuracy
+    src = _synthetic_package()
+    report = accuracy.dtype_delta_report(src, max_batch=8, n_rows=32)
+    ok, failures = accuracy.check(report)
+    if not ok:
+        print("accuracy_delta selftest FAILED: clean synthetic model "
+              "broke its pins: %s" % failures)
+        return 1
+    # the failure path must actually fail: sabotage the int8 sidecar
+    # with scales that forgot the /127 (so dequant inflates every
+    # weight 127x) — a broken quantizer that LOADS fine and serves
+    # garbage, the exact failure class only an output check catches
+    manifest, arrays = src
+    bad_manifest = json.loads(json.dumps(manifest))
+    bad_arrays = dict(arrays)
+    from znicz_tpu.serving import quant
+    for entry in bad_manifest["layers"]:
+        fname = entry["arrays"]["weights"]
+        q, scale = quant.quantize_weights(bad_arrays[fname],
+                                          quant.quant_axis(entry))
+        base = fname[:-len(".npy")]
+        bad_arrays[base + "_q8.npy"] = q
+        bad_arrays[base + "_scale.npy"] = scale * 127.0
+        entry["arrays"]["quant_weights_q8"] = base + "_q8.npy"
+        entry["arrays"]["quant_weights_scale"] = base + "_scale.npy"
+    bad_report = accuracy.dtype_delta_report(
+        (bad_manifest, bad_arrays), max_batch=8, n_rows=32,
+        dtypes=("int8",))
+    bad_ok, _ = accuracy.check(bad_report)
+    if bad_ok:
+        print("accuracy_delta selftest FAILED: wrong-axis int8 scales "
+              "passed the tolerance pins (max_delta %.4g)"
+              % bad_report["dtypes"]["int8"]["max_delta"])
+        return 1
+    print("accuracy_delta selftest OK: bf16 max_delta %.2g / int8 "
+          "max_delta %.2g within pins; sabotaged int8 scales rejected "
+          "(max_delta %.2g)"
+          % (report["dtypes"]["bf16"]["max_delta"],
+             report["dtypes"]["int8"]["max_delta"],
+             bad_report["dtypes"]["int8"]["max_delta"]))
+    return 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python tools/accuracy_delta.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("model",
+                        help="snapshot pickle or package zip")
+    parser.add_argument("--dtypes", default="bf16,int8",
+                        help="comma list of dtypes to compare vs f32")
+    parser.add_argument("--rows", type=int, default=64,
+                        help="seeded eval rows (default 64)")
+    parser.add_argument("--max-batch", type=int, default=None,
+                        help="bucket ladder ceiling for the report "
+                             "engines")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--report", action="store_true",
+                        help="print the report without asserting the "
+                             "tolerance pins")
+    args = parser.parse_args(argv)
+
+    from znicz_tpu.serving import accuracy
+    kwargs = {}
+    if args.max_batch is not None:
+        kwargs["max_batch"] = args.max_batch
+    report = accuracy.dtype_delta_report(
+        args.model, n_rows=args.rows, seed=args.seed,
+        dtypes=tuple(d.strip() for d in args.dtypes.split(",")
+                     if d.strip()), **kwargs)
+    report["model"] = args.model
+    print(json.dumps(report))
+    if args.report:
+        return 0
+    ok, failures = accuracy.check(report)
+    if not ok:
+        print("accuracy_delta: TOLERANCE BROKEN: %s"
+              % "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,7 +48,14 @@ GATED = ("value", "f32_images_per_sec", "cifar_caffe_images_per_sec",
          # goodput under 3x overload regress CI exactly like training
          # throughput does
          "serving_loadgen_requests_per_sec",
-         "serving_goodput_under_overload_pct")
+         "serving_goodput_under_overload_pct",
+         # the per-dtype serving data path (ISSUE 10): the memory-
+         # bound model's requests/sec at every precision mode — a
+         # quantized path that slows down (or stops stamping) fails
+         # the round like any training workload
+         "serving_f32_requests_per_sec",
+         "serving_bf16_requests_per_sec",
+         "serving_int8_requests_per_sec")
 
 #: latency-style keys (lower is better): a RISE past the threshold
 #: fails; zero/missing when the previous round had a number fails too
@@ -178,23 +185,43 @@ def selftest(threshold=0.10):
              serving_loadgen_requests_per_sec=500.0 * 0.95,
              serving_loadgen_p99_ms=20.0 * (1.0 + threshold)),
         serving_old, threshold)
+    # the per-dtype serving keys (ISSUE 10), proven on a synthetic
+    # round: an int8-throughput drop and a VANISHED dtype key must
+    # both fail; per-dtype wobble passes
+    dtype_old = {"serving_f32_requests_per_sec": 100.0,
+                 "serving_bf16_requests_per_sec": 500.0,
+                 "serving_int8_requests_per_sec": 700.0}
+    dt_drop, _ = compare(
+        dict(dtype_old, serving_int8_requests_per_sec=700.0 * 0.85),
+        dtype_old, threshold)
+    dtype_gone = dict(dtype_old)
+    del dtype_gone["serving_bf16_requests_per_sec"]
+    dt_gone, _ = compare(dtype_gone, dtype_old, threshold)
+    dt_wobble, _ = compare(
+        {k: v * 0.95 for k, v in dtype_old.items()},
+        dtype_old, threshold)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
-            or not srv_wobble:
+            or not srv_wobble or dt_drop or dt_gone or not dt_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
               "serving_p99_rise_rejected=%s "
-              "serving_p99_zero_rejected=%s serving_wobble_passed=%s"
+              "serving_p99_zero_rejected=%s serving_wobble_passed=%s "
+              "dtype_drop_rejected=%s dtype_vanished_rejected=%s "
+              "dtype_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
-                 not srv_p99_zero, srv_wobble))
+                 not srv_p99_zero, srv_wobble, not dt_drop,
+                 not dt_gone, dt_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
           "improvement pass; serving req/s drop, p99 rise and p99 "
-          "zero-stamp rejected, serving wobble passes (threshold "
-          "%.0f%%)" % (os.path.basename(path), key, 100 * threshold))
+          "zero-stamp rejected, serving wobble passes; per-dtype "
+          "int8 drop and vanished bf16 key rejected, dtype wobble "
+          "passes (threshold %.0f%%)"
+          % (os.path.basename(path), key, 100 * threshold))
     return 0
 
 

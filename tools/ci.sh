@@ -25,9 +25,11 @@ echo "== mesh smoke (wine 1 vs 4 data shards: identical aggregates, 1 readback/s
 JAX_PLATFORMS=cpu python tools/mesh_smoke.py
 echo "== bench gate selftest (injected >10% drop must fail the gate)"
 python tools/bench_gate.py --selftest
+echo "== accuracy delta selftest (bf16/int8 pins hold; sabotaged int8 scales rejected)"
+JAX_PLATFORMS=cpu python tools/accuracy_delta.py --selftest
 echo "== chaos smoke (SIGKILL mid-epoch -> resume bit-identical; breaker opens -> recovers)"
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
-echo "== serving smoke (wine over HTTP, 64 concurrent, 0 recompiles; then 2-model registry, interleaved traffic + seeded loadgen SLO assertion)"
+echo "== serving smoke (wine over HTTP, 64 concurrent, 0 recompiles; then 2-model registry + loadgen SLO; then f32+int8 same-model precision act)"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 if [ "$1" = "full" ]; then
     echo "== tests (full lane)"

@@ -1,4 +1,4 @@
-"""CI smoke: the serving tier end to end, in two acts.
+"""CI smoke: the serving tier end to end, in three acts.
 
 **Act 1 — single engine (the PR 2 contract):** train a tiny wine
 model, snapshot it, bring up the HTTP front end, fire 64 CONCURRENT
@@ -22,6 +22,20 @@ ContinuousBatcher, interleaved concurrent traffic against both:
 * per-model labeled series landed on /metrics,
 * a short seeded ``tools/loadgen.py`` run (open-loop Poisson, fixed
   seed) through the real CLI holds the goodput SLO assertion.
+
+**Act 3 — the low-precision data path (ISSUE 10):** ONE registry
+serving the SAME wine snapshot at f32 and at int8, under interleaved
+concurrent traffic:
+
+* per-dtype label separation on /metrics (the int8 engine's series
+  carry ``dtype_int8``, the f32 engine's do not),
+* the int8 replies sit within the documented accuracy pins of the
+  f32 replies for identical inputs,
+* ZERO recompiles across the mixed-precision storm,
+* the registry's resident accounting shows the int8 model's smaller
+  footprint,
+* the ``tools/accuracy_delta.py`` CLI holds its tolerance assertion
+  against the same snapshot.
 
 Run by ``tools/ci.sh`` (fast lane).  Exit code 0 = pass.
 """
@@ -146,6 +160,7 @@ def main():
     finally:
         server.stop()
     registry_smoke(tmp, snapshot)
+    precision_smoke(snapshot)
 
 
 def _second_model_package(tmp):
@@ -258,6 +273,115 @@ def registry_smoke(tmp, snapshot):
                  report["goodput_pct"],
                  report["latency_ms"]["p99"] or -1.0,
                  report["seed"]))
+    finally:
+        server.stop()
+
+
+def precision_smoke(snapshot):
+    """Act 3: one registry, one model, two precisions (ISSUE 10)."""
+    import subprocess
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+    from znicz_tpu.serving.accuracy import TOLERANCES
+
+    telemetry.reset()
+    registry = ModelRegistry(max_batch=MAX_BATCH)
+    registry.add("wine_f32", snapshot)          # default dtype = f32
+    registry.add("wine_int8", snapshot, dtype="int8")
+    assert registry.peek("wine_f32").serve_dtype == "f32"
+    assert registry.peek("wine_int8").serve_dtype == "int8"
+    # the quantized twin is the SMALLER resident: the budget meters
+    # int8 bytes, and an evict->restore round-trip re-uploads them
+    f32_bytes = registry.peek("wine_f32").device_bytes
+    int8_bytes = registry.peek("wine_int8").device_bytes
+    assert 0 < int8_bytes < f32_bytes, (int8_bytes, f32_bytes)
+
+    server = ServingServer(registry=registry).start()
+    url = "http://127.0.0.1:%d" % server.port
+    compiles0 = telemetry.counter("jax.backend_compiles").value
+    replies, errors = {}, []
+
+    def client(seed):
+        try:
+            r = numpy.random.RandomState(1000 + seed // 2)
+            x = r.uniform(-1, 1, (1 + (seed // 2) % MAX_BATCH, 13))
+            model = ("wine_f32", "wine_int8")[seed % 2]
+            req = urllib.request.Request(
+                url + "/predict/" + model,
+                json.dumps({"inputs": x.tolist()}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                doc = json.loads(resp.read())
+            assert doc["model"] == model
+            replies[seed] = numpy.asarray(doc["outputs"])
+        except Exception as e:  # noqa: BLE001 - asserted below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_REQUESTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errors, "request failures: %s" % errors[:5]
+        assert len(replies) == N_REQUESTS
+        # identical inputs through both precisions: the int8 replies
+        # hold the documented pin vs their f32 twins
+        tol = TOLERANCES["int8"]["max_delta"]
+        worst = 0.0
+        for seed in range(0, N_REQUESTS, 2):
+            delta = float(numpy.abs(replies[seed]
+                                    - replies[seed + 1]).max())
+            worst = max(worst, delta)
+        assert worst <= tol, \
+            "int8 delta %.4g over the %.4g pin" % (worst, tol)
+        recompiles = telemetry.counter(
+            "jax.backend_compiles").value - compiles0
+        assert recompiles == 0, \
+            "%d recompiles across the mixed-precision storm" \
+            % recompiles
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        # per-dtype label separation: the int8 engine's series carry
+        # the dtype label AND the model label; the f32 engine's series
+        # exist without any dtype label
+        assert "dtype_int8" in metrics and \
+            "model_wine_int8" in metrics, \
+            "int8 dtype/model labels missing from /metrics"
+        assert "model_wine_f32" in metrics, \
+            "f32 model labels missing from /metrics"
+        assert "dtype_f32" not in metrics, \
+            "f32 engines must keep their unlabeled series names"
+        # /models carries the per-model serve_dtype truth
+        with urllib.request.urlopen(url + "/models",
+                                    timeout=30) as resp:
+            models = json.loads(resp.read())
+        blocks = models.get("models", models)
+        assert blocks["wine_int8"]["serve_dtype"] == "int8"
+        assert blocks["wine_f32"]["serve_dtype"] == "f32"
+        # the accuracy-delta CLI holds its pins on the same snapshot
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "tools", "accuracy_delta.py"),
+             str(snapshot), "--rows", "32", "--max-batch",
+             str(MAX_BATCH)],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, \
+            "accuracy_delta tolerance assertion failed:\n%s\n%s" % (
+                proc.stdout[-1000:], proc.stderr[-1000:])
+        report = json.loads(proc.stdout.splitlines()[-1])
+        print("precision smoke OK: %d interleaved requests, same "
+              "model at f32 (%d B) + int8 (%d B resident), worst "
+              "int8 delta %.2g (pin %.2g), 0 recompiles, per-dtype "
+              "labels separated; accuracy_delta: bf16 %.2g / int8 "
+              "%.2g max delta"
+              % (N_REQUESTS, f32_bytes, int8_bytes, worst, tol,
+                 report["dtypes"]["bf16"]["max_delta"],
+                 report["dtypes"]["int8"]["max_delta"]))
     finally:
         server.stop()
 

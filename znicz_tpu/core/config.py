@@ -111,7 +111,7 @@ root = Config("root")
 # (samples/CIFAR10/cifar_caffe_config.py:52-53, site_config.py:37-40).
 root.common.update({
     "engine": {
-        "precision_type": "float",    # "float" | "double"
+        "precision_type": "float",    # "float" | "double" | "bfloat16"
         "precision_level": 0,         # 0: fast, 1: deterministic-ish
         "backend": "auto",            # "numpy" | "jax" | "auto"
     },
@@ -192,6 +192,10 @@ root.common.update({
         "queue_limit": 256,     # queued ROWS before 429 backpressure
         "timeout_ms": 1000.0,   # per-request deadline in the queue
         "warmup": True,         # compile every bucket before ready
+        # default serving precision recorded in export warmup
+        # manifests ("f32" | "bf16" | "int8"); engines without an
+        # explicit dtype= adopt the source manifest's value
+        "dtype": "f32",
         "slow_request_ms": 1000.0,  # log requests slower than this
         # graceful degradation (serving/breaker.py + HandlerBase):
         "breaker_threshold": 5,     # consecutive dispatch failures
@@ -238,7 +242,23 @@ def get(value, default=None):
 
 
 def dtype_map():
-    """Numpy dtype for the configured precision."""
+    """Numpy dtype for the configured
+    ``root.common.engine.precision_type``: ``float`` (f32), ``double``
+    (f64), or ``bfloat16`` (the ml_dtypes numpy dtype jax natively
+    consumes — the low-precision serving/training tier).  Unknown
+    strings fail LOUDLY with the accepted spellings — a typo'd
+    precision must never surface as a bare ``KeyError`` deep inside
+    workflow initialize."""
     import numpy
-    return {"float": numpy.float32, "double": numpy.float64}[
-        root.common.engine.precision_type]
+    precision = root.common.engine.precision_type
+    if precision in ("float", "float32", "f32"):
+        return numpy.float32
+    if precision in ("double", "float64", "f64"):
+        return numpy.float64
+    if precision in ("bfloat16", "bf16"):
+        import ml_dtypes
+        return numpy.dtype(ml_dtypes.bfloat16)
+    raise ValueError(
+        "unknown root.common.engine.precision_type %r (accepted: "
+        "float/float32/f32, double/float64/f64, bfloat16/bf16)"
+        % (precision,))

@@ -298,6 +298,16 @@ def cost_registry():
         return list(_state.cost.values())
 
 
+def cost_entries_by_meta(**match):
+    """Registered entries whose ``meta`` carries every given
+    key=value — e.g. ``cost_entries_by_meta(dtype="int8")`` selects
+    the int8 serving-forward executables for the per-dtype roofline
+    bench.py stamps."""
+    return [e for e in cost_registry()
+            if all((e.get("meta") or {}).get(k) == v
+                   for k, v in match.items())]
+
+
 def cost_report():
     """The cross-check view: every entry that carries an analytic
     estimate plus an overall ``agree`` verdict (True only when every

@@ -143,18 +143,26 @@ def serving_manifest(sample_shape):
     """The ahead-of-time **warmup manifest** recorded at export /
     snapshot time: the shape-bucket ladder a serving replica should
     precompile for this model (from the serving config active at
-    export), plus the per-sample input shape.  A cold replica reads it
+    export), plus the per-sample input shape and the serving
+    **dtype** (``root.common.serving.dtype`` — "f32" unless the
+    exporting cluster serves low precision).  A cold replica reads it
     and warms the EXACT executable set the exporter's cluster serves —
-    with the persistent compilation cache (core/compile_cache.py)
-    every one of those warms is a cache load, not a compile, so the
-    replica is ready in seconds with zero fresh XLA work."""
+    same ladder, same precision mode, so with the persistent
+    compilation cache (core/compile_cache.py) every one of those warms
+    is a cache load, not a compile, and the replica is ready in
+    seconds with zero fresh XLA work.  An engine constructed with an
+    explicit ``dtype=`` keeps its pin; the manifest only selects when
+    the operator left the choice to the source."""
     from znicz_tpu.core.config import root
     from znicz_tpu.serving.engine import default_buckets
+    from znicz_tpu.serving.quant import normalize_dtype
     max_batch = int(root.common.serving.get("max_batch", 64))
     return {
         "buckets": list(default_buckets(max_batch)),
         "max_batch": max_batch,
         "sample_shape": list(sample_shape),
+        "dtype": normalize_dtype(
+            root.common.serving.get("dtype", None)),
     }
 
 
@@ -196,23 +204,61 @@ def forward_topology(workflow):
     return topology
 
 
-def export_package(workflow, path):
+def quantize_manifest(manifest, files):
+    """Add the **int8 quantization sidecar** to a package manifest in
+    place: for every weight-bearing layer, per-output-channel
+    symmetric int8 weights (``layerN_weights_q8.npy``) and their f32
+    scales (``layerN_weights_scale.npy``), referenced from the entry
+    as ``quant_weights_q8`` / ``quant_weights_scale`` plus the scheme
+    tag.  The f32 weights stay — the package still serves at any
+    dtype; an ``int8`` engine adopts the sidecar verbatim (export-time
+    quantization is authoritative) instead of re-quantizing at load.
+    Like the zero_filter provenance arrays, the sidecar never appears
+    in ``manifest.txt`` — the C++ runtime's flat parser only sees the
+    f32 layers.  Returns the number of layers quantized."""
+    from znicz_tpu.serving import quant
+    quantized = 0
+    for entry in manifest["layers"]:
+        fname = entry.get("arrays", {}).get("weights")
+        if fname is None or not quant.quantizable(entry):
+            continue
+        q, scale = quant.quantize_weights(files[fname],
+                                          quant.quant_axis(entry))
+        base = fname[:-len(".npy")]
+        files[base + "_q8.npy"] = q
+        files[base + "_scale.npy"] = scale
+        entry["arrays"]["quant_weights_q8"] = base + "_q8.npy"
+        entry["arrays"]["quant_weights_scale"] = base + "_scale.npy"
+        entry["quant_scheme"] = quant.QUANT_SCHEME
+        quantized += 1
+    if quantized:
+        manifest["quant_scheme"] = quant.QUANT_SCHEME
+    return quantized
+
+
+def export_package(workflow, path, quantize=False):
     """Write ``workflow``'s forward stack as a deployment package.
 
     ``workflow`` needs a ``forwards`` list (StandardWorkflow / NNWorkflow
-    contract); returns the path written.
+    contract); returns the path written.  ``quantize=True`` adds the
+    int8 weight sidecar (:func:`quantize_manifest`) so serving
+    replicas in int8 mode load export-time scales instead of
+    quantizing per replica.
     """
     manifest, files = forward_manifest(workflow)
+    if quantize:
+        quantize_manifest(manifest, files)
     layers = manifest["layers"]
 
     lines = []
     for i, entry in enumerate(layers):
         parts = ["type=%s" % entry["type"]]
         for attr, fname in sorted(entry["arrays"].items()):
-            if attr.startswith("zero_filter"):
+            if attr.startswith("zero_filter") or \
+                    attr.startswith("quant"):
                 # python-side provenance only; the C++ runtime consumes
-                # the already-masked weights and its flat parser must
-                # not see unknown array attrs
+                # the already-masked f32 weights and its flat parser
+                # must not see unknown array attrs
                 continue
             parts.append("%s=%s" % (attr, fname))
         # scalar / tuple hyperparameters (conv & pooling geometry, LRN
@@ -220,7 +266,8 @@ def export_package(workflow, path):
         # C++ runtime's flat parser
         for attr in sorted(entry):
             if attr in ("type", "name", "arrays") or \
-                    attr.startswith("zero_filter"):
+                    attr.startswith("zero_filter") or \
+                    attr.startswith("quant"):
                 continue
             value = entry[attr]
             if isinstance(value, bool):

@@ -19,11 +19,18 @@ Three pillars (docs/serving.md):
   circuit breaking around executable dispatch (503 + ``Retry-After``
   while open, half-open recovery probes) plus graceful SIGTERM drain
   on the server — the degradation valves of docs/deployment.md's
-  "Fault tolerance" story.
+  "Fault tolerance" story;
+* :mod:`znicz_tpu.serving.quant` /
+  :mod:`znicz_tpu.serving.accuracy` — the low-precision data path
+  (f32 / bf16 / int8 per-channel weight quantization) and its
+  measured per-bucket accuracy-delta harness (docs/serving.md
+  "Precision modes").
 """
 
 from znicz_tpu.serving.engine import (  # noqa: F401 - re-export
     InferenceEngine, default_buckets)
+from znicz_tpu.serving.quant import (  # noqa: F401 - re-export
+    DTYPES as SERVING_DTYPES, normalize_dtype)
 from znicz_tpu.serving.batcher import (  # noqa: F401 - re-export
     BatcherStoppedError, MicroBatcher, QueueFullError,
     RequestTimeoutError)
@@ -39,4 +46,5 @@ __all__ = ["InferenceEngine", "MicroBatcher", "ContinuousBatcher",
            "ModelRegistry", "UnknownModelError", "ServingServer",
            "BatcherStoppedError", "QueueFullError",
            "RequestTimeoutError", "default_buckets",
-           "CircuitBreaker", "CircuitOpenError"]
+           "CircuitBreaker", "CircuitOpenError",
+           "SERVING_DTYPES", "normalize_dtype"]

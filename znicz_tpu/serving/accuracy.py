@@ -1,0 +1,156 @@
+"""Accuracy-delta harness — the measured half of the precision axis.
+
+A serving dtype is only usable if its error is KNOWN: this module runs
+the same evaluation rows through one engine per dtype (f32 reference
+vs bf16 / int8) **per shape bucket** — the executables that actually
+serve traffic, padding included — and reports, per bucket and overall:
+
+* ``max_delta`` / ``mean_delta`` — elementwise output deviation from
+  the f32 reference (absolute; model outputs here are O(1) softmax /
+  activation values, the same convention the PR 2 MSE_RTOL golden
+  pins use),
+* ``flip_rate`` — fraction of rows whose top-1 argmax CHANGED (the
+  delta that costs a classifier accuracy; only for >=2-wide outputs).
+
+:data:`TOLERANCES` are the documented pins (docs/serving.md
+"Precision modes"): the serving smoke, the functional tests and
+``tools/accuracy_delta.py`` all assert against them, so a quantizer
+regression fails CI the same way a throughput regression fails the
+bench gate.  They are deliberately loose enough for any real model of
+the package layer scope and tight enough that a broken scale
+(off-by-127, wrong axis) fails instantly.
+"""
+
+import numpy
+
+from znicz_tpu.serving import quant
+from znicz_tpu.serving.engine import InferenceEngine
+
+#: documented per-dtype accuracy pins (outputs in O(1) units —
+#: softmax probabilities / bounded activations).  ``max_delta`` is
+#: elementwise |y - y_f32|; ``flip_rate`` the top-1 disagreement
+#: fraction.  bf16 carries ~3 decimal digits -> deltas land ~1e-2;
+#: int8 per-channel weight quantization lands in the same decade.
+TOLERANCES = {
+    "bf16": {"max_delta": 0.08, "flip_rate": 0.05},
+    "int8": {"max_delta": 0.15, "flip_rate": 0.08},
+}
+
+
+def _rows_for(engine, rows, n_rows, seed):
+    """The shared eval rows: caller-provided, or a seeded uniform
+    batch over the model's recorded sample shape."""
+    if rows is not None:
+        x = numpy.asarray(rows, dtype=numpy.float32)
+        if x.shape[1:] != tuple(engine.sample_shape or x.shape[1:]):
+            raise ValueError(
+                "eval rows of per-sample shape %s do not match the "
+                "model's %s" % (x.shape[1:], engine.sample_shape))
+        return x
+    if engine.sample_shape is None:
+        raise ValueError(
+            "model records no sample shape — pass rows= explicitly")
+    r = numpy.random.RandomState(seed)
+    return r.uniform(-1.0, 1.0,
+                     (n_rows,) + tuple(engine.sample_shape)) \
+        .astype(numpy.float32)
+
+
+def _bucket_rows(x, bucket):
+    """Exactly ``bucket`` rows, cycling the eval set when it is
+    smaller — every bucket executable gets exercised at its own
+    shape."""
+    if len(x) >= bucket:
+        return x[:bucket]
+    reps = -(-bucket // len(x))
+    return numpy.concatenate([x] * reps, axis=0)[:bucket]
+
+
+def _delta_stats(y_ref, y):
+    d = numpy.abs(numpy.asarray(y, numpy.float64)
+                  - numpy.asarray(y_ref, numpy.float64))
+    out = {"max_delta": float(d.max()) if d.size else 0.0,
+           "mean_delta": float(d.mean()) if d.size else 0.0}
+    if y_ref.ndim >= 2 and y_ref.shape[-1] >= 2:
+        flat_ref = y_ref.reshape(len(y_ref), -1)
+        flat = numpy.asarray(y).reshape(len(y), -1)
+        flips = numpy.argmax(flat_ref, axis=1) != \
+            numpy.argmax(flat, axis=1)
+        out["flip_rate"] = float(numpy.mean(flips))
+    else:
+        out["flip_rate"] = None
+    return out
+
+
+def dtype_delta_report(source, rows=None, dtypes=("bf16", "int8"),
+                       n_rows=64, seed=0, tolerances=None,
+                       **engine_kwargs):
+    """Run the same eval rows through f32 vs each low-precision dtype,
+    per bucket, and report the deltas against :data:`TOLERANCES`.
+
+    ``source`` is anything :class:`InferenceEngine` loads (snapshot
+    path, package zip, ``(manifest, arrays)``); ``rows`` the eval rows
+    (default: ``n_rows`` seeded uniform samples over the recorded
+    sample shape); ``engine_kwargs`` (``max_batch=``, ``buckets=``,
+    ``sample_shape=``) apply to every engine so the bucket ladders
+    align.  Engines are built with ``warmup=False`` — each bucket
+    compiles exactly once, when its row slice runs.
+
+    Returns a JSON-able dict; ``report["ok"]`` is True when every
+    dtype sits inside its tolerance pin.
+    """
+    tolerances = dict(TOLERANCES, **(tolerances or {}))
+    engine_kwargs = dict(engine_kwargs, warmup=False)
+    ref = InferenceEngine(source, dtype="f32", **engine_kwargs)
+    x = _rows_for(ref, rows, n_rows, seed)
+    buckets = tuple(ref.buckets)
+    per_bucket_ref = {b: ref.predict(_bucket_rows(x, b))
+                      for b in buckets}
+    report = {"buckets": list(buckets), "rows": int(len(x)),
+              "reference": "f32", "dtypes": {}, "ok": True}
+    for dt in dtypes:
+        dt = quant.normalize_dtype(dt)
+        if dt == "f32":
+            raise ValueError("f32 is the reference — compare bf16/int8")
+        engine = InferenceEngine(source, dtype=dt, **engine_kwargs)
+        per_bucket = {}
+        worst = {"max_delta": 0.0, "mean_delta": 0.0, "flip_rate": 0.0}
+        for b in buckets:
+            stats = _delta_stats(per_bucket_ref[b],
+                                 engine.predict(_bucket_rows(x, b)))
+            per_bucket[str(b)] = stats
+            worst["max_delta"] = max(worst["max_delta"],
+                                     stats["max_delta"])
+            worst["mean_delta"] = max(worst["mean_delta"],
+                                      stats["mean_delta"])
+            if stats["flip_rate"] is not None:
+                worst["flip_rate"] = max(worst["flip_rate"],
+                                         stats["flip_rate"])
+        tol = tolerances.get(dt, {})
+        within = (worst["max_delta"] <= tol.get("max_delta",
+                                                float("inf"))
+                  and worst["flip_rate"] <= tol.get("flip_rate",
+                                                    float("inf")))
+        report["dtypes"][dt] = dict(
+            worst, per_bucket=per_bucket, tolerance=tol,
+            within_tolerance=bool(within))
+        report["ok"] = report["ok"] and within
+    return report
+
+
+def check(report):
+    """(ok, failures) over a :func:`dtype_delta_report` — ``failures``
+    names each dtype outside its pin with the offending numbers."""
+    failures = []
+    for dt, block in sorted(report.get("dtypes", {}).items()):
+        if not block.get("within_tolerance"):
+            failures.append(
+                "%s: max_delta %.4g (tol %.4g), flip_rate %.4g "
+                "(tol %.4g)"
+                % (dt, block["max_delta"],
+                   block.get("tolerance", {}).get("max_delta",
+                                                  float("inf")),
+                   block["flip_rate"],
+                   block.get("tolerance", {}).get("flip_rate",
+                                                  float("inf"))))
+    return not failures, failures

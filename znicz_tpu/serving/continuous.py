@@ -6,8 +6,9 @@ batching *window* (size-or-deadline) and dispatches it with ONE worker
 trickle traffic always pays ``max_delay_ms``.  Continuous batching
 inverts the control flow:
 
-* requests land in per-``(model, sample-shape)`` FIFO queues the
-  moment they arrive;
+* requests land in per-``(model, sample-shape, serve-dtype)`` FIFO
+  queues the moment they arrive (the dtype leg keeps dispatches
+  dtype-pure across precision-changing hot reloads);
 * ``max_inflight`` dispatch slots (worker threads) each grab the next
   coalescible run of requests THE MOMENT they free up — a request
   admits into the next in-flight shape bucket as soon as there is
@@ -52,7 +53,7 @@ from znicz_tpu.serving.batcher import (_DISPATCH_GRACE, _Request,
 
 
 class _Queue(object):
-    """One (model, trailing-shape) admission lane."""
+    """One (model, trailing-shape, serve-dtype) admission lane."""
 
     __slots__ = ("reqs", "max_batch")
 
@@ -89,7 +90,7 @@ class ContinuousBatcher(Logger):
         timeout_ms = (timeout_ms if timeout_ms is not None
                       else cfg.get("timeout_ms", 1000.0))
         self.timeout = float(timeout_ms) / 1e3 if timeout_ms else None
-        self._queues = {}          # (model, shape) -> _Queue
+        self._queues = {}          # (model, shape, dtype) -> _Queue
         self._rows_queued = 0
         self._last_model = None    # round-robin cursor
         self._cond = threading.Condition()
@@ -188,7 +189,14 @@ class ContinuousBatcher(Logger):
         from concurrent.futures import Future
         future = Future()
         req = _Request(x, rows, future, now, deadline, rid=request_id)
-        key = (model, x.shape[1:])
+        # the lane key carries the engine's serving dtype next to the
+        # trailing shape: a hot reload that changes the model's
+        # precision mode must not coalesce requests parsed for the old
+        # generation's dtype into the new generation's dispatches —
+        # each dispatch stays dtype-pure (plain callables have no
+        # serve_dtype; their lane key gains a stable None)
+        key = (model, x.shape[1:],
+               getattr(engine, "serve_dtype", None))
         with self._cond:
             if not self._running:
                 raise BatcherStoppedError("batcher is not running")

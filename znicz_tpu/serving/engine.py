@@ -18,6 +18,17 @@ pytree) and builds ONE ``jax.jit``-compiled pure function
 hot reload with an unchanged topology reuses every compiled
 executable — zero recompiles across model version bumps.
 
+**Precision modes.** Serving precision is a first-class, measured
+axis (``dtype=`` / ``serve --dtype`` / the source's recorded warmup
+manifest): ``f32`` is bit-identical to the training forward, ``bf16``
+casts params once at load and runs activations in bfloat16 (f32
+replies), ``int8`` serves per-output-channel symmetrically quantized
+weights with the dequant folded into the executable — 4x fewer weight
+bytes per dispatch (:mod:`znicz_tpu.serving.quant`).  The dtype joins
+the compile-cache key, the per-dtype cost-registry entries and the
+``dtype_<mode>`` telemetry labels; accuracy deltas per bucket are
+measured and pinned by :mod:`znicz_tpu.serving.accuracy`.
+
 **Shape buckets.** jit compiles per input shape, so free-form batch
 sizes would recompile constantly.  ``predict`` pads every batch up to
 the next bucket (powers of two up to ``max_batch`` by default) and
@@ -46,6 +57,7 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core import faults
 from znicz_tpu.core import telemetry
+from znicz_tpu.serving import quant
 
 
 def default_buckets(max_batch):
@@ -83,9 +95,60 @@ def _nhwc(y):
     return y
 
 
+def _apply_quantized_layer(entry, params, y):
+    """One int8-quantized FC/conv layer: the dot runs against the
+    int8 weights (converted in registers — XLA fuses the convert into
+    the contraction's operand read, so the executable streams int8
+    bytes from device memory) and the per-output-channel dequant
+    scale applies to the dot's OUTPUT — algebraically identical to
+    scaling the weights, but it keeps the scale multiply out of the
+    matmul operand, where it would force the backend to materialize a
+    full f32 copy of the weights per dispatch."""
+    import jax.numpy as jnp
+    from znicz_tpu.ops import activations, dense
+    from znicz_tpu.ops import conv as conv_ops
+
+    tpe = entry["type"]
+    q = params["weights_q8"].astype(jnp.float32)
+    scale = params["weights_scale"]
+    b = params.get("bias")
+    include_bias = bool(entry.get("include_bias", True)) and \
+        b is not None
+    if tpe == "softmax" or tpe.startswith("all2all"):
+        y = y.reshape(y.shape[0], -1)
+        z = dense.forward_jax(
+            y, q, None, activation="linear",
+            weights_transposed=bool(entry.get("weights_transposed")),
+            include_bias=False)
+        z = z * scale.reshape(1, -1)
+        if include_bias:
+            z = z + b
+        if tpe == "softmax":
+            z, _ = dense.softmax_jax(z)
+            return z
+        return activations.apply_jax(_FC_ACT[tpe], z)
+    if tpe.startswith("conv"):
+        z = conv_ops.forward_jax(
+            _nhwc(y), q, None, int(entry["ky"]), int(entry["kx"]),
+            tuple(int(v) for v in entry["padding"]),
+            tuple(int(v) for v in entry["sliding"]),
+            activation="linear", include_bias=False)
+        # NHWC output: kernels are the trailing channel axis
+        z = z * scale.reshape(1, 1, 1, -1)
+        if include_bias:
+            z = z + b
+        return activations.apply_jax(_CONV_ACT[tpe], z)
+    raise ValueError(
+        "quantized serving: unsupported layer type %r" % tpe)
+
+
 def _apply_layer(entry, params, y):
     """One manifest layer as a pure jax computation (the jax twin of
-    ``export.run_package_numpy`` — same layer scope, same semantics)."""
+    ``export.run_package_numpy`` — same layer scope, same semantics).
+    Layers carrying int8-quantized weights route through
+    :func:`_apply_quantized_layer`."""
+    if "weights_q8" in params:
+        return _apply_quantized_layer(entry, params, y)
     from znicz_tpu.ops import activations, dense
     from znicz_tpu.ops import conv as conv_ops
     from znicz_tpu.ops import normalization as norm_ops
@@ -182,10 +245,11 @@ class _Model(object):
 
     __slots__ = ("layers", "params", "fn", "key", "dtype",
                  "sample_shape", "source", "version", "warm",
-                 "host_params", "dev_bytes")
+                 "host_params", "dev_bytes", "serve_dtype")
 
     def __init__(self, layers, params, fn, key, dtype, sample_shape,
-                 source, version, warm, host_params=None):
+                 source, version, warm, host_params=None,
+                 serve_dtype="f32"):
         self.layers = layers
         self.params = params
         self.fn = fn
@@ -196,6 +260,9 @@ class _Model(object):
         self.version = version
         self.warm = warm
         self.host_params = host_params
+        #: the serving precision mode ("f32" | "bf16" | "int8") this
+        #: generation's params are stored in — fixed per load
+        self.serve_dtype = serve_dtype
         #: resident param footprint, computed ONCE — the registry's
         #: budget sweep reads this per request and must not walk the
         #: whole pytree each time (sizes never change for a generation)
@@ -203,18 +270,36 @@ class _Model(object):
             int(v.nbytes) for p in (params or []) for v in p.values())
 
 
-def _build_forward(layers):
+def _build_forward(layers, serve_dtype="f32"):
     """Compose the layer chain into one jitted ``forward(params, x)``.
 
     ``layers`` is static (closed over); ``params`` is a pytree argument
     so param-only reloads hit the existing executable.
+
+    ``serve_dtype`` selects the low-precision data path
+    (:mod:`znicz_tpu.serving.quant`):
+
+    * ``"f32"`` — the historical bit-identical path (identical jaxpr).
+    * ``"bf16"`` — activations run in bfloat16 end to end (params
+      arrive pre-cast), outputs cast back to f32 at the jit boundary.
+    * ``"int8"`` — quantized layers carry ``weights_q8`` (int8) +
+      ``weights_scale`` (f32); the dequant is folded INTO the jitted
+      program (:func:`_apply_quantized_layer`), so the executable's
+      weight reads are int8 — 4x fewer bytes from device memory than
+      f32 — while activations and accumulation stay in the model's
+      float dtype.
     """
     import jax
+    import jax.numpy as jnp
+    out_f32 = serve_dtype == "bf16"
 
     def forward(params, x):
         y = x
         for entry, p in zip(layers, params):
             y = _apply_layer(entry, p, y)
+        if out_f32:
+            # bf16 serves float32 replies — clients never see bf16
+            y = y.astype(jnp.float32)
         return y
 
     return jax.jit(forward)
@@ -229,13 +314,27 @@ class InferenceEngine(Logger):
     largest bucket; ``buckets`` overrides the power-of-two ladder.
     ``sample_shape`` overrides the per-sample input shape when the
     source does not record one (old packages).
+
+    ``dtype`` pins the serving precision mode — ``"f32"`` (default,
+    bit-identical), ``"bf16"`` (params + activations bfloat16, f32
+    replies) or ``"int8"`` (per-output-channel quantized weights with
+    the dequant folded into the executable) — see
+    :mod:`znicz_tpu.serving.quant`.  ``None`` follows the source's
+    recorded warmup manifest (``serving.dtype``), falling back to f32.
+    Unknown strings raise immediately.
     """
 
     def __init__(self, source=None, max_batch=None, buckets=None,
-                 sample_shape=None, warmup=None, name=None):
+                 sample_shape=None, warmup=None, name=None,
+                 dtype=None):
         super(InferenceEngine, self).__init__(
             logger_name="InferenceEngine")
         cfg = root.common.serving
+        #: operator-pinned serving dtype (validated NOW — a typo must
+        #: fail the constructor, not silently serve f32); None follows
+        #: the source manifest
+        self._dtype_pin = (quant.normalize_dtype(dtype)
+                           if dtype is not None else None)
         #: registry model name; when set, every telemetry series /
         #: breaker / journal event this engine emits carries a
         #: ``model_<name>`` label so multi-model metrics never collide
@@ -296,10 +395,23 @@ class InferenceEngine(Logger):
 
     @property
     def dtype(self):
-        """The loaded model's compute dtype (None before a load) — the
-        HTTP front end parses request bodies straight into it."""
+        """The loaded model's activation/input dtype (None before a
+        load) — the HTTP front end parses request bodies straight into
+        it.  bf16 engines take bf16 activations; int8 engines quantize
+        WEIGHTS only, so their inputs stay in the model's float dtype."""
         m = self._model
         return m.dtype if m is not None else None
+
+    @property
+    def serve_dtype(self):
+        """The serving precision mode ("f32" | "bf16" | "int8") — the
+        dtype axis of the compile-cache key, the warmup manifest, the
+        per-dtype cost-registry entries and the continuous batcher's
+        dispatch lanes."""
+        m = self._model
+        if m is not None:
+            return m.serve_dtype
+        return self._dtype_pin or "f32"
 
     @property
     def warm_buckets(self):
@@ -327,9 +439,15 @@ class InferenceEngine(Logger):
         """Per-model telemetry naming: unnamed engines keep the exact
         historical series names; named (registry-hosted) engines get a
         ``model_<name>`` label so several models' metrics coexist on
-        one /metrics page."""
+        one /metrics page.  Low-precision engines additionally carry a
+        ``dtype_<mode>`` label (f32 keeps the exact historical names),
+        so the same model served at two precisions separates cleanly.
+        """
         if self.name is not None:
             labels["model"] = self.name
+        sd = self.serve_dtype
+        if sd != "f32":
+            labels["dtype"] = sd
         return telemetry.labeled(series, **labels)
 
     def stats(self):
@@ -343,6 +461,7 @@ class InferenceEngine(Logger):
             "sample_shape": (list(m.sample_shape)
                              if m and m.sample_shape else None),
             "dtype": str(numpy.dtype(m.dtype)) if m else None,
+            "serve_dtype": self.serve_dtype,
             "buckets": list(self.buckets),
             "warm_buckets": list(self.warm_buckets),
             "resident": self.resident,
@@ -380,12 +499,26 @@ class InferenceEngine(Logger):
             p = {}
             for attr, value in arrs.items():
                 value = numpy.asarray(value)
-                if dtype is None and \
-                        numpy.issubdtype(value.dtype, numpy.floating):
+                if dtype is None and not attr.startswith("quant_") \
+                        and numpy.issubdtype(value.dtype,
+                                             numpy.floating):
                     dtype = value.dtype
                 p[attr] = value
             host_params.append(p)
         dtype = dtype or numpy.float32
+        # serving precision: the constructor pin wins; otherwise the
+        # source's recorded warmup manifest selects (a package exported
+        # for int8 serving serves int8 everywhere it lands); f32 else.
+        # Resolved per load so a reload of a different-manifest source
+        # behaves like a topology change (the key below diverges).
+        serve_dtype = self._dtype_pin or quant.normalize_dtype(
+            (serving_mf or {}).get("dtype"))
+        # convert the HOST copies: quantized/cast arrays are what gets
+        # uploaded, what evict keeps, and what restore re-uploads — an
+        # int8 model's restore moves int8 bytes, not the f32 originals
+        host_params = quant.convert_host_params(layers, host_params,
+                                                serve_dtype)
+        dtype = quant.input_dtype(serve_dtype, dtype)
         # pin the params device-resident ONCE — dispatches must not pay
         # a host->device upload per request (jit's cache key only sees
         # shape/dtype, so this changes nothing else)
@@ -396,11 +529,13 @@ class InferenceEngine(Logger):
         else:
             shape = src_shape or self._sample_shape_override or \
                 _derived_sample_shape(layers, params)
-        # the compile-cache key: topology + array shapes/dtypes — any
-        # difference means the old executables cannot be reused
+        # the compile-cache key: serving dtype + topology + array
+        # shapes/dtypes — any difference means the old executables
+        # cannot be reused
         key = json.dumps(
-            [layers, [{a: [str(v.dtype)] + list(v.shape)
-                       for a, v in p.items()} for p in params]],
+            [serve_dtype, layers,
+             [{a: [str(v.dtype)] + list(v.shape)
+               for a, v in p.items()} for p in params]],
             sort_keys=True, default=str)
         # manifest-ladder adoption happens LAST before the swap —
         # nothing below here raises until warmup, whose failure
@@ -434,12 +569,13 @@ class InferenceEngine(Logger):
                 # warm-bucket set carry over to the new generation
                 fn, warm = old.fn, old.warm
             else:
-                fn, warm = _build_forward(layers), set()
+                fn, warm = _build_forward(layers, serve_dtype), set()
                 self._ready.clear()
             self._version += 1
             model = _Model(layers, params, fn, key, dtype, shape,
                            label, self._version, warm,
-                           host_params=host_params)
+                           host_params=host_params,
+                           serve_dtype=serve_dtype)
             self._model = model
             if telemetry.enabled():
                 telemetry.gauge(self._label(
@@ -448,13 +584,15 @@ class InferenceEngine(Logger):
                     "serving.warm_buckets")).set(len(model.warm))
         self._ledger_swap(old_bytes, self.device_bytes)
         event = {"version": self._version, "source": label,
-                 "topology_changed": not reused}
+                 "topology_changed": not reused,
+                 "serve_dtype": serve_dtype}
         if self.name is not None:
             event["model"] = self.name
         telemetry.record_event("serving.reload", **event)
-        self.info("model v%d <- %s (%d layers, dtype %s, "
+        self.info("model v%d <- %s (%d layers, dtype %s, serve %s, "
                   "sample shape %s)", self._version, label,
-                  len(layers), numpy.dtype(dtype).name, shape)
+                  len(layers), numpy.dtype(dtype).name, serve_dtype,
+                  shape)
         if not self._warmup_wanted:
             self._ready.set()
             return self._version
@@ -671,14 +809,27 @@ class InferenceEngine(Logger):
             from znicz_tpu.core import profiler
             if profiler.enabled():
                 # cost registry: this bucket's forward executable
-                # (lowered pre-dispatch — the dispatch reuses the trace)
+                # (lowered pre-dispatch — the dispatch reuses the
+                # trace).  Low-precision entries grow a dtype suffix
+                # (f32 keeps the exact historical names) and every
+                # entry carries dtype= meta, so per-dtype bytes
+                # accessed / operational intensity are separable —
+                # the roofline axis bench.py's precision block stamps.
                 cost_name = ("serving.forward.b%d" % bucket
                              if self.name is None else
                              "serving.forward.%s.b%d"
                              % (self.name, bucket))
+                if m.serve_dtype != "f32":
+                    cost_name += "." + m.serve_dtype
+                meta = {"bucket": bucket, "model_version": m.version,
+                        "dtype": m.serve_dtype}
+                if self.name is not None:
+                    # meta-addressable per model: consumers look
+                    # entries up via cost_entries_by_meta(model=...,
+                    # dtype=...) instead of rebuilding name strings
+                    meta["model"] = self.name
                 profiler.register_jit_cost(
-                    cost_name, fn, (params, x),
-                    bucket=bucket, model_version=m.version)
+                    cost_name, fn, (params, x), **meta)
         # admission immediately adjacent to the recorded region: an
         # admitted call (half-open probe slot included) is ALWAYS
         # answered by exactly one record_* below — nothing that can
@@ -814,8 +965,11 @@ class InferenceEngine(Logger):
                 raise RuntimeError("no model loaded")
             if m.params is not None and m.fn is not None:
                 return False  # resident — nothing to do
+            # host_params hold the CONVERTED arrays (bf16 casts / int8
+            # weights + scales), so a low-precision model's restore
+            # re-uploads the small representation, never f32 originals
             m.params = jax.device_put(m.host_params)
-            m.fn = _build_forward(m.layers)
+            m.fn = _build_forward(m.layers, m.serve_dtype)
             m.warm.clear()
         self._ledger_swap(0, self.device_bytes)
         event = {"version": self._version,
@@ -858,7 +1012,11 @@ def _derived_sample_shape(layers, params):
     for entry, p in zip(layers, params):
         tpe = entry["type"]
         if tpe == "softmax" or tpe.startswith("all2all"):
+            # int8 engines carry the quantized weights instead — same
+            # shape, same derivation
             w = p.get("weights")
+            if w is None:
+                w = p.get("weights_q8")
             if w is None:
                 return None
             size = (w.shape[0] if entry.get("weights_transposed")

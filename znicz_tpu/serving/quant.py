@@ -1,0 +1,208 @@
+"""Low-precision serving dtypes — the numeric half of the leaner
+inference path the paper ships as libZnicz (PAPER.md §0): training
+wants f32 master params and reproducible gradients, serving wants the
+fewest bytes per prediction the accuracy budget allows.
+
+Three serving dtypes (:data:`DTYPES`), selected per engine
+(``InferenceEngine(dtype=...)`` / per-model registry kwarg /
+``serve ... --dtype`` / the source's recorded warmup manifest):
+
+* ``f32`` — today's path, bit-identical to the training forward.
+* ``bf16`` — params cast ONCE at load/restore to ``bfloat16`` (host
+  copies kept in bf16 too, so evict→restore re-uploads half the
+  bytes), activations bf16, outputs cast back to f32 at the jit
+  boundary.  2x fewer weight bytes per dispatch.
+* ``int8`` — **per-output-channel symmetric weight quantization**:
+  int8 weights plus one f32 scale per output channel
+  (:func:`quantize_weights`), biases and activations kept f32, the
+  dequant (``w_q * scale``) folded INTO the jitted forward so the
+  executable reads 4x fewer weight bytes from device memory.  Scales
+  come from the package's export-time sidecar
+  (``export.export_package(..., quantize=True)``) when present, else
+  they are computed lazily at load — bit-identical either way for the
+  same weights.
+
+The quantization error bound is the usual symmetric-uniform one: each
+weight moves by at most ``scale/2 = max|w_channel| / 254``; the
+per-BUCKET output deltas this produces on real models are measured and
+pinned by :mod:`znicz_tpu.serving.accuracy`.
+
+This module is pure numpy — device placement and the jitted dequant
+live in ``serving/engine.py``; everything here runs once per load, not
+per request.
+"""
+
+import numpy
+
+#: the serving dtype axis, in documentation order
+DTYPES = ("f32", "bf16", "int8")
+
+#: accepted spellings (config files, CLI flags, manifests)
+_ALIASES = {
+    "f32": "f32", "float32": "f32", "float": "f32",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "int8": "int8", "i8": "int8",
+}
+
+#: the one weight-quantization scheme this build writes and reads;
+#: recorded in package manifests so a reader can refuse a future one
+QUANT_SCHEME = "int8_per_channel_symmetric"
+
+#: layer type prefixes whose ``weights`` array quantizes (the GEMM /
+#: conv families — everything dense.forward_jax / conv.forward_jax
+#: consumes).  Pooling/LRN/activations carry no weights.
+_QUANTIZABLE = ("softmax", "all2all", "conv")
+
+
+def normalize_dtype(dtype):
+    """Canonical serving dtype for any accepted spelling; ``None``
+    means f32.  Unknown strings fail LOUDLY — a typo'd dtype must
+    never silently serve f32."""
+    if dtype is None:
+        return "f32"
+    key = str(dtype).strip().lower()
+    try:
+        return _ALIASES[key]
+    except KeyError:
+        raise ValueError(
+            "unknown serving dtype %r (known: %s)"
+            % (dtype, "/".join(sorted(set(_ALIASES)))))
+
+
+def quantizable(entry):
+    """True when the manifest layer's ``weights`` array quantizes."""
+    tpe = entry.get("type", "")
+    return any(tpe == p or tpe.startswith(p) for p in _QUANTIZABLE)
+
+
+def quant_axis(entry):
+    """The output-channel axis of the layer's STORED weights layout.
+
+    FC and conv weights store as ``(out, in)`` — axis 0 — unless the
+    manifest flags ``weights_transposed`` (stored ``(in, out)`` —
+    axis 1).  Quantization happens in the stored layout, BEFORE the
+    engine's transposes, so the scale broadcast is a plain multiply.
+    """
+    return 1 if entry.get("weights_transposed") else 0
+
+
+def quantize_weights(w, axis=0):
+    """Per-output-channel symmetric int8 quantization.
+
+    Returns ``(q, scale)``: ``q`` is int8 in [-127, 127] (symmetric —
+    -128 is never used, so negation round-trips), ``scale`` is f32
+    with ``w``'s rank and size 1 on every axis but ``axis``
+    (broadcast-ready: ``q * scale ~= w``).  All-zero channels get
+    scale 1.0 so the dequant never divides by zero.
+    """
+    w = numpy.asarray(w, dtype=numpy.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = numpy.max(numpy.abs(w), axis=reduce_axes, keepdims=True)
+    scale = amax / 127.0
+    scale = numpy.where(scale > 0.0, scale, 1.0).astype(numpy.float32)
+    q = numpy.clip(numpy.rint(w / scale), -127, 127).astype(numpy.int8)
+    return q, scale
+
+
+def dequantize_weights(q, scale):
+    """The numpy reference dequant (the jitted forward folds the same
+    multiply): ``q * scale`` in f32."""
+    return q.astype(numpy.float32) * numpy.asarray(scale,
+                                                   numpy.float32)
+
+
+def bfloat16_dtype():
+    """numpy's bfloat16 dtype (via ml_dtypes, a jax dependency)."""
+    import ml_dtypes
+    return numpy.dtype(ml_dtypes.bfloat16)
+
+
+def convert_host_params(layers, host_params, dtype):
+    """Convert a loaded model's per-layer host param dicts to the
+    serving ``dtype``'s STORAGE layout.  Returns a NEW params list —
+    the converted arrays are what the engine uploads, keys its compile
+    cache on, and keeps as the host copies for evict→restore (a
+    restore must re-upload the quantized bytes, not the f32
+    originals).  ``layers`` entries may be updated in place (the
+    ``weights_transposed`` flag, see layout canonicalization below) —
+    the engine passes its per-generation normalized copies, never a
+    caller's manifest.
+
+    * ``f32`` — the input list unchanged (bit-identical path — the
+      arrays AND the stored layout are never touched), minus any
+      export-time quant sidecar arrays (an f32 engine must not upload
+      int8 arrays it never reads).
+    * ``bf16`` — every floating array cast to bfloat16.
+    * ``int8`` — for each quantizable layer, ``weights`` is replaced
+      by ``weights_q8`` (int8) + ``weights_scale`` (f32, broadcast
+      shape).  A package sidecar (``quant_weights_q8`` /
+      ``quant_weights_scale`` arrays written at export time) is
+      adopted verbatim; otherwise the weights quantize here.  Biases
+      and non-quantizable layers stay f32.
+
+    **Layout canonicalization.**  Low-precision weights of layers
+    stored TRANSPOSED (``(in, out)``) are transposed once here to the
+    row-major ``(out, in)`` layout and the entry's
+    ``weights_transposed`` flag cleared: each output channel's
+    int8/bf16 bytes then form one contiguous run that the dot's
+    contraction reads directly, which XLA fuses into the matvec/GEMM
+    instead of materializing a full-precision copy of the weights per
+    dispatch (measured 2.5x on the CPU backend's batch-1 path; on TPU
+    it is the HBM-optimal per-channel layout).  f32 models keep their
+    stored layout untouched — bit-identity beats layout preference.
+    """
+    dtype = normalize_dtype(dtype)
+    out = []
+    for entry, p in zip(layers, host_params):
+        sidecar_q = p.get("quant_weights_q8")
+        sidecar_s = p.get("quant_weights_scale")
+        p = {k: v for k, v in p.items()
+             if not k.startswith("quant_")}
+        canonicalize = (dtype in ("bf16", "int8")
+                        and quantizable(entry)
+                        and bool(entry.get("weights_transposed"))
+                        and p.get("weights") is not None)
+        if dtype == "bf16":
+            if canonicalize:
+                p = dict(p, weights=numpy.ascontiguousarray(
+                    p["weights"].T))
+                entry["weights_transposed"] = False
+            bf16 = bfloat16_dtype()
+            p = {k: (v.astype(bf16)
+                     if numpy.issubdtype(v.dtype, numpy.floating)
+                     else v)
+                 for k, v in p.items()}
+        elif dtype == "int8" and quantizable(entry) and \
+                p.get("weights") is not None:
+            if sidecar_q is not None and sidecar_s is not None:
+                # export-time sidecar (stored layout) is authoritative
+                q = numpy.asarray(sidecar_q, numpy.int8)
+                scale = numpy.asarray(sidecar_s, numpy.float32)
+                if q.shape != p["weights"].shape:
+                    raise ValueError(
+                        "layer %r: quant sidecar shape %s does not "
+                        "match weights %s"
+                        % (entry.get("name", entry.get("type")),
+                           q.shape, p["weights"].shape))
+            else:
+                q, scale = quantize_weights(p["weights"],
+                                            quant_axis(entry))
+            if canonicalize:
+                q = numpy.ascontiguousarray(q.T)
+                scale = numpy.ascontiguousarray(scale.T)
+                entry["weights_transposed"] = False
+            p = dict(p)
+            del p["weights"]
+            p["weights_q8"] = q
+            p["weights_scale"] = scale
+        out.append(p)
+    return out
+
+
+def input_dtype(dtype, base_dtype):
+    """The dtype request bodies parse into / activations enter as:
+    bf16 engines take bf16 activations; f32 and int8 engines keep the
+    model's base floating dtype (int8 quantizes WEIGHTS only)."""
+    if normalize_dtype(dtype) == "bf16":
+        return bfloat16_dtype()
+    return base_dtype

@@ -18,7 +18,12 @@ never needed:
   budget (``root.common.serving.registry_memory_budget_bytes``, live
   config read; 0 = unlimited) evicts the least-recently-USED model's
   device state — params and compiled executables — via
-  ``engine.evict()``, keeping host copies.  The next request to an
+  ``engine.evict()``, keeping host copies.  Low-precision engines
+  (``add(name, src, dtype="int8"/"bf16")`` — a constructor-only kwarg,
+  so changing a model's precision means remove + re-add) account their
+  QUANTIZED footprint against the budget: an int8 model charges ~4x
+  fewer bytes than its f32 twin, and its evict→restore round-trip
+  re-uploads the int8 arrays, never the f32 originals.  The next request to an
   evicted model lazily restores it (re-upload + re-warm; with the
   persistent compilation cache of :mod:`znicz_tpu.core.compile_cache`
   the re-warm is a cache load, not a recompile).  Residency is
@@ -147,7 +152,8 @@ class ModelRegistry(Logger):
             count = len(self._entries)
         telemetry.record_event("registry.add", model=name,
                                version=engine.version,
-                               source=str(engine.source))
+                               source=str(engine.source),
+                               serve_dtype=engine.serve_dtype)
         if telemetry.enabled():
             telemetry.gauge("serving.registry_models").set(count)
         self.info("model %r added (v%d, %d model%s registered)",

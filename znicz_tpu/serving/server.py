@@ -65,6 +65,10 @@ CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
     python -m znicz_tpu serve model.zip --max-batch 32 --max-delay-ms 2
     # multi-model registry + continuous batching + persistent cache:
     python -m znicz_tpu serve wine=wine.pickle mnist=mnist.zip
+    # low-precision serving: engine-wide --dtype, or per model via
+    # NAME=PATH@DTYPE (docs/serving.md "Precision modes"):
+    python -m znicz_tpu serve model.zip --dtype int8
+    python -m znicz_tpu serve a=m.zip@int8 b=m.zip   # same model, 2 dtypes
 """
 
 import argparse
@@ -544,6 +548,12 @@ def main(argv=None):
     parser.add_argument("--no-warmup", action="store_true",
                         help="serve immediately; first request per "
                              "bucket pays the compile")
+    parser.add_argument("--dtype", default=None,
+                        choices=("f32", "bf16", "int8"),
+                        help="serving precision mode (default: the "
+                             "source's recorded manifest, else f32); "
+                             "per-model override via NAME=PATH@DTYPE "
+                             "specs in registry mode")
     parser.add_argument("--compile-cache", nargs="?", const="",
                         default=None, metavar="DIR",
                         help="wire the persistent XLA compilation "
@@ -572,14 +582,29 @@ def main(argv=None):
     if args.sample_shape:
         sample_shape = tuple(int(d) for d in
                              args.sample_shape.split(","))
+    def _split_dtype(path):
+        """Optional per-model precision suffix: NAME=PATH@DTYPE.
+        Only a suffix that parses as a known serving dtype splits —
+        a literal '@' elsewhere in a path stays part of the path."""
+        from znicz_tpu.serving import quant
+        if "@" in path:
+            base, _, suffix = path.rpartition("@")
+            try:
+                return base, quant.normalize_dtype(suffix)
+            except ValueError:
+                pass
+        return path, None
+
     registry = engine = None
     if named:
         registry = ModelRegistry(
             memory_budget_bytes=args.memory_budget_bytes,
             max_batch=args.max_batch, sample_shape=sample_shape,
-            warmup=not args.no_warmup)
+            warmup=not args.no_warmup, dtype=args.dtype)
         for name, path in named:
-            registry.add(name, path)
+            path, dtype = _split_dtype(path)
+            registry.add(name, path,
+                         **({"dtype": dtype} if dtype else {}))
         from znicz_tpu.serving.continuous import ContinuousBatcher
         batcher = ContinuousBatcher(
             registry, max_inflight=args.max_inflight,
@@ -587,17 +612,19 @@ def main(argv=None):
             timeout_ms=args.timeout_ms).start()
         label = ", ".join(sorted(registry.names()))
     else:
-        model = specs[0][1]
+        model, spec_dtype = _split_dtype(specs[0][1])
         if args.latest:
             from znicz_tpu.launcher import newest_snapshot
             directory = args.directory or root.common.dirs.snapshots
-            model = newest_snapshot(directory, specs[0][1])
+            prefix = model
+            model = newest_snapshot(directory, prefix)
             if model is None:
                 raise SystemExit("no snapshot with prefix %r under %s"
-                                 % (specs[0][1], directory))
+                                 % (prefix, directory))
         engine = InferenceEngine(model, max_batch=args.max_batch,
                                  sample_shape=sample_shape,
-                                 warmup=not args.no_warmup)
+                                 warmup=not args.no_warmup,
+                                 dtype=spec_dtype or args.dtype)
         batcher = MicroBatcher(engine, max_delay_ms=args.max_delay_ms,
                                queue_limit=args.queue_limit,
                                timeout_ms=args.timeout_ms).start()
