@@ -518,6 +518,11 @@ def main(profile_dir=None):
     # flat serving_<dtype>_requests_per_sec keys gated like all
     # throughput (tools/bench_gate.py)
     _stamp_serving_precision(out, peaks)
+    # batch-1 tail latency (ISSUE 12): the f32-fast hot path under
+    # adversarial mixes (steady / cold bucket / evict→restore /
+    # breaker half-open probe) — req/s gated like throughput, exact
+    # per-scenario p99s gated inverted (tools/bench_gate.py)
+    _stamp_serving_tail(out)
     prec = out.get("serving_precision", {}).get("dtypes")
     if prec and isinstance(out.get("roofline"), dict):
         # the roofline block grows the per-dtype serving axis: where
@@ -851,8 +856,9 @@ def _serving_loadgen_block(steady_s=4.0, overload_s=3.0, max_batch=8,
     return out
 
 
-#: the serving precision axis the bench sweeps (ISSUE 10)
-PRECISION_DTYPES = ("f32", "bf16", "int8")
+#: the serving precision axis the bench sweeps (ISSUE 10; ISSUE 12
+#: adds the f32-fast batch-1 latency mode to the same roofline)
+PRECISION_DTYPES = ("f32", "f32_fast", "bf16", "int8")
 
 
 def _precision_model(n_in=784, n_hidden=2048, n_out=10, seed=33):
@@ -974,7 +980,7 @@ def _serving_precision_block(peaks, n_requests=300):
                                        else "compute")
         out["dtypes"][dt] = d
     f32 = out["dtypes"]["f32"]
-    for dt in ("bf16", "int8"):
+    for dt in ("f32_fast", "bf16", "int8"):
         d = out["dtypes"][dt]
         if f32["requests_per_sec"]:
             d["speedup_vs_f32"] = round(
@@ -989,10 +995,134 @@ def _serving_precision_block(peaks, n_requests=300):
         int8["requests_per_sec"] > f32["requests_per_sec"])
     out["int8_intensity_gain"] = int8.get("intensity_vs_f32")
     # the accuracy axis, same source, per bucket (ladder 1..4 keeps
-    # the report to 9 small compiles) — deltas vs the documented pins
+    # the report to 12 small compiles) — deltas vs the documented pins
     out["accuracy"] = accuracy.dtype_delta_report(
-        src, max_batch=4, n_rows=32)
+        src, dtypes=("f32_fast", "bf16", "int8"), max_batch=4,
+        n_rows=32)
     return out
+
+
+#: the flat gated tail keys (tools/bench_gate.py GATED_INVERSE) and
+#: the scenario each one tracks — one schema for the stamping helper,
+#: the --serving-tail CI assertion and the gate
+TAIL_P99_KEYS = {
+    "serving_tail_p99_ms": "steady",
+    "serving_tail_cold_bucket_p99_ms": "cold_bucket",
+    "serving_tail_evict_restore_p99_ms": "evict_restore",
+    "serving_tail_breaker_probe_p99_ms": "breaker_probe",
+}
+
+
+def _serving_tail_block(n_steady=300):
+    """The batch-1 tail-latency block (ISSUE 12): the f32-fast engine
+    on the memory-bound precision model, measured under the
+    adversarial mixes real traffic hits —
+
+    * ``steady`` — warmed batch-1 dispatches (the fast-path headline;
+      its req/s is the gated ``serving_f32_batch1_requests_per_sec``
+      and its exact p99 the gated ``serving_tail_p99_ms``),
+    * ``cold_bucket`` — the FIRST request of every bucket on a fresh
+      un-warmed replica (trace+compile on the request path; a
+      persistent-cache load when the compile cache is wired),
+    * ``evict_restore`` — the request that pays a registry-LRU
+      evict's lazy restore (re-upload + rebuild + re-warm),
+    * ``breaker_probe`` — the half-open probe through a recovering
+      circuit breaker.
+
+    A strict-f32 steady reference runs next to it so the stamped
+    block carries the fast-vs-strict speedup (the number that closes
+    ROADMAP item 5), and every scenario's samples land in the
+    ``serving.tail_seconds.scenario_*`` histogram series.  Exact
+    quantiles from retained samples throughout
+    (znicz_tpu/serving/latency.py)."""
+    from znicz_tpu.core import telemetry
+    from znicz_tpu.serving import InferenceEngine
+    from znicz_tpu.serving import latency
+
+    telemetry.enable()
+    src = _precision_model()
+    n_in = src[0]["input_sample_shape"][0]
+    row = numpy.random.RandomState(5).uniform(
+        -1, 1, (1, n_in)).astype(numpy.float32)
+    buckets = (1, 2, 4, 8)
+
+    # strict f32 steady reference (today's shipped slow path — the
+    # PR 10 73-117 req/s regime; a short loop, it is ~15x slower)
+    strict = InferenceEngine(src, max_batch=1, dtype="f32",
+                             name="tail_f32")
+    s_samples, s_elapsed = latency.run_steady(strict, row,
+                                              n=max(20, n_steady // 6))
+    strict_block = dict(latency.quantile_summary(s_samples),
+                        requests_per_sec=round(
+                            len(s_samples) / s_elapsed, 1))
+
+    engine = InferenceEngine(src, buckets=buckets, dtype="f32-fast",
+                             name="tail_fast")
+    compiles0 = telemetry.counter("jax.backend_compiles").value
+    f_samples, f_elapsed = latency.run_steady(engine, row, n=n_steady)
+    steady_recompiles = (telemetry.counter("jax.backend_compiles").value
+                         - compiles0)
+    scenarios = {"steady": latency.quantile_summary(f_samples)}
+
+    cold = latency.run_cold_bucket(
+        lambda: InferenceEngine(src, buckets=buckets, dtype="f32-fast",
+                                warmup=False, name="tail_fast"),
+        (n_in,), trials=2)
+    scenarios["cold_bucket"] = latency.quantile_summary(cold)
+
+    ev_samples, ev_replies = latency.run_evict_restore(engine, row,
+                                                       n=3)
+    scenarios["evict_restore"] = latency.quantile_summary(ev_samples)
+
+    pr_samples, pr_replies = latency.run_breaker_probe(engine, row,
+                                                       trials=2)
+    scenarios["breaker_probe"] = latency.quantile_summary(pr_samples)
+
+    y_strict = strict.predict(row)
+    y_fast = engine.predict(row)
+    fast_rps = len(f_samples) / f_elapsed
+    out = {
+        "model": src[0]["input_sample_shape"],
+        "fast_dtype": engine.serve_dtype,
+        "latency_bucket_max": engine.stats().get("latency_bucket_max"),
+        "buckets": list(buckets),
+        "strict_f32": strict_block,
+        "scenarios": scenarios,
+        "f32_batch1_requests_per_sec": round(fast_rps, 1),
+        "fast_vs_strict_speedup": round(
+            fast_rps / max(strict_block["requests_per_sec"], 1e-9), 2),
+        "steady_recompiles": steady_recompiles,
+        "fast_strict_max_delta": float(
+            numpy.abs(y_fast - y_strict).max()),
+        "fast_bit_identical_to_strict": bool(
+            (y_fast == y_strict).all()),
+        "compile_keys_distinct": engine.compile_key !=
+        strict.compile_key,
+        # correctness rides the latency numbers: scenario replies
+        # must match the fast path's own steady answer exactly
+        "scenario_replies_exact": bool(
+            all((y == y_fast).all() for y in ev_replies) and
+            all((y == y_fast).all() for y in pr_replies)),
+    }
+    return out
+
+
+def _stamp_serving_tail(out):
+    """Stamp the tail-latency block + the flat gated keys — req/s
+    (gated like throughput) and the per-scenario exact p99s (gated
+    INVERTED).  Crash-guarded ZERO stamps: a broken latency tier
+    fails tools/bench_gate.py, never the bench."""
+    try:
+        out["serving_tail_latency"] = _serving_tail_block()
+    except Exception as e:  # noqa: BLE001 - never kill the primary
+        out["serving_tail_latency"] = {"error": repr(e)}
+    block = out["serving_tail_latency"]
+    out["serving_f32_batch1_requests_per_sec"] = (
+        block.get("f32_batch1_requests_per_sec") or 0.0)
+    scenarios = block.get("scenarios", {})
+    for key, scenario in sorted(TAIL_P99_KEYS.items()):
+        out[key] = (scenarios.get(scenario, {}) or {}).get("p99_ms") \
+            or 0.0
 
 
 def _stamp_serving_precision(out, peaks):
@@ -1121,6 +1251,22 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
     import jax
     _stamp_serving_precision(
         out, _device_peaks(jax.devices()[0].device_kind))
+    # ISSUE 12: the batch-1 tail-latency block — same stamps as the
+    # main bench
+    _stamp_serving_tail(out)
+    print(json.dumps(out))
+
+
+def main_serving_tail():
+    """``--serving-tail``: ONLY the batch-1 tail-latency block + its
+    flat gated keys, as one JSON line — the CPU-feasible CI entry
+    (tools/ci.sh pipes it through ``bench_gate --assert-stamped`` so
+    a latency tier that stops producing numbers fails the gate, not
+    the bench)."""
+    from znicz_tpu.core import telemetry
+    telemetry.reset()
+    out = {"metric": "serving_tail_latency"}
+    _stamp_serving_tail(out)
     print(json.dumps(out))
 
 
@@ -1137,6 +1283,9 @@ if __name__ == "__main__":
         # internal: one replica of the cold-start measurement
         _coldstart_worker(
             sys.argv[sys.argv.index("--serving-coldstart") + 1])
+        sys.exit(0)
+    if "--serving-tail" in sys.argv:
+        main_serving_tail()
         sys.exit(0)
     if "--serving" in sys.argv:
         kwargs = {}

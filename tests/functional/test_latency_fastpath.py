@@ -1,0 +1,207 @@
+"""Functional contract of the batch-1 latency fast path (ISSUE 12):
+the ``f32-fast`` engine mode serves replies matching strict f32 within
+its documented pin on every bucket, never aliases strict executables
+(compile-key distinctness, with the ``latency_bucket_max`` knob as a
+key component), stays recompile-free after warmup, and the adversarial
+tail scenarios — evict→restore on the request path, breaker half-open
+probes — produce CORRECT batch-1 answers whose latencies land in the
+per-scenario ``serving.tail_seconds`` histogram series."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core import prng, telemetry
+from znicz_tpu.core.config import root
+from znicz_tpu.serving import InferenceEngine
+from znicz_tpu.serving import accuracy, latency
+
+MAX_BATCH = 8
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """A trained wine workflow snapshot (the recipe every serving
+    suite pins bit-exactness with)."""
+    import znicz_tpu.loader.loader_wine  # noqa: F401
+    from znicz_tpu.standard_workflow import StandardWorkflow
+
+    tmp = tmp_path_factory.mktemp("latency_fastpath")
+    prng.get(1).seed(1024)
+    prng.get(2).seed(1025)
+    wf = StandardWorkflow(
+        None,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.3}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ],
+        loader_name="wine_loader",
+        loader_config={"minibatch_size": 10},
+        decision_config={"max_epochs": 3, "fail_iterations": 20},
+        snapshotter_config={"prefix": "lfwine", "interval": 1,
+                            "time_interval": 0, "compression": "",
+                            "directory": str(tmp)})
+    wf.initialize()
+    wf.run()
+    wf.snapshotter.suffix = "final"
+    return wf.snapshotter.export()
+
+
+def _rows(n, seed=3):
+    r = numpy.random.RandomState(seed)
+    return r.uniform(-1, 1, (n, 13)).astype(numpy.float32)
+
+
+def test_fast_replies_match_strict_within_pin_per_bucket(trained):
+    """Every bucket executable of the fast engine answers within the
+    documented f32_fast pin of the strict engine's reply for the SAME
+    rows — the padded executables that serve traffic, not a
+    convenience shape."""
+    strict = InferenceEngine(trained, max_batch=MAX_BATCH)
+    fast = InferenceEngine(trained, max_batch=MAX_BATCH,
+                           dtype="f32-fast")
+    tol = accuracy.TOLERANCES["f32_fast"]["max_delta"]
+    for bucket in strict.buckets:
+        x = _rows(bucket)
+        d = numpy.abs(strict.predict(x) - fast.predict(x)).max()
+        assert d <= tol, "bucket %d delta %.3g over pin %.3g" \
+            % (bucket, d, tol)
+
+
+def test_fast_mode_accuracy_report_holds_pin(trained):
+    report = accuracy.dtype_delta_report(
+        trained, dtypes=("f32_fast",), max_batch=4, n_rows=16)
+    block = report["dtypes"]["f32_fast"]
+    assert block["within_tolerance"], block
+    assert report["ok"]
+    # per-bucket deltas are reported for every ladder bucket
+    assert set(block["per_bucket"]) == {"1", "2", "4"}
+
+
+def test_compile_keys_fast_vs_strict_distinct(trained):
+    """The fast mode NEVER aliases strict-f32 executables — and the
+    strict key itself is untouched by this PR (dtype=None and
+    dtype="f32" still share everything)."""
+    default = InferenceEngine(trained, max_batch=MAX_BATCH)
+    strict = InferenceEngine(trained, max_batch=MAX_BATCH,
+                             dtype="f32")
+    fast = InferenceEngine(trained, max_batch=MAX_BATCH,
+                           dtype="f32-fast")
+    assert default.compile_key == strict.compile_key
+    assert fast.compile_key != strict.compile_key
+
+
+def test_latency_bucket_max_is_a_compile_key_component(trained,
+                                                       monkeypatch):
+    """Two fast loads under different latency_bucket_max values trace
+    different programs per bucket — they must never share executables;
+    their replies still agree bit-for-bit (the knob moves the
+    fast/strict variant boundary, both variants hold the pin)."""
+    monkeypatch.setattr(root.common.serving, "latency_bucket_max", 8)
+    fast8 = InferenceEngine(trained, max_batch=MAX_BATCH,
+                            dtype="f32-fast")
+    assert fast8.stats()["latency_bucket_max"] == 8
+    monkeypatch.setattr(root.common.serving, "latency_bucket_max", 0)
+    fast0 = InferenceEngine(trained, max_batch=MAX_BATCH,
+                            dtype="f32-fast")
+    assert fast0.stats()["latency_bucket_max"] == 0
+    assert fast8.compile_key != fast0.compile_key
+    x = _rows(2)
+    tol = accuracy.TOLERANCES["f32_fast"]["max_delta"]
+    assert numpy.abs(fast8.predict(x)
+                     - fast0.predict(x)).max() <= tol
+
+
+def test_zero_recompiles_after_warmup_mixed_sizes(trained):
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    fast = InferenceEngine(trained, max_batch=MAX_BATCH,
+                           dtype="f32-fast")
+    assert fast.ready
+    compiles0 = telemetry.counter("jax.backend_compiles").value
+    assert compiles0 > 0
+    for n in (1, 1, 2, 3, 5, 8, 1, 4):
+        assert fast.predict(_rows(n)).shape == (n, 3)
+    assert telemetry.counter("jax.backend_compiles").value == compiles0
+
+
+def test_evict_restore_batch1_correct_and_recorded(trained):
+    """The evict→restore scenario runner: restored batch-1 answers are
+    BIT-identical to the engine's own pre-evict reply, and every
+    trial's latency lands in the scenario's histogram series."""
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    fast = InferenceEngine(trained, max_batch=MAX_BATCH,
+                           dtype="f32-fast", name="lf")
+    x = _rows(1)
+    y0 = fast.predict(x)
+    samples, replies = latency.run_evict_restore(fast, x, n=2)
+    assert len(samples) == 2 and all(s > 0 for s in samples)
+    for y in replies:
+        assert (y == y0).all()
+    h = telemetry.histogram(
+        "serving.tail_seconds.model_lf.scenario_evict_restore")
+    assert h.count == 2
+    assert fast.resident and fast.ready
+
+
+def test_breaker_probe_batch1_correct_and_recorded(trained):
+    """The breaker-probe scenario runner: injected serving.forward
+    faults open the batch-1 bucket's breaker, the half-open probe
+    request answers CORRECTLY once the fault clears, its latency lands
+    in the scenario series, and the breaker closes again."""
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    fast = InferenceEngine(trained, max_batch=MAX_BATCH,
+                           dtype="f32-fast", name="lf2")
+    x = _rows(1)
+    y0 = fast.predict(x)
+    samples, replies = latency.run_breaker_probe(fast, x, trials=2)
+    assert len(samples) == 2
+    for y in replies:
+        assert (y == y0).all()
+    h = telemetry.histogram(
+        "serving.tail_seconds.model_lf2.scenario_breaker_probe")
+    assert h.count == 2
+    # the probe's success closed the breaker: normal traffic flows
+    assert (fast.predict(x) == y0).all()
+    assert fast.stats()["breakers"]["1"]["state"] == "closed"
+
+
+def test_cold_bucket_runner_hits_every_bucket(trained):
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    samples = latency.run_cold_bucket(
+        lambda: InferenceEngine(trained, buckets=(1, 2),
+                                dtype="f32-fast", warmup=False),
+        (13,), trials=2)
+    assert len(samples) == 4  # 2 buckets x 2 trials
+    h = telemetry.histogram(
+        "serving.tail_seconds.scenario_cold_bucket")
+    assert h.count == 4
+
+
+def test_warmup_manifest_selects_f32_fast_and_pin_wins(trained):
+    """A source whose recorded serving manifest says "f32-fast" loads
+    fast everywhere it lands; an explicit constructor pin still
+    wins."""
+    manifest = {
+        "format": 1,
+        "layers": [
+            {"type": "all2all_tanh", "name": "fc0",
+             "arrays": {"weights": "w0.npy", "bias": "b0.npy"},
+             "include_bias": True, "weights_transposed": False},
+        ],
+        "input_sample_shape": [5],
+        "serving": {"dtype": "f32-fast", "buckets": [1, 2]},
+    }
+    r = numpy.random.RandomState(0)
+    arrays = {"w0.npy": r.normal(0, 0.3, (4, 5)).astype("f4"),
+              "b0.npy": numpy.zeros(4, "f4")}
+    adopted = InferenceEngine((manifest, arrays))
+    assert adopted.serve_dtype == "f32_fast"
+    assert adopted.buckets == (1, 2)
+    pinned = InferenceEngine((dict(manifest), dict(arrays)),
+                             dtype="f32")
+    assert pinned.serve_dtype == "f32"

@@ -1,0 +1,182 @@
+"""Unit contract of the tail-latency quantile math (ISSUE 12):
+``znicz_tpu/serving/latency.py`` exact percentiles over RETAINED
+samples (the one formula loadgen, bench and the per-scenario
+histograms share), the scenario-series vocabulary, and
+``tools/loadgen.py``'s per-model × per-bucket latency breakdowns."""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+from znicz_tpu.serving import latency
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _loadgen():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return importlib.import_module("loadgen")
+    finally:
+        sys.path.pop(0)
+
+
+# -- exact_percentile -------------------------------------------------------
+
+def test_empty_returns_none():
+    assert latency.exact_percentile([], 50) is None
+    assert latency.exact_percentile((), 99.9) is None
+
+
+def test_single_sample_is_every_quantile():
+    for q in (0, 50, 95, 99, 99.9, 100):
+        assert latency.exact_percentile([7.5], q) == 7.5
+
+
+def test_known_small_sets_exact():
+    # rank = q/100 * (n-1), linear interpolation between order stats
+    data = [1.0, 2.0, 3.0, 4.0]
+    assert latency.exact_percentile(data, 50) == 2.5
+    assert latency.exact_percentile(data, 0) == 1.0
+    assert latency.exact_percentile(data, 100) == 4.0
+    # p25 of [1..4]: rank 0.75 -> 1*0.25 + 2*0.75
+    assert latency.exact_percentile(data, 25) == pytest.approx(1.75)
+    # p99 of 1..101 is exactly 100 (rank 99.0)
+    data = [float(v) for v in range(1, 102)]
+    assert latency.exact_percentile(data, 99) == pytest.approx(100.0)
+    # p999 interpolates the two largest order statistics
+    data = [float(v) for v in range(1, 11)]  # n=10, rank 8.991
+    assert latency.exact_percentile(data, 99.9) == \
+        pytest.approx(9.991)
+
+
+def test_ties_interpolate_to_tied_value():
+    data = [1.0, 2.0, 2.0, 2.0, 9.0]
+    assert latency.exact_percentile(data, 50) == 2.0
+    assert latency.exact_percentile([3.0, 3.0], 99) == 3.0
+
+
+def test_unsorted_input_is_sorted_first():
+    assert latency.exact_percentile([4.0, 1.0, 3.0, 2.0], 50) == 2.5
+
+
+def test_out_of_range_q_clamps():
+    data = [5.0, 6.0]
+    assert latency.exact_percentile(data, -3) == 5.0
+    assert latency.exact_percentile(data, 250) == 6.0
+
+
+# -- quantile_summary -------------------------------------------------------
+
+def test_quantile_summary_keys_and_units():
+    s = latency.quantile_summary([0.001, 0.002, 0.003, 0.004])
+    assert s["count"] == 4
+    assert s["p50_ms"] == pytest.approx(2.5)
+    assert s["p999_ms"] == pytest.approx(3.997)
+    assert s["min_ms"] == pytest.approx(1.0)
+    assert s["max_ms"] == pytest.approx(4.0)
+    assert s["mean_ms"] == pytest.approx(2.5)
+    assert set(s) >= {"p50_ms", "p95_ms", "p99_ms", "p999_ms"}
+
+
+def test_quantile_summary_empty_is_nulls_not_zeros():
+    s = latency.quantile_summary([])
+    assert s["count"] == 0
+    # a consumer must see the hole — a zero would read as "fast"
+    assert s["p99_ms"] is None and s["mean_ms"] is None
+
+
+# -- scenario series --------------------------------------------------------
+
+def test_record_scenario_unknown_name_is_loud():
+    with pytest.raises(ValueError, match="unknown tail-latency"):
+        latency.record_scenario("warp_drive", 0.1)
+
+
+def test_record_scenario_lands_in_labeled_histogram():
+    from znicz_tpu.core import telemetry
+    from znicz_tpu.core.config import root
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    latency.record_scenario("evict_restore", 0.25, model="m1")
+    h = telemetry.histogram(
+        "serving.tail_seconds.model_m1.scenario_evict_restore")
+    assert h.count == 1 and h.sum == pytest.approx(0.25)
+
+
+def test_record_scenario_disabled_is_noop():
+    from znicz_tpu.core import telemetry
+    from znicz_tpu.core.config import root
+    root.common.telemetry.enabled = False
+    telemetry.reset()
+    latency.record_scenario("steady", 0.1)  # must not raise
+    # nothing was recorded: the series is empty once readable
+    root.common.telemetry.enabled = True
+    assert telemetry.histogram("serving.tail_seconds.scenario_steady") \
+        .count == 0
+
+
+# -- loadgen report breakdowns ----------------------------------------------
+
+def test_loadgen_report_per_model_per_bucket():
+    loadgen = _loadgen()
+    models = [loadgen.ModelSpec("alpha", (4,), max_batch=8),
+              loadgen.ModelSpec("beta", (2,), max_batch=4)]
+    # records: (model_index, rows, latency_s, status)
+    records = [
+        ("alpha", 0, 1, 0.010, 200),   # bucket 1
+        ("alpha", 0, 1, 0.030, 200),   # bucket 1
+        ("alpha", 0, 3, 0.100, 200),   # bucket 4
+        ("alpha", 0, 5, 0.500, 504),   # error: excluded from latency
+        ("beta", 1, 2, 0.020, 200),    # bucket 2
+    ]
+    records = [r[1:] for r in records]
+    out = loadgen.report(records, scheduled=5, duration_s=1.0,
+                         slo_ms=100.0, seed=0, models=models)
+    a = out["per_model"]["alpha"]
+    assert a["requests"] == 4 and a["ok"] == 3
+    # exact quantiles from the retained per-model samples
+    assert a["latency_ms"]["p50"] == pytest.approx(30.0)
+    assert a["latency_ms"]["p999"] == pytest.approx(
+        1e3 * latency.exact_percentile([0.01, 0.03, 0.1], 99.9))
+    # flat back-compat keys agree with the block
+    assert a["p50_ms"] == a["latency_ms"]["p50"]
+    assert a["p99_ms"] == a["latency_ms"]["p99"]
+    # per-bucket attribution: rows pad into the engine-side bucket
+    assert set(a["per_bucket"]) == {"1", "4"}
+    assert a["per_bucket"]["1"]["count"] == 2
+    assert a["per_bucket"]["1"]["p50"] == pytest.approx(20.0)
+    assert a["per_bucket"]["4"]["count"] == 1
+    assert a["per_bucket"]["4"]["p99"] == pytest.approx(100.0)
+    b = out["per_model"]["beta"]
+    assert set(b["per_bucket"]) == {"2"}
+    # the global block carries the new tail quantiles too
+    assert out["latency_ms"]["p95"] is not None
+    assert out["latency_ms"]["p999"] is not None
+
+
+def test_loadgen_report_single_request_n1():
+    loadgen = _loadgen()
+    models = [loadgen.ModelSpec(None, (4,), max_batch=2)]
+    out = loadgen.report([(0, 1, 0.042, 200)], scheduled=1,
+                         duration_s=1.0, slo_ms=100.0, seed=0,
+                         models=models)
+    block = out["per_model"]["<default>"]
+    # n=1: every quantile is that sample
+    for key in ("p50", "p95", "p99", "p999", "max"):
+        assert block["latency_ms"][key] == pytest.approx(42.0)
+    assert block["per_bucket"]["1"]["count"] == 1
+
+
+def test_loadgen_bucket_for_uses_model_ladder():
+    loadgen = _loadgen()
+    m = loadgen.ModelSpec("x", (4,), max_batch=8)
+    assert [m.bucket_for(r) for r in (1, 2, 3, 8)] == [1, 2, 4, 8]
+    custom = loadgen.ModelSpec("y", (4,), max_batch=6,
+                               buckets=(3, 6))
+    assert custom.bucket_for(1) == 3 and custom.bucket_for(4) == 6
+    # over-ladder rows clamp to the top bucket (they erred anyway)
+    assert custom.bucket_for(99) == 6

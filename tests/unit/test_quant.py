@@ -176,8 +176,80 @@ def test_convert_canonicalizes_transposed_layout():
 def test_input_dtype():
     assert quant.input_dtype("f32", numpy.float32) == numpy.float32
     assert quant.input_dtype("int8", numpy.float32) == numpy.float32
+    assert quant.input_dtype("f32_fast", numpy.float32) == \
+        numpy.float32
     assert quant.input_dtype("bf16", numpy.float32) == \
         quant.bfloat16_dtype()
+
+
+# -- f32-fast (ISSUE 12: the batch-1 latency fast path) ---------------------
+
+def test_normalize_dtype_f32_fast_aliases():
+    for alias in ("f32-fast", "f32_fast", "F32-Fast", "f32fast",
+                  " fast32 "):
+        assert quant.normalize_dtype(alias) == "f32_fast"
+    assert "f32_fast" in quant.DTYPES
+
+
+def test_convert_f32_fast_fc_flips_to_dot_native_layout():
+    """FC weights stored (out, in) re-lay ONCE to (in, out) with the
+    flag SET — the forward then contracts x @ W with no transpose op
+    in the compiled program.  Values are the exact f32 bits."""
+    r = numpy.random.RandomState(11)
+    w = r.normal(0, 0.2, (4, 6)).astype(numpy.float32)  # (out, in)
+    b = r.normal(0, 0.1, 4).astype(numpy.float32)
+    entry = _fc_layer(transposed=False)
+    out = quant.convert_host_params(
+        [entry], [{"weights": w, "bias": b}], "f32_fast")
+    assert entry["weights_transposed"] is True
+    assert out[0]["weights"].shape == (6, 4)
+    assert (out[0]["weights"] == w.T).all()
+    assert out[0]["weights"].flags["C_CONTIGUOUS"]
+    # bias untouched, bit-identical
+    assert (out[0]["bias"] == b).all()
+    assert out[0]["bias"].dtype == numpy.float32
+
+
+def test_convert_f32_fast_already_dot_native_untouched():
+    r = numpy.random.RandomState(12)
+    w = r.normal(0, 0.2, (6, 4)).astype(numpy.float32)  # (in, out)
+    entry = _fc_layer(transposed=True)
+    out = quant.convert_host_params([entry], [{"weights": w}],
+                                    "f32_fast")
+    assert entry["weights_transposed"] is True
+    assert out[0]["weights"] is w  # no copy on the already-fast layout
+
+
+def test_convert_f32_fast_conv_clears_transpose():
+    """Conv forwards transpose FLAGGED weights in-program — f32-fast
+    pre-transposes those host-side and clears the flag, so the conv's
+    operand also carries no transpose op."""
+    r = numpy.random.RandomState(13)
+    w = r.normal(0, 0.2, (9, 5)).astype(numpy.float32)
+    entry = {"type": "conv_relu", "name": "c0", "ky": 3, "kx": 3,
+             "padding": (0, 0, 0, 0), "sliding": (1, 1),
+             "weights_transposed": True, "include_bias": True}
+    out = quant.convert_host_params([entry], [{"weights": w}],
+                                    "f32_fast")
+    assert entry["weights_transposed"] is False
+    assert (out[0]["weights"] == w.T).all()
+    # an unflagged conv stays untouched
+    entry2 = dict(entry, weights_transposed=False)
+    out2 = quant.convert_host_params([entry2], [{"weights": w}],
+                                     "f32_fast")
+    assert entry2["weights_transposed"] is False
+    assert out2[0]["weights"] is w
+
+
+def test_convert_f32_fast_drops_quant_sidecar():
+    r = numpy.random.RandomState(14)
+    w = r.normal(0, 0.2, (4, 6)).astype(numpy.float32)
+    q, s = quant.quantize_weights(w)
+    entry = _fc_layer(transposed=False)
+    out = quant.convert_host_params(
+        [entry], [{"weights": w, "quant_weights_q8": q,
+                   "quant_weights_scale": s}], "f32_fast")
+    assert set(out[0]) == {"weights"}
 
 
 # -- config.dtype_map (satellite) -------------------------------------------

@@ -1,8 +1,9 @@
 """Per-dtype serving accuracy report — the CLI face of
 ``znicz_tpu/serving/accuracy.py``.
 
-Runs the same eval rows through an f32 engine and its bf16/int8
-twins, PER SHAPE BUCKET (the executables that actually serve
+Runs the same eval rows through an f32 engine and its
+f32-fast/bf16/int8 twins, PER SHAPE BUCKET (the executables that
+actually serve
 traffic), and prints one JSON report with max/mean output delta and
 top-1 flip rate per dtype per bucket.  Exits nonzero when any dtype
 breaks its documented tolerance pin (docs/serving.md "Precision
@@ -11,7 +12,7 @@ and through ``tools/serving_smoke.py`` act 3, so a quantizer
 regression fails CI like any other contract break.
 
 Usage:
-    python tools/accuracy_delta.py MODEL [--dtypes bf16,int8]
+    python tools/accuracy_delta.py MODEL [--dtypes f32_fast,bf16,int8]
            [--rows N] [--max-batch B] [--seed S] [--report]
     python tools/accuracy_delta.py --selftest
 
@@ -65,7 +66,9 @@ def _synthetic_package():
 def selftest():
     from znicz_tpu.serving import accuracy
     src = _synthetic_package()
-    report = accuracy.dtype_delta_report(src, max_batch=8, n_rows=32)
+    report = accuracy.dtype_delta_report(
+        src, dtypes=("f32_fast", "bf16", "int8"), max_batch=8,
+        n_rows=32)
     ok, failures = accuracy.check(report)
     if not ok:
         print("accuracy_delta selftest FAILED: clean synthetic model "
@@ -97,10 +100,11 @@ def selftest():
               "passed the tolerance pins (max_delta %.4g)"
               % bad_report["dtypes"]["int8"]["max_delta"])
         return 1
-    print("accuracy_delta selftest OK: bf16 max_delta %.2g / int8 "
-          "max_delta %.2g within pins; sabotaged int8 scales rejected "
-          "(max_delta %.2g)"
-          % (report["dtypes"]["bf16"]["max_delta"],
+    print("accuracy_delta selftest OK: f32_fast max_delta %.2g / "
+          "bf16 %.2g / int8 %.2g within pins; sabotaged int8 scales "
+          "rejected (max_delta %.2g)"
+          % (report["dtypes"]["f32_fast"]["max_delta"],
+             report["dtypes"]["bf16"]["max_delta"],
              report["dtypes"]["int8"]["max_delta"],
              bad_report["dtypes"]["int8"]["max_delta"]))
     return 0
@@ -116,7 +120,7 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("model",
                         help="snapshot pickle or package zip")
-    parser.add_argument("--dtypes", default="bf16,int8",
+    parser.add_argument("--dtypes", default="f32_fast,bf16,int8",
                         help="comma list of dtypes to compare vs f32")
     parser.add_argument("--rows", type=int, default=64,
                         help="seeded eval rows (default 64)")

@@ -8,6 +8,9 @@ Usage:
                                [--threshold 0.10]
     python bench.py | python tools/bench_gate.py -      # pipe mode
     python tools/bench_gate.py --selftest               # CI wiring pin
+    python bench.py --serving-tail | \\
+        python tools/bench_gate.py - --assert-stamped KEY1,KEY2
+                                                        # CI stamping pin
 
 * ``NEW.json`` is bench.py's one-line JSON (or a driver stamp whose
   payload sits under ``"parsed"``); ``-`` reads stdin.
@@ -21,6 +24,17 @@ Usage:
   previous round stamped that comes back zero (bench.py's crash-guard
   fallback) or missing FAILS — a workload that stopped producing a
   number is the worst regression, not a skip.
+* ``--assert-stamped KEYS`` (comma list) checks only that the fresh
+  run carries a NONZERO value for every named key — the CI wiring for
+  partial-bench stampings (``bench.py --serving-tail``): a tier whose
+  crash guard stamped zeros (or that lost a key) fails the gate right
+  there, without waiting for the next full TPU round.  No round
+  comparison runs in this mode (a partial stamping legitimately lacks
+  the other workloads' keys).  The literal ``tail`` expands to the
+  batch-1 tail schema (``serving_f32_batch1_requests_per_sec`` + the
+  ``serving_tail_*`` keys of GATED_INVERSE) — derived from the gated
+  key tuples, so adding a scenario to the gate automatically extends
+  the CI assertion; key lists never drift apart by hand.
 * ``--selftest`` proves the gate actually fails: it takes the latest
   committed round, synthesizes a run with one workload dropped 15%
   below it, asserts the gate REJECTS it (likewise a zeroed/vanished
@@ -55,11 +69,27 @@ GATED = ("value", "f32_images_per_sec", "cifar_caffe_images_per_sec",
          # the round like any training workload
          "serving_f32_requests_per_sec",
          "serving_bf16_requests_per_sec",
-         "serving_int8_requests_per_sec")
+         "serving_int8_requests_per_sec",
+         # the batch-1 latency fast path (ISSUE 12): the f32-fast
+         # engine's steady batch-1 req/s (the number that closes the
+         # PR 10 f32-vs-int8 gap) plus its roofline-sweep twin — a
+         # fast path that slows down or stops stamping fails the
+         # round
+         "serving_f32_batch1_requests_per_sec",
+         "serving_f32_fast_requests_per_sec")
 
 #: latency-style keys (lower is better): a RISE past the threshold
 #: fails; zero/missing when the previous round had a number fails too
-GATED_INVERSE = ("serving_loadgen_p99_ms",)
+GATED_INVERSE = ("serving_loadgen_p99_ms",
+                 # per-scenario batch-1 tail p99s (ISSUE 12): exact
+                 # quantiles from retained samples, stamped by
+                 # bench.py's serving_tail_latency block — steady,
+                 # cold-bucket first hit, evict→restore on the
+                 # request path, breaker half-open probe
+                 "serving_tail_p99_ms",
+                 "serving_tail_cold_bucket_p99_ms",
+                 "serving_tail_evict_restore_p99_ms",
+                 "serving_tail_breaker_probe_p99_ms")
 
 
 def _payload(doc):
@@ -200,27 +230,57 @@ def selftest(threshold=0.10):
     dt_wobble, _ = compare(
         {k: v * 0.95 for k, v in dtype_old.items()},
         dtype_old, threshold)
+    # the batch-1 tail gates (ISSUE 12), proven on a synthetic round:
+    # a fast-path req/s drop, a steady-p99 RISE and a VANISHED
+    # per-scenario tail key must all fail; tail wobble passes
+    tail_old = {"serving_f32_batch1_requests_per_sec": 1000.0,
+                "serving_f32_fast_requests_per_sec": 1000.0,
+                "serving_tail_p99_ms": 2.0,
+                "serving_tail_cold_bucket_p99_ms": 60.0,
+                "serving_tail_evict_restore_p99_ms": 200.0,
+                "serving_tail_breaker_probe_p99_ms": 3.0}
+    tl_drop, _ = compare(
+        dict(tail_old, serving_f32_batch1_requests_per_sec=850.0),
+        tail_old, threshold)
+    tl_p99_up, _ = compare(
+        dict(tail_old, serving_tail_p99_ms=2.0 *
+             (1.0 + 2 * threshold) * 1.5),
+        tail_old, threshold)
+    tail_gone = dict(tail_old)
+    del tail_gone["serving_tail_evict_restore_p99_ms"]
+    tl_gone, _ = compare(tail_gone, tail_old, threshold)
+    tl_wobble, _ = compare(
+        dict(tail_old,
+             serving_f32_batch1_requests_per_sec=1000.0 * 0.95,
+             serving_tail_p99_ms=2.0 * (1.0 + threshold)),
+        tail_old, threshold)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
-            or not srv_wobble or dt_drop or dt_gone or not dt_wobble:
+            or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
+            or tl_drop or tl_p99_up or tl_gone or not tl_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
               "serving_p99_rise_rejected=%s "
               "serving_p99_zero_rejected=%s serving_wobble_passed=%s "
               "dtype_drop_rejected=%s dtype_vanished_rejected=%s "
-              "dtype_wobble_passed=%s"
+              "dtype_wobble_passed=%s tail_batch1_drop_rejected=%s "
+              "tail_p99_rise_rejected=%s tail_vanished_rejected=%s "
+              "tail_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
-                 not dt_gone, dt_wobble))
+                 not dt_gone, dt_wobble, not tl_drop, not tl_p99_up,
+                 not tl_gone, tl_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
           "improvement pass; serving req/s drop, p99 rise and p99 "
           "zero-stamp rejected, serving wobble passes; per-dtype "
           "int8 drop and vanished bf16 key rejected, dtype wobble "
-          "passes (threshold %.0f%%)"
+          "passes; tail batch-1 req/s drop, steady-p99 rise and "
+          "vanished scenario-p99 key rejected, tail wobble passes "
+          "(threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))
     return 0
 
@@ -234,6 +294,23 @@ def main(argv=None):
         del argv[i:i + 2]
     if "--selftest" in argv:
         return selftest(threshold)
+    assert_stamped = None
+    if "--assert-stamped" in argv:
+        i = argv.index("--assert-stamped")
+        assert_stamped = []
+        for k in argv[i + 1].split(","):
+            if k == "tail":
+                # the batch-1 tail schema, derived from the gated
+                # tuples (one source of truth for bench.py stamps,
+                # the round gate and this CI assertion)
+                assert_stamped.append(
+                    "serving_f32_batch1_requests_per_sec")
+                assert_stamped.extend(
+                    key for key in GATED_INVERSE
+                    if key.startswith("serving_tail_"))
+            elif k:
+                assert_stamped.append(k)
+        del argv[i:i + 2]
     old_path = None
     if "--old" in argv:
         i = argv.index("--old")
@@ -251,6 +328,18 @@ def main(argv=None):
     except (OSError, ValueError) as e:
         print("bench_gate: cannot read new run: %s" % e)
         return 2
+    if assert_stamped is not None:
+        missing = [k for k in assert_stamped if not new.get(k)]
+        if missing:
+            print("bench_gate: crash-guard/missing stamps for %s "
+                  "(values: %s) — the tier broke, failing the gate"
+                  % (",".join(missing),
+                     {k: new.get(k) for k in missing}))
+            return 1
+        print("bench_gate: stamped OK: %s"
+              % ", ".join("%s=%s" % (k, new[k])
+                          for k in assert_stamped))
+        return 0
     if old_path:
         with open(old_path) as f:
             old = _payload(json.load(f))

@@ -21,10 +21,21 @@ honestly.
 * **Mixed batch shapes**: request sizes draw log-uniformly over
   ``1..max_batch``, sweeping the engine's whole bucket ladder.
 * **SLO report**: requests per second offered vs achieved, latency
-  p50/p90/p99, and **goodput** — completed-OK responses within
-  ``slo_ms`` (``root.common.serving.slo_ms``) per second.  Under
-  overload goodput is the number that matters: a server answering
-  everything late has throughput but no goodput.
+  p50/p90/p95/p99/p999, and **goodput** — completed-OK responses
+  within ``slo_ms`` (``root.common.serving.slo_ms``) per second.
+  Under overload goodput is the number that matters: a server
+  answering everything late has throughput but no goodput.
+* **Exact quantiles, per model × per bucket**: every completed
+  request's latency is RETAINED and percentiles come from
+  :func:`znicz_tpu.serving.latency.exact_percentile` (sorted order
+  statistics + linear interpolation — never a bucketed
+  approximation).  Besides the global block, the report breaks
+  latency down per model AND per shape bucket — the bucket the
+  request's OWN rows pad into (its nominal bucket; a coalescing
+  batcher may ride some requests through a larger bucket's
+  executable, so read the breakdown as "tail by request size", the
+  client-side view) — so a tail regression on one request class of
+  one model is visible in the artifact, not averaged away.
 
 Two runners share the report:
 
@@ -57,16 +68,42 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 class ModelSpec(object):
     """One routable target: ``name`` (None = the server's default
-    route), per-sample input shape, the largest request to draw, and
-    its share of the traffic mix."""
+    route), per-sample input shape, the largest request to draw, its
+    share of the traffic mix, and the model's shape-bucket ladder
+    (defaults to the engine's power-of-two ladder; ``discover_models``
+    adopts the server's recorded ladder) — the per-bucket latency
+    breakdown attributes each request to the bucket its rows pad
+    into."""
 
-    __slots__ = ("name", "sample_shape", "max_batch", "weight")
+    __slots__ = ("name", "sample_shape", "max_batch", "weight",
+                 "buckets")
 
-    def __init__(self, name, sample_shape, max_batch=8, weight=1.0):
+    def __init__(self, name, sample_shape, max_batch=8, weight=1.0,
+                 buckets=None):
         self.name = name
         self.sample_shape = tuple(int(d) for d in sample_shape)
         self.max_batch = max(1, int(max_batch))
         self.weight = float(weight)
+        if buckets:
+            self.buckets = tuple(sorted(int(b) for b in buckets))
+        else:
+            # the engine's own default ladder rule — never a local
+            # re-implementation that could drift (lazy import keeps
+            # plain CLI startup light)
+            from znicz_tpu.serving.engine import default_buckets
+            self.buckets = default_buckets(self.max_batch)
+
+    def bucket_for(self, rows):
+        """The NOMINAL bucket for a ``rows``-row request — the
+        smallest ladder entry >= rows, i.e. what the request pads
+        into when dispatched alone (a coalescing batcher may ride it
+        through a larger bucket).  Over-ladder rows clamp to the top
+        bucket — the engine would have 400'd those, and they carry an
+        error status anyway."""
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
 
 
 def make_plan(rate_rps, duration_s, seed, models):
@@ -103,9 +140,28 @@ def make_inputs(models, seed):
 
 
 def _percentile(values, q):
-    if not values:
-        return None
-    return float(numpy.percentile(numpy.asarray(values), q))
+    """Exact quantile from the retained samples — ONE formula for the
+    whole latency stack (znicz_tpu/serving/latency.py; unit-tested
+    there down to n=1 and ties).  Imported lazily so the module stays
+    importable before znicz_tpu's heavier imports are wanted."""
+    from znicz_tpu.serving.latency import exact_percentile
+    return exact_percentile(values, q)
+
+
+def _pct_block(lat_s):
+    """The per-series latency block: exact p50/p90/p95/p99/p999/max in
+    ms over retained OK latencies (all None when the series is
+    empty)."""
+    # one real sort per series; exact_percentile's own sorted() is
+    # O(n) on already-sorted input
+    lat_s = sorted(lat_s)
+    out = {}
+    for label, q in (("p50", 50), ("p90", 90), ("p95", 95),
+                     ("p99", 99), ("p999", 99.9)):
+        v = _percentile(lat_s, q)
+        out[label] = round(v * 1e3, 3) if v is not None else None
+    out["max"] = round(max(lat_s) * 1e3, 3) if lat_s else None
+    return out
 
 
 def _classify(exc):
@@ -206,15 +262,31 @@ def report(records, scheduled, duration_s, slo_ms, seed, models,
     for i, m in enumerate(models):
         mine = [r for r in records if r[0] == i]
         m_ok = [r[2] for r in mine if r[3] == 200]
-        per_model[m.name or "<default>"] = {
+        m_pct = _pct_block(m_ok)
+        per_bucket = {}
+        for r in mine:
+            if r[3] != 200:
+                continue
+            per_bucket.setdefault(m.bucket_for(r[1]), []).append(r[2])
+        block = {
             "requests": len(mine),
             "ok": len(m_ok),
             "rows": int(sum(r[1] for r in mine)),
-            "p50_ms": (round(_percentile(m_ok, 50) * 1e3, 3)
-                       if m_ok else None),
-            "p99_ms": (round(_percentile(m_ok, 99) * 1e3, 3)
-                       if m_ok else None),
+            # flat keys kept for existing consumers; the full exact-
+            # quantile block sits under "latency_ms"
+            "p50_ms": m_pct["p50"],
+            "p99_ms": m_pct["p99"],
+            "latency_ms": m_pct,
+            # per NOMINAL shape bucket (what the request's own rows
+            # pad into; coalescing may dispatch some through a larger
+            # bucket — this is the client-side "tail by request size"
+            # view): a p99 regression on one request class can no
+            # longer hide in the model-wide aggregate
+            "per_bucket": {
+                str(b): dict(_pct_block(lats), count=len(lats))
+                for b, lats in sorted(per_bucket.items())},
         }
+        per_model[m.name or "<default>"] = block
     out = {
         "seed": int(seed),
         "duration_s": round(float(duration_s), 3),
@@ -231,15 +303,7 @@ def report(records, scheduled, duration_s, slo_ms, seed, models,
         "goodput_rps": round(good / duration_s, 2),
         "goodput_pct": (round(100.0 * good / scheduled, 2)
                         if scheduled else None),
-        "latency_ms": {
-            "p50": (round(_percentile(ok_lat, 50) * 1e3, 3)
-                    if ok_lat else None),
-            "p90": (round(_percentile(ok_lat, 90) * 1e3, 3)
-                    if ok_lat else None),
-            "p99": (round(_percentile(ok_lat, 99) * 1e3, 3)
-                    if ok_lat else None),
-            "max": (round(max(ok_lat) * 1e3, 3) if ok_lat else None),
-        },
+        "latency_ms": _pct_block(ok_lat),
         "rows_ok": int(sum(r[1] for r in records if r[3] == 200)),
         "dispatch_behind_max_ms": round(
             dispatch_behind_max_s * 1e3, 3),
@@ -298,7 +362,7 @@ def discover_models(base_url, timeout=10.0):
         buckets = stats.get("buckets") or [8]
         specs.append(ModelSpec(
             None if name == "default" else name, shape,
-            max_batch=int(buckets[-1])))
+            max_batch=int(buckets[-1]), buckets=buckets))
     if not specs:
         raise SystemExit(
             "loadgen: %s/models reports no servable model with a "

@@ -1,4 +1,4 @@
-"""CI smoke: the serving tier end to end, in three acts.
+"""CI smoke: the serving tier end to end, in four acts.
 
 **Act 1 — single engine (the PR 2 contract):** train a tiny wine
 model, snapshot it, bring up the HTTP front end, fire 64 CONCURRENT
@@ -36,6 +36,20 @@ concurrent traffic:
   footprint,
 * the ``tools/accuracy_delta.py`` CLI holds its tolerance assertion
   against the same snapshot.
+
+**Act 4 — the batch-1 latency fast path (ISSUE 12):** the SAME wine
+snapshot served strict (f32) and fast (f32-fast) behind one registry:
+
+* batch-1 replies from the fast engine match the strict engine's
+  within the documented ``f32_fast`` pin for identical inputs (they
+  are bit-identical on the CPU backend today — the smoke prints the
+  observed identity),
+* the fast and strict engines' compile keys are DISTINCT (the fast
+  mode never silently aliases strict-f32 executables, in-process or
+  in the persistent cache),
+* ZERO recompiles across the batch-1 storm after warmup,
+* the fast engine's series carry the ``dtype_f32_fast`` label on
+  /metrics while strict f32 keeps its unlabeled names.
 
 Run by ``tools/ci.sh`` (fast lane).  Exit code 0 = pass.
 """
@@ -161,6 +175,7 @@ def main():
         server.stop()
     registry_smoke(tmp, snapshot)
     precision_smoke(snapshot)
+    latency_smoke(snapshot)
 
 
 def _second_model_package(tmp):
@@ -382,6 +397,86 @@ def precision_smoke(snapshot):
               % (N_REQUESTS, f32_bytes, int8_bytes, worst, tol,
                  report["dtypes"]["bf16"]["max_delta"],
                  report["dtypes"]["int8"]["max_delta"]))
+    finally:
+        server.stop()
+
+
+def latency_smoke(snapshot):
+    """Act 4: one model, strict f32 vs the f32-fast batch-1 path
+    (ISSUE 12)."""
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+    from znicz_tpu.serving.accuracy import TOLERANCES
+
+    telemetry.reset()
+    registry = ModelRegistry(max_batch=MAX_BATCH)
+    registry.add("wine_strict", snapshot)
+    registry.add("wine_fast", snapshot, dtype="f32-fast")
+    assert registry.peek("wine_strict").serve_dtype == "f32"
+    assert registry.peek("wine_fast").serve_dtype == "f32_fast"
+    # the fast mode must NEVER alias strict executables: its compile
+    # key (serving dtype + latency_bucket_max + topology) differs
+    k_strict = registry.peek("wine_strict").compile_key
+    k_fast = registry.peek("wine_fast").compile_key
+    assert k_strict and k_fast and k_strict != k_fast, \
+        "fast/strict compile keys must be distinct"
+
+    server = ServingServer(registry=registry).start()
+    url = "http://127.0.0.1:%d" % server.port
+    compiles0 = telemetry.counter("jax.backend_compiles").value
+    replies, errors = {}, []
+
+    def client(seed):
+        try:
+            r = numpy.random.RandomState(2000 + seed // 2)
+            x = r.uniform(-1, 1, (1, 13))  # the batch-1 bucket
+            model = ("wine_strict", "wine_fast")[seed % 2]
+            req = urllib.request.Request(
+                url + "/predict/" + model,
+                json.dumps({"inputs": x.tolist()}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                doc = json.loads(resp.read())
+            assert doc["model"] == model
+            replies[seed] = numpy.asarray(doc["outputs"])
+        except Exception as e:  # noqa: BLE001 - asserted below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_REQUESTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errors, "request failures: %s" % errors[:5]
+        assert len(replies) == N_REQUESTS
+        tol = TOLERANCES["f32_fast"]["max_delta"]
+        worst = 0.0
+        identical = True
+        for seed in range(0, N_REQUESTS, 2):
+            delta = float(numpy.abs(replies[seed]
+                                    - replies[seed + 1]).max())
+            worst = max(worst, delta)
+            identical = identical and delta == 0.0
+        assert worst <= tol, \
+            "f32-fast delta %.4g over the %.4g pin" % (worst, tol)
+        recompiles = telemetry.counter(
+            "jax.backend_compiles").value - compiles0
+        assert recompiles == 0, \
+            "%d recompiles across the batch-1 storm" % recompiles
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert "dtype_f32_fast" in metrics and \
+            "model_wine_fast" in metrics, \
+            "f32-fast dtype/model labels missing from /metrics"
+        assert "model_wine_strict" in metrics, \
+            "strict model labels missing from /metrics"
+        print("latency smoke OK: %d batch-1 requests, strict vs "
+              "f32-fast worst delta %.2g (pin %.2g, bit-identical=%s)"
+              ", 0 recompiles, compile keys distinct, dtype_f32_fast "
+              "labels present"
+              % (N_REQUESTS, worst, tol, identical))
     finally:
         server.stop()
 

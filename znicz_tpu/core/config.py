@@ -193,9 +193,17 @@ root.common.update({
         "timeout_ms": 1000.0,   # per-request deadline in the queue
         "warmup": True,         # compile every bucket before ready
         # default serving precision recorded in export warmup
-        # manifests ("f32" | "bf16" | "int8"); engines without an
-        # explicit dtype= adopt the source manifest's value
+        # manifests ("f32" | "f32-fast" | "bf16" | "int8"); engines
+        # without an explicit dtype= adopt the source manifest's value
         "dtype": "f32",
+        # batch-1 latency fast path (serving dtype "f32-fast"): shape
+        # buckets up to this size dispatch the restructured forward —
+        # the contraction runs as a STANDALONE dot (kept out of the
+        # bias/activation fusion by an optimization barrier) over the
+        # dot-native weight layout, which keeps XLA's low-batch dot on
+        # its fast path.  Read at engine LOAD time (part of the
+        # compile key); larger buckets keep the fused-epilogue path.
+        "latency_bucket_max": 8,
         "slow_request_ms": 1000.0,  # log requests slower than this
         # graceful degradation (serving/breaker.py + HandlerBase):
         "breaker_threshold": 5,     # consecutive dispatch failures

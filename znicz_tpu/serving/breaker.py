@@ -151,9 +151,13 @@ class CircuitBreaker(object):
         if prev == state:
             return
         if telemetry.enabled():
+            # label key "breaker", not "name" — labeled()'s first
+            # positional parameter is itself called ``name``, so a
+            # name= label kwarg collides and raises the moment a
+            # breaker transitions with telemetry enabled
             telemetry.gauge(telemetry.labeled(
                 "serving.breaker_open",
-                name=self.name)).set(0 if state == CLOSED else 1)
+                breaker=self.name)).set(0 if state == CLOSED else 1)
         telemetry.record_event("serving.breaker", name=self.name,
                                state=state, previous=prev,
                                failures=self._failures)

@@ -20,11 +20,17 @@ executable — zero recompiles across model version bumps.
 
 **Precision modes.** Serving precision is a first-class, measured
 axis (``dtype=`` / ``serve --dtype`` / the source's recorded warmup
-manifest): ``f32`` is bit-identical to the training forward, ``bf16``
-casts params once at load and runs activations in bfloat16 (f32
-replies), ``int8`` serves per-output-channel symmetrically quantized
-weights with the dequant folded into the executable — 4x fewer weight
-bytes per dispatch (:mod:`znicz_tpu.serving.quant`).  The dtype joins
+manifest): ``f32`` is bit-identical to the training forward,
+``f32-fast`` serves the same f32 bits through the batch-1 LATENCY
+fast path (dot-native weight layout + standalone-dot epilogue for
+buckets up to ``root.common.serving.latency_bucket_max`` — see
+:func:`_apply_fast_layer`; measured ~15x batch-1 req/s over strict
+f32 on the CPU backend, replies within a tight documented pin),
+``bf16`` casts params once at load and runs activations in bfloat16
+(f32 replies), ``int8`` serves per-output-channel symmetrically
+quantized weights with the dequant folded into the executable — 4x
+fewer weight bytes per dispatch (:mod:`znicz_tpu.serving.quant`).
+The dtype joins
 the compile-cache key, the per-dtype cost-registry entries and the
 ``dtype_<mode>`` telemetry labels; accuracy deltas per bucket are
 measured and pinned by :mod:`znicz_tpu.serving.accuracy`.
@@ -142,6 +148,44 @@ def _apply_quantized_layer(entry, params, y):
         "quantized serving: unsupported layer type %r" % tpe)
 
 
+def _apply_fast_layer(entry, params, y):
+    """One FC layer on the batch-1 LATENCY fast path (serving dtype
+    ``f32-fast``, buckets <= ``root.common.serving.latency_bucket_max``):
+    the contraction runs as a STANDALONE dot — an optimization
+    barrier between the dot and the bias/activation epilogue stops
+    XLA from output-fusing them, which on the CPU backend would turn
+    the small-batch dot into a naive loop instead of the GEMV/GEMM
+    runtime call.  The weights already sit in the dot-native layout
+    (:func:`znicz_tpu.serving.quant.convert_host_params`), so the
+    program carries no weight transpose either.  The barrier is the
+    identity on values — the dot, the bias add and the activation
+    compute exactly what the fused epilogue computes, in the same
+    order.  Non-FC layers (conv/pool/norm/standalone activations)
+    keep the standard path."""
+    import jax
+    from znicz_tpu.ops import activations, dense
+
+    tpe = entry["type"]
+    if not (tpe == "softmax" or tpe.startswith("all2all")) or \
+            "weights_q8" in params:
+        return _apply_layer(entry, params, y)
+    b = params.get("bias")
+    include_bias = bool(entry.get("include_bias", True)) and \
+        b is not None
+    y = y.reshape(y.shape[0], -1)
+    z = dense.forward_jax(
+        y, params["weights"], None, activation="linear",
+        weights_transposed=bool(entry.get("weights_transposed")),
+        include_bias=False)
+    z = jax.lax.optimization_barrier(z)
+    if include_bias:
+        z = z + b
+    if tpe == "softmax":
+        z, _ = dense.softmax_jax(z)
+        return z
+    return activations.apply_jax(_FC_ACT[tpe], z)
+
+
 def _apply_layer(entry, params, y):
     """One manifest layer as a pure jax computation (the jax twin of
     ``export.run_package_numpy`` — same layer scope, same semantics).
@@ -245,11 +289,12 @@ class _Model(object):
 
     __slots__ = ("layers", "params", "fn", "key", "dtype",
                  "sample_shape", "source", "version", "warm",
-                 "host_params", "dev_bytes", "serve_dtype")
+                 "host_params", "dev_bytes", "serve_dtype",
+                 "fast_max")
 
     def __init__(self, layers, params, fn, key, dtype, sample_shape,
                  source, version, warm, host_params=None,
-                 serve_dtype="f32"):
+                 serve_dtype="f32", fast_max=0):
         self.layers = layers
         self.params = params
         self.fn = fn
@@ -260,9 +305,15 @@ class _Model(object):
         self.version = version
         self.warm = warm
         self.host_params = host_params
-        #: the serving precision mode ("f32" | "bf16" | "int8") this
-        #: generation's params are stored in — fixed per load
+        #: the serving precision mode ("f32" | "f32_fast" | "bf16" |
+        #: "int8") this generation's params are stored in — fixed per
+        #: load
         self.serve_dtype = serve_dtype
+        #: f32-fast only: the largest bucket dispatching the
+        #: standalone-dot fast variant (the latency_bucket_max knob
+        #: captured at load — it shapes the traced program, so it
+        #: lives on the generation and in the compile key)
+        self.fast_max = int(fast_max)
         #: resident param footprint, computed ONCE — the registry's
         #: budget sweep reads this per request and must not walk the
         #: whole pytree each time (sizes never change for a generation)
@@ -270,7 +321,7 @@ class _Model(object):
             int(v.nbytes) for p in (params or []) for v in p.values())
 
 
-def _build_forward(layers, serve_dtype="f32"):
+def _build_forward(layers, serve_dtype="f32", fast_max=0):
     """Compose the layer chain into one jitted ``forward(params, x)``.
 
     ``layers`` is static (closed over); ``params`` is a pytree argument
@@ -280,6 +331,13 @@ def _build_forward(layers, serve_dtype="f32"):
     (:mod:`znicz_tpu.serving.quant`):
 
     * ``"f32"`` — the historical bit-identical path (identical jaxpr).
+    * ``"f32_fast"`` — the batch-1 latency path: shape buckets up to
+      ``fast_max`` (the ``latency_bucket_max`` knob captured at load)
+      trace the standalone-dot variant (:func:`_apply_fast_layer`) —
+      the batch size is static at trace time, so each bucket's
+      executable picks its variant at COMPILE time and the dispatch
+      path is branch-free.  Larger buckets keep the standard
+      fused-epilogue program over the same dot-native weight layout.
     * ``"bf16"`` — activations run in bfloat16 end to end (params
       arrive pre-cast), outputs cast back to f32 at the jit boundary.
     * ``"int8"`` — quantized layers carry ``weights_q8`` (int8) +
@@ -292,11 +350,16 @@ def _build_forward(layers, serve_dtype="f32"):
     import jax
     import jax.numpy as jnp
     out_f32 = serve_dtype == "bf16"
+    fast_mode = serve_dtype == "f32_fast"
+    fast_max = int(fast_max)
 
     def forward(params, x):
+        apply_one = (_apply_fast_layer
+                     if fast_mode and x.shape[0] <= fast_max
+                     else _apply_layer)
         y = x
         for entry, p in zip(layers, params):
-            y = _apply_layer(entry, p, y)
+            y = apply_one(entry, p, y)
         if out_f32:
             # bf16 serves float32 replies — clients never see bf16
             y = y.astype(jnp.float32)
@@ -316,12 +379,14 @@ class InferenceEngine(Logger):
     source does not record one (old packages).
 
     ``dtype`` pins the serving precision mode — ``"f32"`` (default,
-    bit-identical), ``"bf16"`` (params + activations bfloat16, f32
-    replies) or ``"int8"`` (per-output-channel quantized weights with
-    the dequant folded into the executable) — see
-    :mod:`znicz_tpu.serving.quant`.  ``None`` follows the source's
-    recorded warmup manifest (``serving.dtype``), falling back to f32.
-    Unknown strings raise immediately.
+    bit-identical), ``"f32-fast"`` (same f32 bits, batch-1 latency
+    fast path — its own compile key + accuracy pin), ``"bf16"``
+    (params + activations bfloat16, f32 replies) or ``"int8"``
+    (per-output-channel quantized weights with the dequant folded
+    into the executable) — see :mod:`znicz_tpu.serving.quant`.
+    ``None`` follows the source's recorded warmup manifest
+    (``serving.dtype``), falling back to f32.  Unknown strings raise
+    immediately.
     """
 
     def __init__(self, source=None, max_batch=None, buckets=None,
@@ -404,14 +469,25 @@ class InferenceEngine(Logger):
 
     @property
     def serve_dtype(self):
-        """The serving precision mode ("f32" | "bf16" | "int8") — the
-        dtype axis of the compile-cache key, the warmup manifest, the
-        per-dtype cost-registry entries and the continuous batcher's
-        dispatch lanes."""
+        """The serving precision mode ("f32" | "f32_fast" | "bf16" |
+        "int8") — the dtype axis of the compile-cache key, the warmup
+        manifest, the per-dtype cost-registry entries and the
+        continuous batcher's dispatch lanes."""
         m = self._model
         if m is not None:
             return m.serve_dtype
         return self._dtype_pin or "f32"
+
+    @property
+    def compile_key(self):
+        """The loaded generation's compile-cache key (None before a
+        load): serving dtype + f32-fast bucket ceiling + topology +
+        array shapes/dtypes.  Exposed so tests and the serving smoke
+        can PROVE two engine modes never alias executables (the
+        fast/strict distinctness pin) without reaching into model
+        internals."""
+        m = self._model
+        return m.key if m is not None else None
 
     @property
     def warm_buckets(self):
@@ -470,6 +546,10 @@ class InferenceEngine(Logger):
         }
         if self.name is not None:
             payload["model"] = self.name
+        if m is not None and m.serve_dtype == "f32_fast":
+            # the fast-variant ceiling this generation compiled with
+            # (the /models truth for the latency_bucket_max knob)
+            payload["latency_bucket_max"] = m.fast_max
         if self._warmup_manifest is not None:
             payload["warmup_manifest"] = self._warmup_manifest
         if self._breakers:
@@ -513,6 +593,13 @@ class InferenceEngine(Logger):
         # behaves like a topology change (the key below diverges).
         serve_dtype = self._dtype_pin or quant.normalize_dtype(
             (serving_mf or {}).get("dtype"))
+        # f32-fast: the fast-variant bucket ceiling shapes each
+        # bucket's traced program, so it is captured per load (live
+        # config read — a reload adopts a changed knob) and joins the
+        # compile key below
+        fast_max = (int(root.common.serving.get(
+            "latency_bucket_max", 8)) if serve_dtype == "f32_fast"
+            else 0)
         # convert the HOST copies: quantized/cast arrays are what gets
         # uploaded, what evict keeps, and what restore re-uploads — an
         # int8 model's restore moves int8 bytes, not the f32 originals
@@ -529,11 +616,14 @@ class InferenceEngine(Logger):
         else:
             shape = src_shape or self._sample_shape_override or \
                 _derived_sample_shape(layers, params)
-        # the compile-cache key: serving dtype + topology + array
-        # shapes/dtypes — any difference means the old executables
-        # cannot be reused
+        # the compile-cache key: serving dtype (+ the f32-fast bucket
+        # ceiling) + topology + array shapes/dtypes — any difference
+        # means the old executables cannot be reused.  The fast mode
+        # NEVER aliases strict-f32 executables: serve_dtype differs,
+        # and two fast loads under different latency_bucket_max
+        # values differ too.
         key = json.dumps(
-            [serve_dtype, layers,
+            [serve_dtype, fast_max, layers,
              [{a: [str(v.dtype)] + list(v.shape)
                for a, v in p.items()} for p in params]],
             sort_keys=True, default=str)
@@ -569,13 +659,15 @@ class InferenceEngine(Logger):
                 # warm-bucket set carry over to the new generation
                 fn, warm = old.fn, old.warm
             else:
-                fn, warm = _build_forward(layers, serve_dtype), set()
+                fn = _build_forward(layers, serve_dtype, fast_max)
+                warm = set()
                 self._ready.clear()
             self._version += 1
             model = _Model(layers, params, fn, key, dtype, shape,
                            label, self._version, warm,
                            host_params=host_params,
-                           serve_dtype=serve_dtype)
+                           serve_dtype=serve_dtype,
+                           fast_max=fast_max)
             self._model = model
             if telemetry.enabled():
                 telemetry.gauge(self._label(
@@ -969,7 +1061,7 @@ class InferenceEngine(Logger):
             # weights + scales), so a low-precision model's restore
             # re-uploads the small representation, never f32 originals
             m.params = jax.device_put(m.host_params)
-            m.fn = _build_forward(m.layers, m.serve_dtype)
+            m.fn = _build_forward(m.layers, m.serve_dtype, m.fast_max)
             m.warm.clear()
         self._ledger_swap(0, self.device_bytes)
         event = {"version": self._version,

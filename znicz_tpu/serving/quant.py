@@ -3,11 +3,27 @@ inference path the paper ships as libZnicz (PAPER.md §0): training
 wants f32 master params and reproducible gradients, serving wants the
 fewest bytes per prediction the accuracy budget allows.
 
-Three serving dtypes (:data:`DTYPES`), selected per engine
+Four serving dtypes (:data:`DTYPES`), selected per engine
 (``InferenceEngine(dtype=...)`` / per-model registry kwarg /
 ``serve ... --dtype`` / the source's recorded warmup manifest):
 
 * ``f32`` — today's path, bit-identical to the training forward.
+* ``f32-fast`` — the batch-1 LATENCY path: the same f32 bits, but FC
+  weights are stored once in the **dot-native layout** (the layout
+  whose contraction needs NO transpose op in the compiled program —
+  ``(in, out)`` for the ``x @ W`` convention) and the engine's
+  low-batch buckets run the contraction as a standalone dot with the
+  bias/activation epilogue kept OUT of it.  XLA-CPU's small-batch
+  lowering of ``x @ W.T`` materializes a full transposed COPY of
+  every weight matrix per dispatch and output-fuses the bias add into
+  the dot (a naive loop instead of the GEMV runtime call) — measured
+  ~18x slower at batch 1 on the memory-bound bench model.  Replies
+  are bit-identical to strict f32 on the CPU backend today (the
+  pre-transposed host bytes are exactly what XLA's per-dispatch
+  transpose copy produced), but the mode is shipped EXPLICIT — its
+  own compile-cache key, its own (tight) accuracy pin in
+  :mod:`znicz_tpu.serving.accuracy` — because operand-layout
+  bit-stability is an empirical property of a backend, not a contract.
 * ``bf16`` — params cast ONCE at load/restore to ``bfloat16`` (host
   copies kept in bf16 too, so evict→restore re-uploads half the
   bytes), activations bf16, outputs cast back to f32 at the jit
@@ -35,11 +51,13 @@ per request.
 import numpy
 
 #: the serving dtype axis, in documentation order
-DTYPES = ("f32", "bf16", "int8")
+DTYPES = ("f32", "f32_fast", "bf16", "int8")
 
 #: accepted spellings (config files, CLI flags, manifests)
 _ALIASES = {
     "f32": "f32", "float32": "f32", "float": "f32",
+    "f32-fast": "f32_fast", "f32_fast": "f32_fast",
+    "f32fast": "f32_fast", "fast32": "f32_fast",
     "bf16": "bf16", "bfloat16": "bf16",
     "int8": "int8", "i8": "int8",
 }
@@ -132,6 +150,17 @@ def convert_host_params(layers, host_params, dtype):
       arrays AND the stored layout are never touched), minus any
       export-time quant sidecar arrays (an f32 engine must not upload
       int8 arrays it never reads).
+    * ``f32-fast`` — the same f32 VALUES, re-laid into the dot-native
+      layout (see below): FC weights stored ``(out, in)`` transpose
+      ONCE to ``(in, out)`` with the entry's ``weights_transposed``
+      flag SET (the forward then contracts ``x @ W`` with no
+      transpose op in the program); conv weights stored transposed
+      transpose to the direct layout with the flag CLEARED.  Each
+      transposed host array holds exactly the bytes XLA's
+      per-dispatch transpose copy used to materialize, so the
+      contraction consumes identical operands — replies hold the
+      (tight) ``f32_fast`` accuracy pin, bit-identical on the CPU
+      backend today.  Sidecar quant arrays drop like f32.
     * ``bf16`` — every floating array cast to bfloat16.
     * ``int8`` — for each quantizable layer, ``weights`` is replaced
       by ``weights_q8`` (int8) + ``weights_scale`` (f32, broadcast
@@ -195,14 +224,34 @@ def convert_host_params(layers, host_params, dtype):
             del p["weights"]
             p["weights_q8"] = q
             p["weights_scale"] = scale
+        elif dtype == "f32_fast" and quantizable(entry) and \
+                p.get("weights") is not None:
+            # dot-native layout, values untouched: the goal is a
+            # compiled program with NO transpose op feeding the
+            # contraction.  FC forwards compute x @ W when the entry
+            # is flagged transposed — so (out, in) storage flips to
+            # (in, out) and the flag SETS; conv forwards transpose
+            # flagged weights in-program — so those flip back and the
+            # flag CLEARS.
+            tpe = entry.get("type", "")
+            if tpe.startswith("conv"):
+                if entry.get("weights_transposed"):
+                    p = dict(p, weights=numpy.ascontiguousarray(
+                        p["weights"].T))
+                    entry["weights_transposed"] = False
+            elif not entry.get("weights_transposed"):
+                p = dict(p, weights=numpy.ascontiguousarray(
+                    p["weights"].T))
+                entry["weights_transposed"] = True
         out.append(p)
     return out
 
 
 def input_dtype(dtype, base_dtype):
     """The dtype request bodies parse into / activations enter as:
-    bf16 engines take bf16 activations; f32 and int8 engines keep the
-    model's base floating dtype (int8 quantizes WEIGHTS only)."""
+    bf16 engines take bf16 activations; f32, f32-fast and int8
+    engines keep the model's base floating dtype (f32-fast only
+    re-lays weights; int8 quantizes WEIGHTS only)."""
     if normalize_dtype(dtype) == "bf16":
         return bfloat16_dtype()
     return base_dtype
