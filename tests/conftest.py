@@ -83,3 +83,37 @@ def _engine_flags_isolated():
     if compile_cache.enabled():
         compile_cache.disable()
 
+
+#: test modules whose CONCURRENT serving traffic runs under the armed
+#: lock-order sanitizer (ISSUE 13) — registry storms, continuous-
+#: batcher floods, breaker half-open races.  The teardown asserts the
+#: run recorded zero lock-order cycles and zero blocking-under-lock,
+#: then restores the gate.
+_LOCKSMITH_ARMED_MODULES = (
+    "test_model_registry",
+    "test_continuous_batcher",
+    "test_serving_resilience",
+)
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_sanitizer(request):
+    name = request.module.__name__.rsplit(".", 1)[-1]
+    if name not in _LOCKSMITH_ARMED_MODULES:
+        yield
+        return
+    from znicz_tpu.analysis import locksmith
+    locksmith.reset()
+    locksmith.arm()
+    try:
+        yield
+    finally:
+        locksmith.disarm()
+    try:
+        # raises LockOrderViolation (with both stacks per violation)
+        # if the test's threads ever acquired locks in a cyclic order
+        # or blocked while holding one
+        locksmith.assert_clean()
+    finally:
+        locksmith.reset()
+

@@ -1,0 +1,644 @@
+"""graftlint (ISSUE 13): the static project-invariant checkers, the
+runtime lock-order sanitizer, and pinning tests for the real findings
+the first scan surfaced (the undeclared ``interactive`` /
+``precision_dtype`` knobs and the engine manifest-ladder adoption
+racing the load lock)."""
+
+import sys
+import threading
+
+import numpy
+import pytest
+
+from znicz_tpu.analysis import graftlint, locksmith
+from znicz_tpu.core import config
+from znicz_tpu.core.config import root
+
+VOCAB = graftlint.load_vocabulary()
+
+
+def _check(src, rel="znicz_tpu/fixture_mod.py"):
+    return graftlint.check_source(src, rel, vocab=VOCAB)
+
+
+def _ids(findings):
+    return sorted(set(f.check for f in findings))
+
+
+# ---------------------------------------------------------------------------
+# The fixture pairs: every checker rejects its seeded violation (right
+# id + line) and passes its clean twin — the same proof --selftest runs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("check", sorted(graftlint.FIXTURES))
+def test_fixture_pair(check):
+    fx = graftlint.FIXTURES[check]
+    bad = graftlint.check_source(fx["bad"], fx["rel"], vocab=VOCAB)
+    hits = [f for f in bad if f.check == check]
+    assert hits, "seeded %s violation not rejected: %s" % (
+        check, [str(f) for f in bad])
+    if check != "syntax":
+        expected = next(i for i, line in
+                        enumerate(fx["bad"].splitlines(), 1)
+                        if "seeded" in line)
+        assert any(f.line == expected for f in hits), \
+            "expected line %d, got %s" % (
+                expected, sorted(f.line for f in hits))
+    clean = graftlint.check_source(fx["clean"], fx["rel"],
+                                   vocab=VOCAB)
+    assert clean == [], [str(f) for f in clean]
+
+
+def test_selftest_passes():
+    assert graftlint.selftest(vocab=VOCAB) == []
+
+
+# ---------------------------------------------------------------------------
+# Checker behavior beyond the fixture pairs
+# ---------------------------------------------------------------------------
+
+def test_knob_checker_resolves_get_keys_and_aliases():
+    src = (
+        "from znicz_tpu.core.config import root\n"
+        "\n"
+        "_cfg = root.common.serving\n"
+        "A = _cfg.get(\"max_batch\", 64)\n"
+        "B = _cfg.get(\"max_bach\", 64)\n"
+        "C = root.common.serving.get(\"slo_ms\", 100.0)\n"
+    )
+    fs = _check(src)
+    assert _ids(fs) == ["knob-vocabulary"]
+    assert [f.line for f in fs] == [5]
+    assert fs[0].token == "common.serving.max_bach"
+
+
+def test_knob_checker_catches_getattr_pattern():
+    """The exact historical bug shape: getattr on the config tree
+    with an undeclared name (auto-vivifies a TRUTHY empty node)."""
+    src = (
+        "from znicz_tpu.core.config import root\n"
+        "\n"
+        "X = bool(getattr(root.common, \"bogus_knob\", False))\n"
+    )
+    fs = _check(src)
+    assert _ids(fs) == ["knob-vocabulary"]
+    assert fs[0].token == "common.bogus_knob"
+
+
+def test_knob_checker_allows_dict_knob_payload():
+    src = (
+        "from znicz_tpu.core.config import root\n"
+        "\n"
+        "R = root.common.faults.rules.my_site\n"
+    )
+    assert _check(src) == []
+
+
+def test_knob_checker_validates_writes():
+    src = (
+        "from znicz_tpu.core.config import root\n"
+        "\n"
+        "root.common.serving.breaker_treshold = 3\n"
+    )
+    fs = _check(src)
+    assert _ids(fs) == ["knob-vocabulary"]
+
+
+def test_knob_pragma_suppresses():
+    src = (
+        "from znicz_tpu.core.config import root\n"
+        "\n"
+        "X = root.common.not_a_knob"
+        "  # graftlint: disable=knob-vocabulary\n"
+    )
+    assert _check(src) == []
+
+
+def test_telemetry_wrapper_call_sites_are_checked():
+    """A naming-wrapper call (engine._label style) used as a metric
+    name has its OWN literal series + label keys validated."""
+    src = (
+        "from znicz_tpu.core import telemetry\n"
+        "\n"
+        "\n"
+        "class E(object):\n"
+        "    def note(self):\n"
+        "        telemetry.counter(\n"
+        "            self._label(\"oops.series\", model=\"m\")).inc()\n"
+    )
+    fs = _check(src)
+    assert _ids(fs) == ["telemetry-series"]
+    assert fs[0].token == "oops.series"
+
+
+def test_telemetry_module_constant_resolves():
+    src = (
+        "from znicz_tpu.core import telemetry\n"
+        "\n"
+        "SERIES = \"serving.tail_seconds\"\n"
+        "\n"
+        "telemetry.histogram(SERIES).observe(1.0)\n"
+    )
+    assert _check(src) == []
+
+
+def test_lock_guard_pragma_marks_method_as_guarded():
+    src = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box(object):\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "\n"
+        "    def _retreat(self):"
+        "  # graftlint: guarded-by(self._lock)\n"
+        "        self.n -= 1\n"
+    )
+    assert _check(src) == []
+
+
+def test_lock_guard_counts_container_mutation():
+    src = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box(object):\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = {}\n"
+        "\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self.items[k] = v\n"
+        "\n"
+        "    def wipe(self):\n"
+        "        self.items.clear()\n"
+    )
+    fs = _check(src)
+    assert _ids(fs) == ["lock-guard"]
+    assert fs[0].line == 14
+
+
+def test_lock_guard_nested_function_not_considered_under_lock():
+    """A closure defined under ``with self._lock`` runs LATER — its
+    writes must not count as guarded."""
+    src = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box(object):\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "\n"
+        "    def deferred(self):\n"
+        "        with self._lock:\n"
+        "            def later():\n"
+        "                self.n = 0\n"
+        "            return later\n"
+    )
+    fs = _check(src)
+    assert _ids(fs) == ["lock-guard"]
+    assert fs[0].line == 16
+
+
+def test_jax_checker_honors_static_argnames():
+    src = (
+        "import functools\n"
+        "\n"
+        "import jax\n"
+        "\n"
+        "\n"
+        "@functools.partial(jax.jit, static_argnames=(\"k\",))\n"
+        "def step(x, k):\n"
+        "    return x * int(k)\n"
+    )
+    assert _check(src) == []
+
+
+def test_jax_checker_shape_metadata_is_static():
+    src = (
+        "import jax\n"
+        "\n"
+        "\n"
+        "def step(x):\n"
+        "    return x.reshape(int(x.shape[0]), -1)\n"
+        "\n"
+        "\n"
+        "fn = jax.jit(step)\n"
+    )
+    assert _check(src) == []
+
+
+def test_unused_import_doctest_blind_spot_fixed():
+    """The legacy lint.py flagged imports used only inside string
+    constants (docstring doctests); graftlint does not — and still
+    flags the truly dead import."""
+    src = (
+        "'''Doc.\n"
+        "\n"
+        ">>> shutil.which(\"ls\")\n"
+        "'''\n"
+        "import shutil\n"
+        "import os\n"
+    )
+    fs = _check(src)
+    assert [(f.check, f.token) for f in fs] == [("unused-import",
+                                                 "os")]
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = graftlint.Finding("a/b.py", 3, "knob-vocabulary", "m",
+                          token="common.x")
+    path = tmp_path / "baseline.txt"
+    path.write_text("# comment\n%s\nstale :: entry :: here\n"
+                    % f.fingerprint)
+    baseline = graftlint.load_baseline(str(path))
+    kept, suppressed, stale = graftlint.apply_baseline([f], baseline)
+    assert kept == [] and suppressed == [f]
+    assert stale == ["stale :: entry :: here"]
+
+
+def test_repo_is_findings_clean():
+    """THE acceptance pin: the shipped tree has zero findings outside
+    the (currently empty) reviewed baseline."""
+    import os
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    findings = graftlint.run(repo, vocab=VOCAB)
+    baseline = graftlint.load_baseline(
+        os.path.join(repo, "tools", "graftlint_baseline.txt"))
+    kept, _, _ = graftlint.apply_baseline(findings, baseline)
+    assert kept == [], [str(f) for f in kept]
+
+
+# ---------------------------------------------------------------------------
+# Lock-order sanitizer (locksmith)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def armed_locksmith():
+    locksmith.reset()
+    locksmith.arm()
+    yield locksmith
+    locksmith.disarm()
+    locksmith.reset()
+
+
+def test_locksmith_detects_abba_cycle(armed_locksmith):
+    """Two threads acquiring A->B and B->A (sequentially, so nothing
+    really deadlocks) must produce ONE cycle violation carrying both
+    acquisition stacks."""
+    A, B = locksmith.lock("lockA"), locksmith.lock("lockB")
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    def ba():
+        with B:
+            with A:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    rep = locksmith.report()
+    assert len(rep["cycles"]) == 1
+    c = rep["cycles"][0]
+    assert set(c["cycle"]) == {"lockA", "lockB"}
+    # both stacks present and pointing at this test
+    assert "ab" in c["reverse_acquire_stack"] or \
+        "ab" in c["reverse_held_stack"]
+    assert "ba" in c["acquire_stack"]
+    with pytest.raises(locksmith.LockOrderViolation) as ei:
+        locksmith.assert_clean()
+    assert "lock-order cycle" in str(ei.value)
+
+
+def test_locksmith_detects_blocking_under_lock(armed_locksmith):
+    """future.result() while holding a tracked lock is the
+    device-sync-under-the-registry-lock bug class: recorded with the
+    blocked stack AND the held lock's acquisition stack."""
+    import concurrent.futures
+    L = locksmith.lock("serving.registry")
+    fut = concurrent.futures.Future()
+    fut.set_result(42)
+
+    def offender():
+        with L:
+            assert fut.result() == 42
+
+    t = threading.Thread(target=offender)
+    t.start()
+    t.join()
+    rep = locksmith.report()
+    assert len(rep["blocking"]) == 1
+    b = rep["blocking"][0]
+    assert b["blocking"] == "Future.result"
+    assert b["held"] == ["serving.registry"]
+    assert "offender" in b["stack"]
+    assert "offender" in b["held_stacks"]["serving.registry"]
+    with pytest.raises(locksmith.LockOrderViolation):
+        locksmith.assert_clean()
+
+
+def test_locksmith_condition_wait_releases_its_own_lock(
+        armed_locksmith):
+    """wait() releases the condition's lock — waiting while holding
+    ONLY the condition is clean; holding another tracked lock too is
+    blocking-under-lock."""
+    cond = locksmith.condition("serving.continuous")
+    other = locksmith.lock("other")
+
+    def clean_waiter():
+        with cond:
+            cond.wait(timeout=0.02)
+
+    def bad_waiter():
+        with other:
+            with cond:
+                cond.wait(timeout=0.02)
+
+    t = threading.Thread(target=clean_waiter)
+    t.start()
+    t.join()
+    assert locksmith.report()["blocking"] == []
+    t = threading.Thread(target=bad_waiter)
+    t.start()
+    t.join()
+    rep = locksmith.report()
+    assert len(rep["blocking"]) == 1
+    assert rep["blocking"][0]["held"] == ["other"]
+
+
+def test_locksmith_rlock_reentry_and_consistent_order_clean(
+        armed_locksmith):
+    R = locksmith.rlock("serving.registry")
+    L = locksmith.lock("serving.engine.load")
+
+    def worker():
+        with R:
+            with R:          # re-entry: no self-cycle
+                with L:      # consistent order: edge only
+                    pass
+
+    for _ in range(2):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    rep = locksmith.report()
+    assert rep["cycles"] == [] and rep["blocking"] == []
+    assert rep["edges"] == {
+        "serving.registry -> serving.engine.load": 2}
+    assert locksmith.assert_clean()["enabled"]
+
+
+def test_locksmith_plain_lock_reacquire_is_self_deadlock(
+        armed_locksmith):
+    L = locksmith.lock("oops")
+    state = {}
+
+    def offender():
+        L.acquire()
+        try:
+            # a second blocking acquire would hang — record what a
+            # non-blocking re-acquire of a PLAIN lock looks like
+            state["ok"] = L.acquire(False)
+        finally:
+            if state.get("ok"):
+                L.release()
+            L.release()
+
+    t = threading.Thread(target=offender)
+    t.start()
+    t.join()
+    rep = locksmith.report()
+    assert len(rep["cycles"]) == 1
+    assert rep["cycles"][0]["cycle"] == ["oops", "oops"]
+
+
+def test_locksmith_disabled_is_one_predicate(monkeypatch):
+    """Zero-overhead-off pin (health.py discipline): with the gate
+    off, the factories never construct a tracked wrapper — proven by
+    booby-trapping the wrapper class."""
+    assert not locksmith.enabled()
+
+    def boom(*a, **k):
+        raise AssertionError("tracked wrapper built while disabled")
+
+    monkeypatch.setattr(locksmith, "_TrackedLock", boom)
+    monkeypatch.setattr(locksmith, "_TrackedCondition", boom)
+    lk = locksmith.lock("x")
+    assert isinstance(lk, type(threading.Lock()))
+    locksmith.rlock("x")
+    locksmith.condition("x")
+    # ... and the serving stack constructs clean threaded objects
+    from znicz_tpu.serving.breaker import CircuitBreaker
+    from znicz_tpu.serving.continuous import ContinuousBatcher
+    b = CircuitBreaker("bucket.1")
+    assert b.allow() is False
+    cb = ContinuousBatcher(lambda x: x)
+    assert cb.queued_rows == 0
+
+
+def test_locksmith_arm_retrowraps_module_locks():
+    """Module-level locks are created at import — always before any
+    arm() — so arm() wraps them IN PLACE (around the existing inner
+    lock, keeping mutual exclusion with any current holder) and
+    disarm() restores the originals.  Without this, a cycle through
+    telemetry's registry lock would be invisible to the sanitizer."""
+    from znicz_tpu.core import telemetry
+    orig = telemetry._lock
+    assert not isinstance(orig, locksmith._TrackedLock)
+    locksmith.arm()
+    try:
+        assert isinstance(telemetry._lock, locksmith._TrackedLock)
+        assert telemetry._lock._inner is orig
+        assert telemetry._lock.role == "telemetry.registry"
+        with telemetry._lock:
+            pass
+    finally:
+        locksmith.disarm()
+        locksmith.reset()
+    assert telemetry._lock is orig
+
+
+def test_unused_import_prose_word_does_not_suppress():
+    """A bare prose word in a docstring must not grandfather a dead
+    import — only dotted usage or a doctest line counts."""
+    src = (
+        "'''This value is baked in at trace time.'''\n"
+        "import time\n"
+    )
+    fs = _check(src)
+    assert [(f.check, f.token) for f in fs] == [("unused-import",
+                                                 "time")]
+
+
+def test_declare_empty_dict_is_open_knob():
+    """declare(path, {}) at any level registers an OPEN dict knob
+    (payload reads under it are legal) — same semantics as a nested
+    empty dict like common.faults.rules."""
+    try:
+        config.declare("common.scratch_open.rules", {})
+        assert config.knob_declared("common.scratch_open.rules")
+        assert config.knob_declared("common.scratch_open.rules.site_x")
+    finally:
+        root.common.__dict__.pop("scratch_open", None)
+
+
+def test_locksmith_wrapper_api_parity(armed_locksmith):
+    """The tracked wrappers expose exactly the inner primitive's API:
+    Lock.locked() works; RLock/Condition have no locked() on this
+    Python, so the wrapper must not invent one."""
+    L = locksmith.lock("parity.lock")
+    assert L.locked() is False
+    with L:
+        assert L.locked() is True
+    R = locksmith.rlock("parity.rlock")
+    C = locksmith.condition("parity.cond")
+    for wrapper, plain in ((R, threading.RLock()),
+                           (C, threading.Condition())):
+        assert hasattr(wrapper, "locked") == hasattr(plain, "locked")
+
+
+def test_locksmith_disarm_restores_future_result(monkeypatch):
+    import concurrent.futures
+    orig = concurrent.futures.Future.result
+    locksmith.arm()
+    try:
+        assert concurrent.futures.Future.result is not orig
+    finally:
+        locksmith.disarm()
+        locksmith.reset()
+    assert concurrent.futures.Future.result is orig
+    assert not locksmith.enabled()
+
+
+# ---------------------------------------------------------------------------
+# Pinning tests for the real findings the first scan surfaced
+# ---------------------------------------------------------------------------
+
+class _Tty(object):
+    def isatty(self):
+        return True
+
+    def readline(self):   # code.interact would need it; never reached
+        return ""
+
+
+def test_interactive_knob_declared_and_default_off(monkeypatch):
+    """The historical bug: ``getattr(root.common, "interactive",
+    False)`` auto-vivified a TRUTHY empty Config node, so every tty
+    run was interactive.  The knob is now declared (default False)
+    and the Shell reads it via .get — pinned with a fake tty."""
+    assert config.knob_declared("common.interactive")
+    assert root.common.get("interactive", False) is False
+    from znicz_tpu.core.workflow import Workflow
+    from znicz_tpu.core.interaction import Shell
+    wf = Workflow()
+    shell = Shell(wf)
+    monkeypatch.setattr(sys, "stdin", _Tty())
+    assert shell.should_interact is False      # the historical bug
+    monkeypatch.setattr(root.common, "interactive", True)
+    assert shell.should_interact is True
+    monkeypatch.setattr(root.common, "interactive", False)
+
+
+def test_precision_dtype_knob_declared():
+    """loader/base.py and units/fused_trainer.py read
+    ``common.engine.precision_dtype`` — it must be declared (was not,
+    until the knob-vocabulary checker flagged it)."""
+    assert config.knob_declared("common.engine.precision_dtype")
+    assert root.common.engine.get("precision_dtype") is None
+
+
+def test_declare_registers_and_respects_overrides():
+    try:
+        root.common.scratch_ns = {"knob": 1}        # operator override
+        config.declare("common.scratch_ns.knob", 7)
+        assert root.common.scratch_ns.knob == 1     # override wins
+        assert config.knob_declared("common.scratch_ns.knob")
+        config.declare("common.scratch_ns.other", "x")
+        assert root.common.scratch_ns.other == "x"
+        assert config.knob_declared("common.scratch_ns")
+        assert not config.knob_declared("common.scratch_ns.typo")
+    finally:
+        root.common.__dict__.pop("scratch_ns", None)
+
+
+def test_engine_ladder_adoption_waits_for_load_lock():
+    """The load-lock fix: manifest-ladder adoption + limits snapshot
+    happen INSIDE engine._load_lock with the generation swap, so a
+    concurrent load cannot interleave half-adopted limits."""
+    from znicz_tpu.serving.engine import InferenceEngine
+
+    def src(buckets):
+        return ({"format": 1,
+                 "layers": [{"type": "dropout", "name": "d0",
+                             "arrays": {}}],
+                 "input_sample_shape": [5],
+                 "serving": {"buckets": list(buckets),
+                             "max_batch": max(buckets),
+                             "sample_shape": [5]}}, {})
+
+    engine = InferenceEngine(src((1, 2)), warmup=False)
+    assert engine.buckets == (1, 2)
+    engine._load_lock.acquire()
+    done = threading.Event()
+
+    def reload():
+        engine.load(src((1, 2, 4)))
+        done.set()
+
+    t = threading.Thread(target=reload)
+    t.start()
+    try:
+        assert not done.wait(0.2)
+        # the lock is held: the new ladder must NOT be adopted yet
+        assert engine.buckets == (1, 2)
+        assert engine.max_batch == 2
+    finally:
+        engine._load_lock.release()
+    t.join(timeout=5)
+    assert done.is_set()
+    assert engine.buckets == (1, 2, 4)
+    assert engine.max_batch == 4
+
+
+def test_armed_batcher_traffic_is_clean():
+    """Functional: the continuous batcher under the armed sanitizer —
+    real worker threads, condition waits, future resolution — records
+    zero cycles and zero blocking-under-lock."""
+    locksmith.reset()
+    locksmith.arm()
+    try:
+        from znicz_tpu.serving.continuous import ContinuousBatcher
+        cb = ContinuousBatcher(
+            lambda x: numpy.asarray(x) * 2.0, max_inflight=2).start()
+        futs = [cb.submit(numpy.ones((1, 3), numpy.float32))
+                for _ in range(16)]
+        for f in futs:
+            numpy.testing.assert_array_equal(
+                f.result(timeout=5),
+                numpy.full((1, 3), 2.0, numpy.float32))
+        cb.stop(flush=True)
+    finally:
+        locksmith.disarm()
+    try:
+        locksmith.assert_clean()
+    finally:
+        locksmith.reset()

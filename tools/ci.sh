@@ -9,8 +9,9 @@
 #   tools/ci.sh full   everything, including the slow tier.
 set -e
 cd "$(dirname "$0")/.."
-echo "== lint"
-python tools/lint.py
+echo "== graftlint (selftest: every checker must reject its seeded violation; then the tree must be findings-clean outside the reviewed baseline)"
+python tools/graftlint.py --selftest
+python tools/graftlint.py
 echo "== cpp"
 make -C cpp -s
 echo "== telemetry smoke (2-epoch wine, trace + /metrics)"

@@ -1,117 +1,14 @@
 #!/usr/bin/env python3
-"""Dependency-free lint for the repo (the reference ships flake8/pylint
-configs; this box has neither, so the checks are implemented directly).
-
-Checks: syntax, tabs in indentation, trailing whitespace, line length,
-unused imports (per module, `# noqa` opt-out), bare except, and
-`print(` calls inside the library (samples/CLI excluded).
+"""Retired — the style checks moved into ``tools/graftlint.py``
+(ISSUE 13), which adds the project-invariant checkers on top.  This
+shim keeps ``python tools/lint.py`` working for muscle memory and old
+scripts by delegating to the graftlint CLI.
 """
 
-import ast
-import os
 import sys
 
-MAX_LINE = 80
-LIB_DIRS = ("znicz_tpu",)
-SCAN_DIRS = ("znicz_tpu", "tests", "tools")
-SKIP_PARTS = ("__pycache__",)
-PRINT_OK = ("samples", "__main__.py", "launcher.py", "parity.py")
-
-
-def iter_py(root):
-    for base in SCAN_DIRS:
-        for dirpath, dirnames, filenames in os.walk(
-                os.path.join(root, base)):
-            if any(p in dirpath for p in SKIP_PARTS):
-                continue
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
-
-
-def unused_imports(tree, source_lines):
-    imported = {}  # name -> (lineno, as_what)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                name = alias.asname or alias.name.split(".")[0]
-                imported[name] = node.lineno
-        elif isinstance(node, ast.ImportFrom):
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                name = alias.asname or alias.name
-                imported[name] = node.lineno
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                used.add(n.id)
-    out = []
-    for name, lineno in imported.items():
-        if name in used:
-            continue
-        line = source_lines[lineno - 1] if lineno <= len(source_lines) \
-            else ""
-        if "noqa" in line:
-            continue
-        out.append((lineno, "unused import %r" % name))
-    return out
-
-
-def check_file(path, rel):
-    problems = []
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, "syntax error: %s" % e.msg)]
-    for i, line in enumerate(lines, 1):
-        stripped = line.rstrip("\n")
-        indent = stripped[:len(stripped) - len(stripped.lstrip())]
-        if "\t" in indent:
-            problems.append((i, "tab in indentation"))
-        if stripped != stripped.rstrip():
-            problems.append((i, "trailing whitespace"))
-        if len(stripped) > MAX_LINE and "noqa" not in stripped:
-            problems.append((i, "line too long (%d > %d)"
-                             % (len(stripped), MAX_LINE)))
-    problems.extend(unused_imports(tree, lines))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            problems.append((node.lineno, "bare except"))
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-                and rel.startswith(LIB_DIRS)
-                and not any(p in rel for p in PRINT_OK)
-                and "noqa" not in lines[node.lineno - 1]):
-            problems.append((node.lineno,
-                             "print() in library code (use the logger)"))
-    return problems
-
-
-def main():
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    total = 0
-    for path in iter_py(root):
-        rel = os.path.relpath(path, root)
-        for lineno, msg in sorted(check_file(path, rel)):
-            print("%s:%d: %s" % (rel, lineno, msg))
-            total += 1
-    if total:
-        print("%d problem(s)" % total)
-        return 1
-    print("lint clean")
-    return 0
-
-
 if __name__ == "__main__":
+    sys.stderr.write("tools/lint.py is retired; running "
+                     "tools/graftlint.py (see docs/development.md)\n")
+    from graftlint import main
     sys.exit(main())

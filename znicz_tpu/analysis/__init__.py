@@ -1,0 +1,19 @@
+"""Project-invariant analysis layer.
+
+Two halves, sharing the knob registry ``core/config.py`` declares:
+
+* :mod:`znicz_tpu.analysis.graftlint` — dependency-free AST checkers
+  for the invariants the stack otherwise only enforces dynamically
+  (config-knob vocabulary, telemetry series/label discipline,
+  lock-guard discipline, JAX tracing hazards, gate discipline) plus
+  the legacy style checks, driven by ``tools/graftlint.py``.
+* :mod:`znicz_tpu.analysis.locksmith` — an opt-in runtime lock-order
+  sanitizer the threaded modules create their locks through; armed, it
+  records the acquisition-order graph, detects ABBA cycles and
+  blocking-while-holding, and reports held-lock stacks.  Off (the
+  default), the factories hand out plain ``threading`` primitives
+  after ONE config predicate.
+
+Neither module imports jax — the CLI and the sanitizer gate stay
+usable from config-only tools.
+"""

@@ -41,12 +41,12 @@ unless explicitly enabled — the off path is one config read.
 
 import glob
 import os
-import threading
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
 
-_lock = threading.Lock()
+_lock = locksmith.lock("compile_cache")
 #: the active cache directory (None = not wired into jax)
 _dir = None
 

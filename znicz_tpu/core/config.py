@@ -107,13 +107,111 @@ class Config(object):
 #: The global configuration root (reference: ``veles.config.root``).
 root = Config("root")
 
+
+# ---------------------------------------------------------------------------
+# Knob registry — declare-before-read config hygiene
+# ---------------------------------------------------------------------------
+
+#: declared LEAF knobs, dotted paths relative to ``root``
+#: (e.g. "common.serving.max_batch")
+_KNOBS = set()
+#: declared NAMESPACE nodes (e.g. "common.serving") — reading a whole
+#: node (to alias it or walk its keys) is legal; reading an undeclared
+#: key under one is not
+_NODES = set()
+
+
+def declare(path, value):
+    """Declare a knob (scalar ``value``) or a whole namespace (dict
+    ``value``) under ``root.<path>``, installing its default and
+    registering the path in the knob registry.
+
+    The registry is THE vocabulary ``tools/graftlint.py``'s
+    ``knob-vocabulary`` checker enforces: every ``root.common.*`` read
+    or write anywhere in the library must resolve to a declared path.
+    Auto-vivification makes a typo'd knob a silent default (an
+    untouched Config node is even *truthy*), so new knobs must be
+    declared here — in exactly one place — before any code reads them.
+    """
+    parts = path.split(".")
+    if not parts or not all(parts):
+        raise ValueError("bad knob path %r" % path)
+    node = root
+    for part in parts[:-1]:
+        node = getattr(node, part)
+        if not isinstance(node, Config):
+            raise ValueError(
+                "cannot declare %r: %s is a leaf knob, not a "
+                "namespace" % (path, node))
+    if isinstance(value, (dict, Config)):
+        as_dict = value if isinstance(value, dict) else value.as_dict()
+        setattr(node, parts[-1], as_dict)
+        if as_dict:
+            _register(path, getattr(node, parts[-1]).as_dict())
+        else:
+            # an empty dict declares an OPEN dict-valued knob — same
+            # rule as a nested empty dict (e.g. common.faults.rules):
+            # its payload is config data, not vocabulary
+            _KNOBS.add(path)
+    else:
+        if parts[-1] not in node.__dict__:
+            # an operator override set before the declaration wins
+            setattr(node, parts[-1], value)
+        _KNOBS.add(path)
+    for i in range(1, len(parts)):
+        _NODES.add(".".join(parts[:i]))
+    return path
+
+
+def _register(prefix, tree):
+    _NODES.add(prefix)
+    for k, v in tree.items():
+        sub = "%s.%s" % (prefix, k)
+        if isinstance(v, dict) and v:
+            _register(sub, v)
+        else:
+            # an EMPTY dict default declares an open dict-valued knob
+            # (e.g. common.faults.rules) — its content is config
+            # payload, not vocabulary
+            _KNOBS.add(sub)
+
+
+def declared_knobs():
+    """Frozen view of the declared LEAF knob paths."""
+    return frozenset(_KNOBS)
+
+
+def declared_nodes():
+    """Frozen view of the declared NAMESPACE paths."""
+    return frozenset(_NODES)
+
+
+def knob_declared(path):
+    """Is ``path`` (dotted, relative to ``root``) a legal config read?
+    True for declared knobs and namespaces, and for any path UNDER a
+    declared leaf knob (data inside a dict-valued knob like
+    ``common.faults.rules`` is config payload, not vocabulary)."""
+    if path in _KNOBS or path in _NODES:
+        return True
+    parts = path.split(".")
+    for i in range(1, len(parts)):
+        if ".".join(parts[:i]) in _KNOBS:
+            return True
+    return False
+
+
 # Engine-level defaults observed in the reference
 # (samples/CIFAR10/cifar_caffe_config.py:52-53, site_config.py:37-40).
-root.common.update({
+declare("common", {
     "engine": {
         "precision_type": "float",    # "float" | "double" | "bfloat16"
         "precision_level": 0,         # 0: fast, 1: deterministic-ish
         "backend": "auto",            # "numpy" | "jax" | "auto"
+        # explicit minibatch/staging dtype override read by
+        # Loader.create_minibatch_data and the fused trainer (None:
+        # follow the data / precision_type) — was read but UNDECLARED
+        # until graftlint's knob-vocabulary checker flagged it
+        "precision_dtype": None,
     },
     "dirs": {
         "datasets": "/root/repo/.data",
@@ -121,6 +219,16 @@ root.common.update({
         "cache": "/root/repo/.cache",
     },
     "disable": {"plotting": True, "publishing": True},
+    # interactive Shell unit gate (core/interaction.py) — MUST be
+    # declared: an undeclared read would auto-vivify a truthy empty
+    # Config node and silently force every Shell interactive on a tty
+    "interactive": False,
+    # static/runtime analysis layer (znicz_tpu/analysis/) — off by
+    # default; when off the locksmith lock factories hand out plain
+    # threading primitives after ONE config predicate
+    "analysis": {
+        "lock_sanitizer": False,
+    },
     # unified telemetry (core/telemetry.py) — off by default so every
     # instrumented hot path reduces to a guard-only no-op
     "telemetry": {

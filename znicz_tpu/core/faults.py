@@ -45,12 +45,12 @@ so a chaos subprocess arms itself with::
         "common.faults.rules={'fused.dispatch': {'kind': 'crash', 'at': 7}}"
 """
 
-import threading
 import time
 
 import numpy
 
 from znicz_tpu.core.config import root, Config
+from znicz_tpu.analysis import locksmith
 from znicz_tpu.core import telemetry
 
 import logging
@@ -183,7 +183,7 @@ class _Registry(object):
     and the armed rules."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("faults.registry")
         self.rules = {}        # site -> _Rule
         self.invocations = {}  # site -> int
         self.injected = {}     # site -> int
@@ -212,7 +212,7 @@ class _Registry(object):
         return rule
 
 
-_registry_lock = threading.Lock()
+_registry_lock = locksmith.lock("faults.module")
 _registry = None
 
 

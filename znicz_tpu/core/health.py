@@ -33,12 +33,12 @@ overhead over time.
 
 import collections
 import math
-import threading
 import time
 
 import numpy
 
 from znicz_tpu.core.config import root
+from znicz_tpu.analysis import locksmith
 from znicz_tpu.core import telemetry
 from znicz_tpu.core.memory import Array, DEV, SYNC
 
@@ -236,7 +236,7 @@ class HealthMonitor(object):
             maxlen=self.VIOLATION_HISTORY)
         self._steps = 0
         self._next_check = 0
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("health.monitor")
 
     # -- interval ------------------------------------------------------------
     def due(self, steps=1):
@@ -365,7 +365,7 @@ class HealthMonitor(object):
         }
 
 
-_monitor_lock = threading.Lock()
+_monitor_lock = locksmith.lock("health.module")
 _monitor = None
 
 

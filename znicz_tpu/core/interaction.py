@@ -31,7 +31,11 @@ class Shell(Unit):
     def should_interact(self):
         enabled = self.enabled
         if enabled is None:
-            enabled = bool(getattr(root.common, "interactive", False))
+            # read the DECLARED knob via .get: a getattr on the config
+            # tree auto-vivifies a truthy empty Config node, which
+            # silently turned every tty run interactive (graftlint's
+            # knob-vocabulary checker now rejects undeclared reads)
+            enabled = bool(root.common.get("interactive", False))
         return enabled and sys.stdin is not None and \
             hasattr(sys.stdin, "isatty") and sys.stdin.isatty()
 

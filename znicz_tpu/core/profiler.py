@@ -53,11 +53,11 @@ import glob
 import json
 import logging
 import os
-import threading
 import time
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
 
 logger = logging.getLogger("profiler")
 
@@ -113,7 +113,7 @@ class DeviceLedger(object):
         #: or reset() while buffers were live) and the live totals are
         #: LOWER BOUNDS, not exact
         self.clamped_frees = 0
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("profiler.ledger")
 
     def swap(self, name, old_nbytes, new_nbytes):
         name = name or "<unnamed>"
@@ -171,11 +171,11 @@ class _ProfilerState(object):
         #: (epoch, ledger live bytes) at each epoch boundary
         self.epoch_bytes = []
         self.leak_suspects = 0
-        self.lock = threading.Lock()
+        self.lock = locksmith.lock("profiler.state")
 
 
 _state = None
-_state_lock = threading.Lock()
+_state_lock = locksmith.lock("profiler.module")
 
 
 def _prof():
@@ -599,7 +599,7 @@ def breakdown_summary():
 # On-demand jax.profiler capture (/debug/profile + the CLI)
 # ---------------------------------------------------------------------------
 
-_capture_lock = threading.Lock()
+_capture_lock = locksmith.lock("profiler.capture")
 _heartbeat = None
 
 

@@ -38,6 +38,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
 
 _PAGE = """<html><head><title>znicz_tpu status</title>
 <meta http-equiv="refresh" content="5"></head>
@@ -202,7 +203,7 @@ class HttpServerBase(Logger):
         self.port = port
         self._httpd = None
         self._thread = None
-        self._lifecycle_lock = threading.Lock()
+        self._lifecycle_lock = locksmith.lock("status_server.lifecycle")
 
     def make_handler(self):
         """Return the request-handler class for this server."""

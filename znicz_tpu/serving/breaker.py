@@ -25,10 +25,10 @@ Telemetry: ``serving.breaker_opens`` counter, per-bucket
 ``serving.breaker`` journal events on every transition.
 """
 
-import threading
 import time
 
 from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
@@ -60,7 +60,7 @@ class CircuitBreaker(object):
         self.cooldown_s = float(cooldown_s)
         self.half_open_max = max(int(half_open_max), 1)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("serving.breaker")
         self.state = CLOSED
         self._failures = 0
         self._opened_at = None
@@ -139,14 +139,14 @@ class CircuitBreaker(object):
                 self._open()
 
     # -- internals (lock held) ----------------------------------------------
-    def _open(self):
+    def _open(self):  # graftlint: guarded-by(self._lock)
         self._opened_at = self._clock()
         self.opens += 1
         if telemetry.enabled():
             telemetry.counter("serving.breaker_opens").inc()
         self._transition(OPEN)
 
-    def _transition(self, state):
+    def _transition(self, state):  # graftlint: guarded-by(self._lock)
         prev, self.state = self.state, state
         if prev == state:
             return

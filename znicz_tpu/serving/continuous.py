@@ -44,6 +44,7 @@ import time
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
 import numpy
 
 from znicz_tpu.serving.batcher import (_DISPATCH_GRACE, _Request,
@@ -93,7 +94,7 @@ class ContinuousBatcher(Logger):
         self._queues = {}          # (model, shape, dtype) -> _Queue
         self._rows_queued = 0
         self._last_model = None    # round-robin cursor
-        self._cond = threading.Condition()
+        self._cond = locksmith.condition("serving.continuous")
         self._running = False
         self._threads = []
         self._inflight = 0

@@ -63,6 +63,7 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core import faults
 from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
 from znicz_tpu.serving import quant
 
 
@@ -426,7 +427,7 @@ class InferenceEngine(Logger):
         self._sample_shape_override = (
             tuple(sample_shape) if sample_shape is not None else None)
         self._model = None
-        self._load_lock = threading.Lock()
+        self._load_lock = locksmith.lock("serving.engine.load")
         self._version = 0
         self._ready = threading.Event()
         #: per-bucket circuit breakers (serving/breaker.py), created
@@ -434,7 +435,7 @@ class InferenceEngine(Logger):
         #: survive hot reloads — backend flakiness is not a property of
         #: one model generation
         self._breakers = {}
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = locksmith.lock("serving.engine.breakers")
         if source is not None:
             self.load(source)
 
@@ -524,7 +525,11 @@ class InferenceEngine(Logger):
         sd = self.serve_dtype
         if sd != "f32":
             labels["dtype"] = sd
-        return telemetry.labeled(series, **labels)
+        # reviewed naming wrapper: graftlint checks every _label CALL
+        # site's literal series + label keys instead; the keys added
+        # here (model/dtype) are both in the bounded vocabulary
+        return telemetry.labeled(  # graftlint: disable=telemetry-series,telemetry-cardinality # noqa
+            series, **labels)
 
     def stats(self):
         """healthz payload: what is loaded, how warm, how big."""
@@ -634,20 +639,28 @@ class InferenceEngine(Logger):
         # derivation leave the surviving generation with the failed
         # source's ladder: a shrunk max_batch 400ing request sizes
         # that were valid a second ago.)
-        old_limits = (self.buckets, self.max_batch,
-                      self._warmup_manifest)
-        if serving_mf is not None:
-            self._warmup_manifest = serving_mf
-            if not self._buckets_explicit and serving_mf.get("buckets"):
-                # adopt the ahead-of-time warmup manifest recorded at
-                # export/snapshot time: the replica warms the EXACT
-                # bucket ladder the exporter's serving config pinned
-                ladder = tuple(sorted(
-                    int(b) for b in serving_mf["buckets"]))
-                if ladder and ladder[0] >= 1:
-                    self.buckets = ladder
-                    self.max_batch = ladder[-1]
         with self._load_lock:
+            # limits snapshot + ladder adoption live INSIDE the load
+            # lock with the swap: two concurrent load()s interleaving
+            # here could snapshot each other's half-adopted ladder and
+            # roll back to the WRONG limits (graftlint lock-guard
+            # finding — buckets/max_batch/_warmup_manifest are
+            # lock-guarded on the rollback path)
+            old_limits = (self.buckets, self.max_batch,
+                          self._warmup_manifest)
+            if serving_mf is not None:
+                self._warmup_manifest = serving_mf
+                if not self._buckets_explicit and \
+                        serving_mf.get("buckets"):
+                    # adopt the ahead-of-time warmup manifest recorded
+                    # at export/snapshot time: the replica warms the
+                    # EXACT bucket ladder the exporter's serving
+                    # config pinned
+                    ladder = tuple(sorted(
+                        int(b) for b in serving_mf["buckets"]))
+                    if ladder and ladder[0] >= 1:
+                        self.buckets = ladder
+                        self.max_batch = ladder[-1]
             old = self._model
             old_bytes = self.device_bytes
             # an evicted old generation has no fn to carry over —

@@ -118,8 +118,10 @@ def record_scenario(scenario, seconds, model=None):
     labels = {"scenario": scenario}
     if model:
         labels["model"] = model
+    # label set bounded by the SCENARIOS check above + model names
     telemetry.histogram(
-        telemetry.labeled(SERIES, **labels)).observe(float(seconds))
+        telemetry.labeled(  # graftlint: disable=telemetry-cardinality
+            SERIES, **labels)).observe(float(seconds))
 
 
 def timed_predict(engine, x, scenario):

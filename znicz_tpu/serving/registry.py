@@ -44,12 +44,12 @@ engine's own load lock orders its generation swaps.
 """
 
 import re
-import threading
 import time
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core import compile_cache, telemetry
+from znicz_tpu.analysis import locksmith
 from znicz_tpu.serving.engine import InferenceEngine
 
 #: URL-routable model names (they appear in /predict/<name> paths,
@@ -93,7 +93,7 @@ class ModelRegistry(Logger):
                  **engine_defaults):
         super(ModelRegistry, self).__init__(
             logger_name="ModelRegistry")
-        self._lock = threading.RLock()
+        self._lock = locksmith.rlock("serving.registry")
         self._entries = {}
         self._default = None
         self._budget_override = memory_budget_bytes
