@@ -523,6 +523,10 @@ def main(profile_dir=None):
     # breaker half-open probe) — req/s gated like throughput, exact
     # per-scenario p99s gated inverted (tools/bench_gate.py)
     _stamp_serving_tail(out)
+    # SLO-plane overhead (ISSUE 14): armed sampler+tracing+SLO vs
+    # disabled on the same HTTP mix — gated inverted so the
+    # observability plane's cost stays a measured, bounded number
+    _stamp_serving_observability(out)
     prec = out.get("serving_precision", {}).get("dtypes")
     if prec and isinstance(out.get("roofline"), dict):
         # the roofline block grows the per-dtype serving axis: where
@@ -1125,6 +1129,153 @@ def _stamp_serving_tail(out):
             or 0.0
 
 
+def _serving_observability_block(duration=2.0, clients=8,
+                                 max_batch=8):
+    """The SLO-plane overhead measurement (ISSUE 14): the SAME
+    closed-loop HTTP mix against one registry server twice — first
+    with the observability plane DISABLED (its shipped default), then
+    ARMED (time-series sampler at a fast interval + every request
+    trace-sampled + SLO tracking) — and the throughput delta between
+    the two laps is the plane's measured cost.  One server and one
+    engine serve both laps, so no compile/warmup asymmetry pollutes
+    the number; a short warm lap ahead of the timed laps absorbs
+    first-dispatch jitter.
+
+    ``overhead_pct`` is floored at 1.0 for the stamp: tools/bench_gate
+    treats a zero as the crash-guard sentinel (a 100% regression), so
+    an honest ~zero (or negative — noise) measurement must never read
+    as a broken tier; the unfloored value rides along as
+    ``overhead_pct_raw``."""
+    import threading
+    import urllib.request
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core import telemetry, timeseries
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+
+    telemetry.reset()
+    timeseries.reset()
+    root.common.telemetry.enabled = True
+    sources = _loadgen_models(max_batch)
+    registry = ModelRegistry(models=sources, max_batch=max_batch)
+    server = ServingServer(registry=registry).start()
+    url = "http://127.0.0.1:%d" % server.port
+    names = sorted(sources)
+    r = numpy.random.RandomState(3)
+    bodies = {}
+    for name in names:
+        n_in = sources[name][0]["input_sample_shape"][0]
+        bodies[name] = [
+            json.dumps({"inputs": r.uniform(
+                -1, 1, (1 + i % max_batch, n_in)).tolist()}).encode()
+            for i in range(4)]
+
+    def lap(seconds):
+        stop = threading.Event()
+        done = [0] * clients
+        errors = []
+
+        def client(k):
+            i = k
+            try:
+                while not stop.is_set():
+                    name = names[i % len(names)]
+                    req = urllib.request.Request(
+                        url + "/predict/" + name,
+                        bodies[name][i % len(bodies[name])],
+                        {"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req,
+                                                timeout=60) as resp:
+                        resp.read()
+                        assert resp.status == 200
+                    done[k] += 1
+                    i += 1
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                errors.append(repr(e))
+                stop.set()
+
+        threads = [threading.Thread(target=client, args=(k,),
+                                    daemon=True)
+                   for k in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            # a dead client thread would silently skew the rps the
+            # gated overhead number is computed from — fail the whole
+            # block instead (the crash-guard stamps a LOUD zero that
+            # fails bench_gate, never a quietly-wrong percentage)
+            raise RuntimeError(
+                "observability lap lost %d client(s): %s"
+                % (len(errors), errors[:3]))
+        return done, time.perf_counter() - t0
+
+    cfg = root.common.serving
+    saved = (cfg.get("slo_enabled", False),
+             cfg.get("trace_sample_n", 0),
+             root.common.telemetry.timeseries.get("enabled", False),
+             root.common.telemetry.timeseries.get("interval_ms",
+                                                  1000.0))
+    try:
+        lap(0.4)  # warm: dispatch paths hot before either timed lap
+        done_off, wall_off = lap(duration)
+        # arm the WHOLE plane: sampler on a fast interval, every
+        # request sampled into a trace tree, SLO accounting on
+        root.common.serving.slo_enabled = True
+        root.common.serving.trace_sample_n = 1
+        root.common.telemetry.timeseries.enabled = True
+        root.common.telemetry.timeseries.interval_ms = 100.0
+        from znicz_tpu.serving import reqtrace
+        reqtrace.reset()
+        timeseries.maybe_start()
+        done_on, wall_on = lap(duration)
+        slo_status = server.slo.status()
+        ts_series = len(timeseries.series_names())
+        traces = len(reqtrace.rids())
+    finally:
+        (root.common.serving.slo_enabled,
+         root.common.serving.trace_sample_n,
+         root.common.telemetry.timeseries.enabled,
+         root.common.telemetry.timeseries.interval_ms) = saved
+        timeseries.stop()
+        server.stop()
+    rps_off = sum(done_off) / wall_off
+    rps_on = sum(done_on) / wall_on
+    raw = (1.0 - rps_on / max(rps_off, 1e-9)) * 100.0
+    tracked = sum(m.get("total", 0)
+                  for m in slo_status.get("models", {}).values())
+    return {
+        "clients": clients,
+        "duration_s": duration,
+        "disabled_requests_per_sec": round(rps_off, 1),
+        "armed_requests_per_sec": round(rps_on, 1),
+        "overhead_pct_raw": round(raw, 2),
+        "overhead_pct": round(max(raw, 1.0), 2),
+        # proof the armed lap actually exercised the plane (a knob
+        # that silently failed to arm would stamp a flattering zero)
+        "armed_slo_requests_tracked": tracked,
+        "armed_timeseries_series": ts_series,
+        "armed_traces_sampled": traces,
+    }
+
+
+def _stamp_serving_observability(out):
+    """Stamp the SLO-plane overhead block + the flat gated key
+    (crash-guarded ZERO stamp; tools/bench_gate.py gates it INVERTED
+    — a rise past the band fails the round) — shared by main(),
+    main_serving() and the ``--serving-obs`` CI entry."""
+    try:
+        out["serving_observability"] = _serving_observability_block()
+    except Exception as e:  # noqa: BLE001 - never kill the primary
+        out["serving_observability"] = {"error": repr(e)}
+    block = out["serving_observability"]
+    out["serving_observability_overhead_pct"] = (
+        block.get("overhead_pct") or 0.0)
+
+
 def _stamp_serving_precision(out, peaks):
     """Stamp the per-dtype serving block + the flat gated keys
     (crash-guarded with explicit ZERO stamps, so a broken precision
@@ -1254,6 +1405,9 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
     # ISSUE 12: the batch-1 tail-latency block — same stamps as the
     # main bench
     _stamp_serving_tail(out)
+    # ISSUE 14: the SLO-plane overhead block — same stamps as the
+    # main bench
+    _stamp_serving_observability(out)
     print(json.dumps(out))
 
 
@@ -1267,6 +1421,19 @@ def main_serving_tail():
     telemetry.reset()
     out = {"metric": "serving_tail_latency"}
     _stamp_serving_tail(out)
+    print(json.dumps(out))
+
+
+def main_serving_obs():
+    """``--serving-obs``: ONLY the SLO-plane overhead block + its flat
+    gated key, as one JSON line — the CPU-feasible CI entry
+    (tools/ci.sh pipes it through ``bench_gate --assert-stamped
+    serving_observability_overhead_pct`` so an observability plane
+    that broke, or stopped arming, fails the gate)."""
+    from znicz_tpu.core import telemetry
+    telemetry.reset()
+    out = {"metric": "serving_observability_overhead_pct"}
+    _stamp_serving_observability(out)
     print(json.dumps(out))
 
 
@@ -1286,6 +1453,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--serving-tail" in sys.argv:
         main_serving_tail()
+        sys.exit(0)
+    if "--serving-obs" in sys.argv:
+        main_serving_obs()
         sys.exit(0)
     if "--serving" in sys.argv:
         kwargs = {}

@@ -61,6 +61,11 @@ def _engine_flags_isolated():
     pen = root.common.profiler.get("enabled", False)
     fen = root.common.faults.get("enabled", False)
     cen = root.common.compile_cache.get("enabled", False)
+    # the serving SLO plane's gates (ISSUE 14): the time-series
+    # sampler, server-side SLO tracking and request-trace sampling
+    tsen = root.common.telemetry.timeseries.get("enabled", False)
+    slo_en = root.common.serving.get("slo_enabled", False)
+    trace_n = root.common.serving.get("trace_sample_n", 0)
     yield
     root.common.timings.sync_each_run = sync
     root.common.telemetry.enabled = tel
@@ -82,6 +87,9 @@ def _engine_flags_isolated():
     from znicz_tpu.core import compile_cache
     if compile_cache.enabled():
         compile_cache.disable()
+    root.common.telemetry.timeseries.enabled = tsen
+    root.common.serving.slo_enabled = slo_en
+    root.common.serving.trace_sample_n = trace_n
 
 
 #: test modules whose CONCURRENT serving traffic runs under the armed

@@ -310,3 +310,80 @@ def test_oversize_request_rejected_loudly():
             b.submit(_rows(5))
     finally:
         b.stop()
+
+
+def test_rid_aware_cache_invalidates_on_model_replace():
+    """ISSUE 14 satellite: the per-model request-id-propagation probe
+    is cached against the RESOLVED engine object, so replacing a
+    model (registry remove + re-add, or a swapped callable) re-probes
+    — a cached negative from a plain predict(x) must not suppress rid
+    propagation to an rid-aware successor."""
+
+    class RidAwareModel(RecordingModel):
+        def __init__(self, **kw):
+            super(RidAwareModel, self).__init__(**kw)
+            self.rids = []
+
+        def predict(self, x, request_ids=None):
+            with self.lock:
+                self.rids.append(request_ids)
+            return numpy.asarray(x) + 1.0
+
+    plain = RecordingModel()
+    registry = FakeRegistry({"m": plain})
+    b = ContinuousBatcher(registry, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        b.submit(_rows(1), model="m", request_id="r1").result(
+            timeout=5)
+        assert plain.batches == [1]  # negative probe now cached
+        # hot replace: the registry re-points "m" at an rid-aware
+        # engine generation
+        aware = RidAwareModel()
+        registry.engines["m"] = aware
+        b.submit(_rows(1), model="m", request_id="r2").result(
+            timeout=5)
+        assert aware.rids == [["r2"]], \
+            "rid propagation not re-probed after the model replace"
+    finally:
+        b.stop()
+
+
+def test_rid_aware_cache_survives_same_engine_dispatches():
+    """The cache still caches: repeated dispatches against the SAME
+    engine object probe the signature exactly once."""
+    model = RecordingModel()
+    registry = FakeRegistry({"m": model})
+    b = ContinuousBatcher(registry, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        for i in range(3):
+            b.submit(_rows(1), model="m",
+                     request_id="r%d" % i).result(timeout=5)
+        cached_ref, rid_aware = b._rid_aware["m"]
+        assert cached_ref() is model and rid_aware is False
+        assert model.batches == [1, 1, 1]
+    finally:
+        b.stop()
+
+
+def test_rid_aware_cache_does_not_pin_removed_engines():
+    """Review fix: the cache holds a WEAK reference — a removed
+    model's engine (and with it the device buffers a real
+    InferenceEngine owns) must free with its last real reference,
+    not live on inside the batcher's probe cache."""
+    import gc
+    import weakref
+    model = RecordingModel()
+    registry = FakeRegistry({"m": model})
+    b = ContinuousBatcher(registry, max_inflight=1, queue_limit=64,
+                          timeout_ms=0).start()
+    try:
+        b.submit(_rows(1), model="m").result(timeout=5)
+        watcher = weakref.ref(model)
+        del registry.engines["m"], model
+        gc.collect()
+        assert watcher() is None, \
+            "the rid-aware cache pinned a removed engine"
+    finally:
+        b.stop()

@@ -1,0 +1,91 @@
+"""Per-request trace trees (znicz_tpu/serving/reqtrace.py): head
+sampling, ring bounds, closed-tree semantics under client rid reuse —
+all with injectable timestamps, zero sleeps.  (The HTTP-stitched
+end-to-end trees are pinned in
+tests/functional/test_slo_observability.py.)"""
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.serving import reqtrace
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setattr(root.common.serving, "trace_sample_n", 1)
+    monkeypatch.setattr(root.common.serving, "trace_capacity", 8)
+    reqtrace.reset()
+    yield reqtrace
+    reqtrace.reset()
+
+
+def _full_tree(rt, rid, t0=100.0):
+    assert rt.begin(rid, now=t0) is True
+    rt.add_span(rid, "admission", t0, t0 + 0.001)
+    rt.add_span(rid, "queue_wait", t0 + 0.001, t0 + 0.002)
+    rt.add_span(rid, "assembly", t0 + 0.002, t0 + 0.003)
+    rt.add_span(rid, "dispatch", t0 + 0.003, t0 + 0.009, bucket=1)
+    rt.add_span(rid, "device", t0 + 0.004, t0 + 0.008)
+    rt.add_span(rid, "reply", t0 + 0.009, t0 + 0.010)
+    rt.finish(rid, now=t0 + 0.010, model="m")
+
+
+def test_tree_math_and_completeness(traced):
+    _full_tree(traced, "r1")
+    tree = traced.get("r1")
+    assert tree["complete"] is True
+    assert tree["model"] == "m"
+    assert tree["wall_ms"] == pytest.approx(10.0)
+    # the five non-overlapping kinds partition the wall; device (the
+    # sixth) nests inside dispatch and is not double-counted
+    assert tree["parts_ms"] == pytest.approx(10.0)
+    assert tree["spans"][0]["kind"] == "admission"
+    assert len(tree["traceEvents"]) == 6
+
+
+def test_head_sampling_every_nth(traced, monkeypatch):
+    monkeypatch.setattr(root.common.serving, "trace_sample_n", 3)
+    hits = [traced.begin("s-%d" % i) for i in range(9)]
+    assert hits == [True, False, False] * 3
+    assert traced.rids() == ["s-6", "s-3", "s-0"]
+
+
+def test_unknown_kind_is_loud(traced):
+    traced.begin("r1")
+    with pytest.raises(ValueError, match="unknown span kind"):
+        traced.add_span("r1", "teleport", 0.0, 1.0)
+
+
+def test_finished_tree_rejects_reused_rid_spans(traced):
+    """Review fix: client retries legitimately resend X-Request-Id.
+    Once a tree is finished, sampled() answers False and add_span is
+    a no-op — the retry must not append spans (timed against the old
+    origin) onto the stored result."""
+    _full_tree(traced, "r1")
+    assert traced.sampled("r1") is False
+    assert traced.add_span("r1", "dispatch", 900.0, 901.0) is False
+    assert len(traced.get("r1")["spans"]) == 6
+
+
+def test_begin_never_clobbers_a_live_tree(traced):
+    assert traced.begin("r1", now=50.0) is True
+    # same rid again while the first request is still in flight:
+    # declined (the live tree's remaining spans must land home)
+    assert traced.begin("r1", now=60.0) is False
+    traced.add_span("r1", "dispatch", 50.001, 50.002)
+    traced.finish("r1", now=50.01)
+    assert traced.get("r1")["wall_ms"] == pytest.approx(10.0)
+    # once finished, a reused rid starts a FRESH tree (newest wins)
+    assert traced.begin("r1", now=200.0) is True
+    assert traced.get("r1")["spans"] == []
+
+
+def test_ring_bounds_and_disabled_gate(traced, monkeypatch):
+    for i in range(20):
+        _full_tree(traced, "r%d" % i, t0=100.0 + i)
+    assert len(traced.rids()) == 8
+    assert traced.rids()[0] == "r19"
+    assert traced.get("r0") is None  # evicted
+    monkeypatch.setattr(root.common.serving, "trace_sample_n", 0)
+    assert traced.enabled() is False
+    assert traced.begin("off") is False

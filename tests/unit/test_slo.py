@@ -1,0 +1,254 @@
+"""Serving SLO tracker — burn-rate / error-budget math
+(znicz_tpu/serving/slo.py, ISSUE 14).
+
+Every test drives a synthetic good/bad sequence through an injectable
+clock and checks the window sums, burn rates and budget remaining
+against hand-computed values — ZERO sleeps anywhere."""
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import telemetry
+from znicz_tpu.serving import slo
+
+
+class FakeClock(object):
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def knobs():
+    """SLO knobs pinned to hand-computable values; restored after."""
+    cfg = root.common.serving
+    keys = ("slo_enabled", "slo_ms", "slo_target_pct",
+            "slo_fast_window_s", "slo_slow_window_s",
+            "slo_burn_threshold")
+    saved = {k: cfg.get(k) for k in keys}
+    cfg.slo_enabled = True
+    cfg.slo_ms = 100.0
+    cfg.slo_target_pct = 99.0   # budget fraction = 0.01
+    cfg.slo_fast_window_s = 10.0
+    cfg.slo_slow_window_s = 60.0
+    cfg.slo_burn_threshold = 2.0
+    yield cfg
+    for k, v in saved.items():
+        setattr(cfg, k, v)
+
+
+def tracker(clock):
+    return slo.SloTracker(clock=clock)
+
+
+# -- classification ----------------------------------------------------------
+
+def test_classification_rules(knobs):
+    t = tracker(FakeClock())
+    # 200 within the SLO is the only good outcome
+    assert t.classify(200, 50.0, 100.0) == "good"
+    # a 200 OVER the SLO burns budget — latency is the contract
+    assert t.classify(200, 150.0, 100.0) == "bad"
+    # every server-fault status burns budget
+    for code in (429, 500, 503, 504):
+        assert t.classify(code, 1.0, 100.0) == "bad"
+    # client faults are excluded entirely (malformed traffic must not
+    # burn a healthy model's budget)
+    for code in (400, 404, 413):
+        assert t.classify(code, 1.0, 100.0) == "excluded"
+
+
+def test_excluded_statuses_never_recorded(knobs):
+    clock = FakeClock()
+    t = tracker(clock)
+    assert t.record("m", 400, 1.0) == "excluded"
+    assert t.record("m", 404, 1.0) == "excluded"
+    assert "m" not in t.status()["models"]
+
+
+# -- window sums and burn rates ----------------------------------------------
+
+def test_burn_rate_hand_computed(knobs):
+    clock = FakeClock(2000.0)
+    t = tracker(clock)
+    # 90 good + 10 bad inside the fast window: error rate 0.1,
+    # budget fraction 0.01 -> burn = 10.0 on both windows
+    for _ in range(90):
+        t.record("m", 200, 10.0)
+    for _ in range(10):
+        t.record("m", 500, 10.0)
+    m = t.status()["models"]["m"]
+    assert m["good"] == 90 and m["bad"] == 10
+    assert m["burn_rate"]["fast"] == pytest.approx(10.0)
+    assert m["burn_rate"]["slow"] == pytest.approx(10.0)
+    assert m["good_pct"] == pytest.approx(90.0)
+
+
+def test_fast_window_forgets_slow_window_remembers(knobs):
+    clock = FakeClock(3000.0)
+    t = tracker(clock)
+    # all the bad traffic lands at t=3000
+    for _ in range(10):
+        t.record("m", 500, 1.0)
+    # 30 s later (outside fast=10s, inside slow=60s) healthy traffic
+    clock.advance(30.0)
+    for _ in range(10):
+        t.record("m", 200, 1.0)
+    m = t.status()["models"]["m"]
+    # fast window: only the 10 recent good -> burn 0
+    assert m["burn_rate"]["fast"] == pytest.approx(0.0)
+    # slow window: 10 bad of 20 -> error rate 0.5 -> burn 50
+    assert m["burn_rate"]["slow"] == pytest.approx(50.0)
+
+
+def test_slow_window_expiry(knobs):
+    clock = FakeClock(5000.0)
+    t = tracker(clock)
+    for _ in range(5):
+        t.record("m", 500, 1.0)
+    clock.advance(120.0)  # beyond the 60 s slow window
+    t.record("m", 200, 1.0)
+    m = t.status()["models"]["m"]
+    # cumulative totals keep the history; the windows have forgotten
+    assert m["bad"] == 5 and m["good"] == 1
+    assert m["burn_rate"]["fast"] == pytest.approx(0.0)
+    assert m["burn_rate"]["slow"] == pytest.approx(0.0)
+    assert m["error_budget_remaining"] == 1.0
+
+
+def test_no_traffic_means_no_burn_rate(knobs):
+    t = tracker(FakeClock())
+    t.record("m", 200, 1.0)
+    status = t.status()
+    clock2 = FakeClock()
+    t2 = tracker(clock2)
+    assert t2.status()["models"] == {}
+    assert status["models"]["m"]["burn_rate"]["fast"] == 0.0
+
+
+# -- error budget ------------------------------------------------------------
+
+def test_budget_remaining_hand_computed(knobs):
+    clock = FakeClock(7000.0)
+    t = tracker(clock)
+    # 995 good + 5 bad in the slow window; allowed bad at 99% target
+    # = 1000 * 0.01 = 10 -> remaining = 1 - 5/10 = 0.5
+    for _ in range(995):
+        t.record("m", 200, 1.0)
+    for _ in range(5):
+        t.record("m", 500, 1.0)
+    m = t.status()["models"]["m"]
+    assert m["error_budget_remaining"] == pytest.approx(0.5)
+
+
+def test_budget_clamps_at_zero(knobs):
+    clock = FakeClock(8000.0)
+    t = tracker(clock)
+    for _ in range(10):
+        t.record("m", 500, 1.0)
+    m = t.status()["models"]["m"]
+    assert m["error_budget_remaining"] == 0.0
+    assert m["burn_rate"]["fast"] == pytest.approx(100.0)
+
+
+def test_per_model_isolation(knobs):
+    clock = FakeClock(9000.0)
+    t = tracker(clock)
+    for _ in range(10):
+        t.record("a", 200, 1.0)
+        t.record("b", 500, 1.0)
+    models = t.status()["models"]
+    assert models["a"]["error_budget_remaining"] == 1.0
+    assert models["b"]["error_budget_remaining"] == 0.0
+    # None routes to the "default" bucket, not to a named model
+    t.record(None, 200, 1.0)
+    assert t.status()["models"]["default"]["good"] == 1
+
+
+# -- burn events (edge-triggered with hysteresis) ----------------------------
+
+@pytest.fixture
+def journal(knobs):
+    root.common.telemetry.enabled = True
+    telemetry.reset()
+    yield telemetry
+    telemetry.reset()
+
+
+def _burn_events(tel):
+    return [e for e in tel.journal_events()
+            if e.get("kind") == "slo.burn"]
+
+
+def test_burn_event_fires_once_per_crossing(journal):
+    clock = FakeClock(10000.0)
+    t = tracker(clock)
+    # drive both windows over threshold 2.0: each bad request at 99%
+    # target gives burn = bad/total/0.01
+    t.record("m", 200, 1.0)
+    for i in range(5):
+        t.record("m", 500, 1.0, rid="bad-%d" % i)
+    events = _burn_events(journal)
+    assert len(events) == 1, events
+    ev = events[0]
+    assert ev["model"] == "m"
+    assert ev["burn_fast"] >= 2.0 and ev["burn_slow"] >= 2.0
+    assert ev["threshold"] == 2.0
+    # the exemplar rid points at a bad request's trace
+    assert str(ev["exemplar_rid"]).startswith("bad-")
+    # staying over the threshold fires NOTHING further
+    for i in range(5):
+        t.record("m", 500, 1.0, rid="more-%d" % i)
+    assert len(_burn_events(journal)) == 1
+
+
+def test_burn_event_refires_after_recovery(journal):
+    clock = FakeClock(20000.0)
+    t = tracker(clock)
+    for _ in range(5):
+        t.record("m", 500, 1.0)
+    assert len(_burn_events(journal)) == 1
+    # recovery: the fast window (10 s) forgets the incident while
+    # healthy traffic dominates -> burning latch clears
+    clock.advance(15.0)
+    for _ in range(50):
+        t.record("m", 200, 1.0)
+    assert t.status()["models"]["m"]["burning"] is False
+    # a second incident 60+ s later (slow window clean again) fires
+    # a SECOND event — crossings are edges, not levels
+    clock.advance(120.0)
+    for _ in range(5):
+        t.record("m", 500, 1.0)
+    assert len(_burn_events(journal)) == 2
+
+
+def test_status_shape_and_knob_echo(knobs):
+    t = tracker(FakeClock())
+    t.record("m", 200, 1.0)
+    st = t.status()
+    assert st["enabled"] is True
+    assert st["slo_ms"] == 100.0
+    assert st["target_pct"] == 99.0
+    assert st["windows_s"] == {"fast": 10.0, "slow": 60.0}
+    assert st["burn_threshold"] == 2.0
+
+
+def test_disabled_gate_is_one_predicate(knobs, monkeypatch):
+    """The HTTP front end checks slo.enabled() before touching the
+    tracker; with the knob off the gate is False and a booby-trapped
+    tracker is never reached (the monkeypatch-boom discipline)."""
+    root.common.serving.slo_enabled = False
+    assert slo.enabled() is False
+
+    def boom(*a, **k):
+        raise AssertionError("disabled path touched the SLO tracker")
+
+    monkeypatch.setattr(slo.SloTracker, "record", boom)
+    # the gate alone decides — nothing else runs
+    if slo.enabled():
+        slo.SloTracker().record("m", 200, 1.0)

@@ -136,6 +136,64 @@ def test_prometheus_exposition_format(tel):
         tel.parse_prometheus("not a metric line at all!")
 
 
+def test_prometheus_help_precedes_type_for_every_series(tel):
+    """ISSUE 14 satellite: every exported series carries a # HELP line
+    immediately ahead of its # TYPE line."""
+    tel.counter("loader.minibatches").inc()
+    tel.gauge("serving.queue_depth").set(2)
+    tel.histogram("serving.request_seconds").observe(0.01)
+    tel.counter("some.unregistered_family").inc()
+    lines = tel.prometheus_text().splitlines()
+    types = [(i, ln.split()[2]) for i, ln in enumerate(lines)
+             if ln.startswith("# TYPE ")]
+    assert types, "no TYPE lines at all"
+    for i, name in types:
+        assert i > 0, "TYPE without a preceding HELP"
+        prev = lines[i - 1]
+        assert prev.startswith("# HELP %s " % name), \
+            "no HELP ahead of TYPE for %s (got %r)" % (name, prev)
+        help_text = prev[len("# HELP %s " % name):]
+        assert help_text.strip(), "empty HELP for %s" % name
+    # registered families carry their registered one-liner; unknown
+    # families still get the generic fallback
+    text = "\n".join(lines)
+    assert "# HELP znicz_loader_minibatches minibatch loader" in text
+    assert "# HELP znicz_some_unregistered_family znicz_tpu " \
+           "telemetry series (family some)" in text
+    # the exposition still validates end to end
+    tel.parse_prometheus(text)
+
+
+def test_prometheus_help_longest_prefix_and_register(tel):
+    # the longest dotted prefix wins: a labeled request-latency series
+    # inherits its family help, not the generic "serving" line
+    assert tel.help_for("serving.request_seconds.model_x") == \
+        tel.help_for("serving.request_seconds")
+    assert tel.help_for("serving.request_seconds") != \
+        tel.help_for("serving.someother")
+    tel.register_help("serving.custom", "my custom family")
+    assert tel.help_for("serving.custom.bucket_4") == \
+        "my custom family"
+
+
+def test_prometheus_escaping_conforms(tel):
+    """Label values escape backslash, double quote and line feed;
+    HELP text escapes backslash and line feed — the exposition-format
+    escaping rules, pinned."""
+    assert tel.escape_label_value('a\\b\n"c') == 'a\\\\b\\n\\"c'
+    assert tel.escape_label_value("plain") == "plain"
+    assert tel.escape_help("a\\b\nc") == "a\\\\b\\nc"
+    assert tel.escape_help('keeps "quotes"') == 'keeps "quotes"'
+    # a help string with a newline must not break the line protocol
+    tel.register_help("loader", "line one\nline two")
+    tel.counter("loader.minibatches").inc()
+    text = tel.prometheus_text()
+    assert "# HELP znicz_loader_minibatches line one\\nline two" \
+        in text
+    tel.parse_prometheus(text)
+    tel.register_help("loader", "minibatch loader pipeline")
+
+
 # -- disabled-by-default fast path ------------------------------------------
 
 def test_noop_mode_records_nothing():

@@ -1,0 +1,168 @@
+"""Metric time-series sampler (znicz_tpu/core/timeseries.py,
+ISSUE 14): ring math via injectable timestamps — zero sleeps — plus
+the disabled-by-default zero-overhead pin."""
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import telemetry, timeseries
+
+
+@pytest.fixture
+def ts():
+    """Telemetry + timeseries ON with clean registries; both wiped
+    and the gate restored after (conftest restores telemetry)."""
+    saved = {k: root.common.telemetry.timeseries.get(k)
+             for k in ("enabled", "interval_ms", "capacity",
+                       "prefixes")}
+    root.common.telemetry.enabled = True
+    root.common.telemetry.timeseries.enabled = True
+    telemetry.reset()
+    timeseries.reset()
+    yield timeseries
+    timeseries.reset()
+    telemetry.reset()
+    for k, v in saved.items():
+        setattr(root.common.telemetry.timeseries, k, v)
+
+
+# -- the disabled fast path --------------------------------------------------
+
+def test_disabled_sampler_touches_nothing(monkeypatch):
+    """The zero-overhead-off pin: with the gate off, sample_once and
+    maybe_start return before touching the telemetry registry or
+    starting a thread — a booby-trapped snapshot() proves it."""
+    root.common.telemetry.timeseries.enabled = False
+
+    def boom(*a, **k):
+        raise AssertionError("disabled sampler touched telemetry")
+
+    monkeypatch.setattr(telemetry, "snapshot", boom)
+    assert timeseries.sample_once() == 0
+    assert timeseries.maybe_start() is False
+    assert timeseries.series_names() == []
+
+
+# -- sampling ----------------------------------------------------------------
+
+def test_sample_records_counters_gauges_and_quantiles(ts):
+    telemetry.counter("serving.batches").inc(3)
+    telemetry.gauge("serving.queue_depth").set(7)
+    for v in (0.01, 0.02, 0.03):
+        telemetry.histogram("serving.request_seconds").observe(v)
+    assert ts.sample_once(now=100.0) > 0
+    assert ts.points("serving.batches") == [(100.0, 3.0)]
+    assert ts.points("serving.queue_depth") == [(100.0, 7.0)]
+    # histograms land as their percentile sub-series
+    assert len(ts.points("serving.request_seconds.p50")) == 1
+    assert len(ts.points("serving.request_seconds.p99")) == 1
+    names = ts.series_names()
+    assert "serving.batches" in names
+    assert "serving.request_seconds.p99" in names
+
+
+def test_prefix_filter_is_curated(ts):
+    root.common.telemetry.timeseries.prefixes = "serving"
+    telemetry.counter("serving.batches").inc()
+    telemetry.counter("workflow.runs").inc()
+    ts.sample_once(now=50.0)
+    assert ts.points("serving.batches")
+    assert ts.points("workflow.runs") == []
+
+
+def test_ring_capacity_bounds_points(ts):
+    root.common.telemetry.timeseries.capacity = 4
+    c = telemetry.counter("serving.batches")
+    for i in range(10):
+        c.inc()
+        ts.sample_once(now=100.0 + i)
+    pts = ts.points("serving.batches")
+    assert len(pts) == 4
+    # oldest dropped first: the ring keeps the LAST 4 sweeps
+    assert [t for t, _ in pts] == [106.0, 107.0, 108.0, 109.0]
+
+
+# -- rate / windowed-delta math ----------------------------------------------
+
+def test_rate_and_delta_hand_computed(ts):
+    c = telemetry.counter("serving.batches")
+    c.inc(10)
+    ts.sample_once(now=100.0)
+    c.inc(30)
+    ts.sample_once(now=104.0)
+    # 30 increments over 4 s
+    assert ts.rate("serving.batches") == pytest.approx(7.5)
+    assert ts.windowed_delta("serving.batches") == pytest.approx(30.0)
+
+
+def test_rate_honors_the_trailing_window(ts):
+    c = telemetry.counter("serving.batches")
+    values = ((100.0, 0), (110.0, 100), (112.0, 120), (114.0, 140))
+    total = 0
+    for t, v in values:
+        c.inc(v - total)
+        total = v
+        ts.sample_once(now=t)
+    # whole ring: 140 over 14 s = 10/s
+    assert ts.rate("serving.batches") == pytest.approx(10.0)
+    # trailing 5 s (points at 110/112/114): 40 over 4 s = 10... no:
+    # (140-100)/(114-110) = 10.0; trailing 3 s (112, 114): 20/2
+    assert ts.rate("serving.batches", window_s=5.0) == \
+        pytest.approx(10.0)
+    assert ts.rate("serving.batches", window_s=3.0) == \
+        pytest.approx(10.0)
+    assert ts.windowed_delta("serving.batches", window_s=3.0) == \
+        pytest.approx(20.0)
+
+
+def test_rate_needs_two_points(ts):
+    telemetry.counter("serving.batches").inc()
+    ts.sample_once(now=100.0)
+    assert ts.rate("serving.batches") is None
+    assert ts.windowed_delta("serving.batches") is None
+    assert ts.rate("serving.never_sampled") is None
+
+
+# -- the /debug/timeseries payload -------------------------------------------
+
+def test_snapshot_payload_shape(ts):
+    c = telemetry.counter("serving.batches")
+    c.inc(4)
+    ts.sample_once(now=100.0)
+    c.inc(4)
+    ts.sample_once(now=102.0)
+    telemetry.gauge("serving.inflight").set(1)
+    ts.sample_once(now=103.0)
+    snap = ts.snapshot()
+    assert snap["enabled"] is True
+    assert snap["sweeps"] == 3
+    s = snap["series"]["serving.batches"]
+    assert s["kind"] == "counter"
+    assert s["points"][0] == [100.0, 4.0]
+    assert s["points"][-1] == [103.0, 8.0]
+    # per-counter trailing rate: 4 over the 100->103 span
+    assert snap["rates"]["serving.batches"] == pytest.approx(4 / 3.0)
+    # gauges carry no rate (a last-write-wins level has no "per sec")
+    assert "serving.inflight" not in snap["rates"]
+
+
+def test_sampler_thread_lifecycle(ts):
+    """maybe_start is idempotent and stop() retires the thread; the
+    rings survive a stop (history outlives the sampler)."""
+    root.common.telemetry.timeseries.interval_ms = 5.0
+    assert ts.maybe_start() is True
+    assert ts.maybe_start() is True  # second call: same thread
+    telemetry.counter("serving.batches").inc()
+    ts.stop()
+    # manual sweeps still work after the thread retired
+    ts.sample_once(now=500.0)
+    assert ts.points("serving.batches")
+
+
+def test_sweeps_meter_on_telemetry(ts):
+    telemetry.counter("serving.batches").inc()
+    ts.sample_once(now=1.0)
+    ts.sample_once(now=2.0)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["timeseries.sweeps"] == 2
+    assert snap["gauges"]["timeseries.series"] >= 1

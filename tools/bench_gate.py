@@ -89,7 +89,14 @@ GATED_INVERSE = ("serving_loadgen_p99_ms",
                  "serving_tail_p99_ms",
                  "serving_tail_cold_bucket_p99_ms",
                  "serving_tail_evict_restore_p99_ms",
-                 "serving_tail_breaker_probe_p99_ms")
+                 "serving_tail_breaker_probe_p99_ms",
+                 # the SLO observability plane's measured cost
+                 # (ISSUE 14): armed sampler+tracing+SLO vs disabled
+                 # on the same HTTP mix (bench.py stamps it floored
+                 # at 1.0 so an honest ~zero never reads as the
+                 # crash-guard zero) — a plane that got expensive
+                 # fails the round like a latency regression
+                 "serving_observability_overhead_pct")
 
 
 def _payload(doc):
@@ -254,10 +261,27 @@ def selftest(threshold=0.10):
              serving_f32_batch1_requests_per_sec=1000.0 * 0.95,
              serving_tail_p99_ms=2.0 * (1.0 + threshold)),
         tail_old, threshold)
+    # the SLO-plane overhead gate (ISSUE 14), proven on a synthetic
+    # round: a large overhead RISE and a zero (crash-guard) stamp must
+    # both fail; small wobble passes (inverted gating — the plane's
+    # cost is a latency-style number)
+    obs_old = {"serving_observability_overhead_pct": 2.0}
+    ob_rise, _ = compare(
+        dict(obs_old, serving_observability_overhead_pct=2.0 *
+             (1.0 + 2 * threshold) * 2.0),
+        obs_old, threshold)
+    ob_zero, _ = compare(
+        dict(obs_old, serving_observability_overhead_pct=0.0),
+        obs_old, threshold)
+    ob_wobble, _ = compare(
+        dict(obs_old, serving_observability_overhead_pct=2.0 *
+             (1.0 + threshold)),
+        obs_old, threshold)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
             or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
-            or tl_drop or tl_p99_up or tl_gone or not tl_wobble:
+            or tl_drop or tl_p99_up or tl_gone or not tl_wobble \
+            or ob_rise or ob_zero or not ob_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
@@ -266,12 +290,14 @@ def selftest(threshold=0.10):
               "dtype_drop_rejected=%s dtype_vanished_rejected=%s "
               "dtype_wobble_passed=%s tail_batch1_drop_rejected=%s "
               "tail_p99_rise_rejected=%s tail_vanished_rejected=%s "
-              "tail_wobble_passed=%s"
+              "tail_wobble_passed=%s obs_rise_rejected=%s "
+              "obs_zero_rejected=%s obs_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
                  not dt_gone, dt_wobble, not tl_drop, not tl_p99_up,
-                 not tl_gone, tl_wobble))
+                 not tl_gone, tl_wobble, not ob_rise, not ob_zero,
+                 ob_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
@@ -279,8 +305,9 @@ def selftest(threshold=0.10):
           "zero-stamp rejected, serving wobble passes; per-dtype "
           "int8 drop and vanished bf16 key rejected, dtype wobble "
           "passes; tail batch-1 req/s drop, steady-p99 rise and "
-          "vanished scenario-p99 key rejected, tail wobble passes "
-          "(threshold %.0f%%)"
+          "vanished scenario-p99 key rejected, tail wobble passes; "
+          "SLO-plane overhead rise and zero-stamp rejected, "
+          "overhead wobble passes (threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))
     return 0
 

@@ -7,6 +7,7 @@ Usage::
     python tools/profile_summary.py --journal <events.jsonl> # black box
     python tools/profile_summary.py --roofline <report.json> # cost registry
     python tools/profile_summary.py --ledger <report.json>   # memory ledger
+    python tools/profile_summary.py --timeseries <ts.json>   # /debug rings
 
 Input kinds, dispatched on the argument:
 
@@ -41,6 +42,12 @@ Input kinds, dispatched on the argument:
 * ``--ledger <file.json>`` renders the device-memory ledger from the
   same inputs: live/high-water bytes, alloc/free counts, the balance
   invariant, and the per-Array-name attribution table.
+
+* ``--timeseries <file.json>`` renders a saved ``GET
+  /debug/timeseries`` payload (``core/timeseries.py``): per-series
+  point counts, first→last span, last value, min/max and the
+  trailing per-second rate for counters — the over-time view of the
+  metric registry.
 """
 
 import collections
@@ -373,15 +380,55 @@ def summarize_ledger(path):
     return "\n".join(lines)
 
 
+def summarize_timeseries(path):
+    """Markdown view of a ``GET /debug/timeseries`` payload: one row
+    per ring with span, last value, min/max and (counters) the
+    trailing per-second rate."""
+    doc = _load_report(path)
+    series = doc.get("series") or {}
+    if not series:
+        raise SystemExit("no time-series rings in %s (is "
+                         "root.common.telemetry.timeseries.enabled "
+                         "on?)" % path)
+    rates = doc.get("rates") or {}
+    lines = ["timeseries: %s  (%d series, %s sweeps, interval %s ms)"
+             % (path, len(series), doc.get("sweeps", "?"),
+                doc.get("interval_ms", "?")), ""]
+    lines.append("| series | kind | points | span (s) | last "
+                 "| min | max | rate/s |")
+    lines.append("|---|---|---|---|---|---|---|---|")
+    for name in sorted(series):
+        s = series[name]
+        pts = s.get("points") or []
+        if pts:
+            span = pts[-1][0] - pts[0][0]
+            values = [p[1] for p in pts]
+            last, lo, hi = values[-1], min(values), max(values)
+        else:
+            span = last = lo = hi = None
+
+        def f(v):
+            return "%.6g" % v if isinstance(v, (int, float)) else "-"
+
+        rate = rates.get(name)
+        lines.append("| `%s` | %s | %d | %s | %s | %s | %s | %s |"
+                     % (name[:48], s.get("kind", "?"), len(pts),
+                        f(span), f(last), f(lo), f(hi),
+                        f(rate) if rate is not None else "-"))
+    return "\n".join(lines)
+
+
 if __name__ == "__main__":
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
-    if sys.argv[1] in ("--journal", "--roofline", "--ledger"):
+    if sys.argv[1] in ("--journal", "--roofline", "--ledger",
+                       "--timeseries"):
         if len(sys.argv) < 3:
             raise SystemExit(__doc__)
         mode = {"--journal": summarize_journal,
                 "--roofline": summarize_roofline,
-                "--ledger": summarize_ledger}[sys.argv[1]]
+                "--ledger": summarize_ledger,
+                "--timeseries": summarize_timeseries}[sys.argv[1]]
         print(mode(sys.argv[2]))
         sys.exit(0)
     target = sys.argv[1]

@@ -37,6 +37,22 @@ concurrent traffic:
 * the ``tools/accuracy_delta.py`` CLI holds its tolerance assertion
   against the same snapshot.
 
+**Act 5 — the serving SLO plane (ISSUE 14):** the wine registry under
+mixed healthy + injected-fault traffic with the whole observability
+plane armed (SLO tracking + per-request trace sampling + the metric
+time-series sampler):
+
+* healthy traffic leaves the error budget full; the injected-fault
+  phase (deterministic ``serving.forward`` faults with retries
+  disabled → real 500s) makes ``GET /slo`` show the budget
+  DECREASING and burn rates over the threshold,
+* an ``slo.burn`` journal event lands in the flight recorder,
+  carrying a bad request's rid as the trace exemplar,
+* a sampled request's trace tree is retrievable by rid at
+  ``GET /debug/trace/<rid>`` with all six span kinds,
+* ``GET /debug/timeseries`` is non-empty and its counter rates agree
+  with the registry's own deltas.
+
 **Act 4 — the batch-1 latency fast path (ISSUE 12):** the SAME wine
 snapshot served strict (f32) and fast (f32-fast) behind one registry:
 
@@ -59,6 +75,7 @@ import os
 import sys
 import tempfile
 import threading
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -176,6 +193,7 @@ def main():
     registry_smoke(tmp, snapshot)
     precision_smoke(snapshot)
     latency_smoke(snapshot)
+    slo_smoke(snapshot)
 
 
 def _second_model_package(tmp):
@@ -479,6 +497,144 @@ def latency_smoke(snapshot):
               % (N_REQUESTS, worst, tol, identical))
     finally:
         server.stop()
+
+
+def slo_smoke(snapshot):
+    """Act 5: the serving SLO plane under injected faults (ISSUE 14).
+    """
+    from znicz_tpu.core import faults, timeseries
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+
+    telemetry.reset()
+    timeseries.reset()
+    cfg = root.common.serving
+    saved = {k: cfg.get(k) for k in
+             ("slo_enabled", "slo_target_pct", "slo_fast_window_s",
+              "slo_slow_window_s", "slo_burn_threshold",
+              "trace_sample_n", "breaker_threshold")}
+    saved_retry = root.common.retry.get("attempts")
+    saved_ts = root.common.telemetry.timeseries.get("enabled")
+    registry = ModelRegistry(models={"wine": snapshot},
+                             max_batch=MAX_BATCH)
+    # arm the whole plane: tight windows + a 90% target so the fault
+    # phase crosses the burn threshold within a handful of requests;
+    # breaker off (an open bucket would turn injected 500s into 503s
+    # and stop dispatching — this act measures SLO accounting, not
+    # the breaker); retries off so every injected fault surfaces
+    cfg.slo_enabled = True
+    cfg.slo_target_pct = 90.0
+    cfg.slo_fast_window_s = 30.0
+    cfg.slo_slow_window_s = 120.0
+    cfg.slo_burn_threshold = 1.5
+    cfg.trace_sample_n = 1
+    cfg.breaker_threshold = 0
+    root.common.retry.attempts = 0
+    root.common.telemetry.timeseries.enabled = True
+    root.common.telemetry.timeseries.interval_ms = 100.0
+    server = ServingServer(registry=registry).start()
+    url = "http://127.0.0.1:%d" % server.port
+    r = numpy.random.RandomState(77)
+
+    def predict(rid, expect_ok=True):
+        body = json.dumps(
+            {"inputs": r.uniform(-1, 1, (1, 13)).tolist()}).encode()
+        req = urllib.request.Request(
+            url + "/predict/wine", body,
+            {"Content-Type": "application/json",
+             "X-Request-Id": rid})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+                return resp.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+
+    try:
+        n_ok = 24
+        for i in range(n_ok):
+            code = predict("slo-ok-%d" % i)
+            assert code == 200, "healthy request answered %d" % code
+            if i == 0:
+                # deterministic ring coverage: the smoke's traffic can
+                # outrun the 100 ms background cadence, so bracket it
+                # with manual sweeps (the thread's own points merge in)
+                timeseries.sample_once()
+        with urllib.request.urlopen(url + "/slo", timeout=30) as resp:
+            healthy = json.loads(resp.read())
+        wine0 = healthy["models"]["wine"]
+        assert wine0["good"] == n_ok and wine0["bad"] == 0, wine0
+        assert wine0["error_budget_remaining"] == 1.0, wine0
+        # fault phase: every dispatch raises (retries disabled) ->
+        # real 500s the budget must pay for
+        faults.enable()
+        faults.install("serving.forward", kind="xla", every=1)
+        n_bad = 8
+        for i in range(n_bad):
+            code = predict("slo-bad-%d" % i)
+            assert code == 500, "faulted request answered %d" % code
+        faults.clear()
+        faults.disable()
+        with urllib.request.urlopen(url + "/slo", timeout=30) as resp:
+            burned = json.loads(resp.read())
+        wine = burned["models"]["wine"]
+        assert wine["bad"] == n_bad, wine
+        assert wine["error_budget_remaining"] < \
+            wine0["error_budget_remaining"], \
+            "budget did not decrease: %s" % wine
+        assert wine["burn_rate"]["fast"] > burned["burn_threshold"], \
+            wine
+        # the burn event landed in the flight recorder, exemplar rid
+        # attached
+        burns = [e for e in telemetry.journal_events()
+                 if e.get("kind") == "slo.burn"]
+        assert burns, "no slo.burn journal event after fault phase"
+        assert burns[-1]["model"] == "wine"
+        assert str(burns[-1].get("exemplar_rid", "")).startswith(
+            "slo-bad-"), burns[-1]
+        # a sampled request's trace tree is retrievable by rid with
+        # all six span kinds
+        with urllib.request.urlopen(url + "/debug/trace/slo-ok-3",
+                                    timeout=30) as resp:
+            tree = json.loads(resp.read())
+        assert tree["complete"], tree
+        assert set(tree["span_kinds"]) == {
+            "admission", "queue_wait", "assembly", "dispatch",
+            "device", "reply"}, tree["span_kinds"]
+        # the time-series rings are live and agree with the registry:
+        # a fresh sweep's last point must equal the counter's own
+        # value, and the ring-wide rate is a real number
+        assert predict("slo-ts") == 200
+        timeseries.sample_once()
+        ts = timeseries.snapshot()
+        assert ts["series"], "empty /debug/timeseries payload"
+        pts = ts["series"]["serving.batches"]["points"]
+        assert pts[-1][1] == float(
+            telemetry.counter("serving.batches").value), \
+            "timeseries ring disagrees with the live counter"
+        assert (timeseries.rate("serving.batches") or 0) > 0
+        with urllib.request.urlopen(url + "/debug/timeseries",
+                                    timeout=30) as resp:
+            http_ts = json.loads(resp.read())
+        assert http_ts["series"], "HTTP /debug/timeseries empty"
+        print("slo smoke OK: %d healthy + %d faulted requests, "
+              "budget %.3f -> %.3f, burn fast %.1f (threshold %.1f), "
+              "slo.burn exemplar %s, trace tree complete (6 kinds, "
+              "wall %.1f ms), %d timeseries series"
+              % (n_ok, n_bad, wine0["error_budget_remaining"],
+                 wine["error_budget_remaining"],
+                 wine["burn_rate"]["fast"], burned["burn_threshold"],
+                 burns[-1].get("exemplar_rid"), tree["wall_ms"],
+                 len(http_ts["series"])))
+    finally:
+        server.stop()
+        timeseries.reset()
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+        root.common.retry.attempts = saved_retry
+        root.common.telemetry.timeseries.enabled = saved_ts
+        faults.clear()
+        faults.disable()
 
 
 if __name__ == "__main__":

@@ -64,7 +64,11 @@ import re
 #: ONLY with a reviewed family prefix (each series is a /metrics entry)
 SERIES_PREFIXES = frozenset((
     "analysis", "faults", "health", "jax", "launcher", "loader",
-    "memory", "profiler", "registry", "serving", "snapshotter",
+    "memory", "profiler", "registry", "serving",
+    # the serving SLO plane (ISSUE 14): per-model good/total,
+    # burn-rate and error-budget series (serving/slo.py) and the
+    # time-series sampler's own meters (core/timeseries.py)
+    "slo", "snapshotter", "timeseries",
     "trainer", "transfer", "unit", "workflow",
 ))
 
@@ -120,6 +124,14 @@ GATED_MODULES = {
     "znicz_tpu/analysis/locksmith.py": {
         "gates": ("enabled",),
         "required": ("lock", "rlock", "condition"),
+    },
+    "znicz_tpu/core/timeseries.py": {
+        "gates": ("enabled",),
+        "required": ("sample_once", "maybe_start"),
+    },
+    "znicz_tpu/serving/reqtrace.py": {
+        "gates": ("enabled", "sampled"),
+        "required": ("begin",),
     },
 }
 

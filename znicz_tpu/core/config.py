@@ -236,6 +236,20 @@ declare("common", {
         "trace_capacity": 65536,    # span ring-buffer size (events)
         "histogram_window": 2048,   # percentile reservoir per series
         "journal_capacity": 4096,   # flight-recorder ring (events)
+        # metric time-series (core/timeseries.py) — a background
+        # sampler snapshotting selected counters/gauges/histogram
+        # percentiles into bounded timestamped rings, served at
+        # GET /debug/timeseries.  Off by default; when off the sampler
+        # thread never starts and every hook is ONE config predicate.
+        "timeseries": {
+            "enabled": False,
+            "interval_ms": 1000.0,  # sampling period
+            "capacity": 512,        # points retained per series
+            # comma-separated family prefixes worth a history (every
+            # matching counter/gauge gets a ring; histograms record
+            # their p50/p99) — keep it a bounded curated set
+            "prefixes": "serving,slo,jax,trainer,transfer,loader",
+        },
     },
     # numeric training-health monitor (core/health.py) — off by default;
     # when off every check site is a single predicate with ZERO device
@@ -334,6 +348,26 @@ declare("common", {
         # latency SLO used by tools/loadgen.py goodput accounting and
         # stamped by bench.py --serving
         "slo_ms": 100.0,
+        # server-side SLO tracking (serving/slo.py): per-model
+        # good/total accounting against slo_ms measured from request
+        # admission, Google-SRE multi-window burn rates and an
+        # error-budget-remaining gauge — the feed for /slo, the
+        # /statusz slo block and the future autoscaler.  Off by
+        # default; when off the HTTP front end pays ONE predicate.
+        "slo_enabled": False,
+        "slo_target_pct": 99.0,     # availability target: good/total
+        "slo_fast_window_s": 60.0,  # fast burn window (page-now)
+        "slo_slow_window_s": 600.0,  # slow burn window (budget window)
+        "slo_burn_threshold": 2.0,  # both windows over this -> one
+                                    # slo.burn journal event (edge-
+                                    # triggered with hysteresis)
+        # per-request trace trees (serving/reqtrace.py): head-sample
+        # every Nth admitted request into a rid-keyed span tree
+        # (admission/queue_wait/assembly/dispatch/device/reply),
+        # retrievable at GET /debug/trace/<rid>.  0 = off (the
+        # default); when off every hook is ONE config predicate.
+        "trace_sample_n": 0,
+        "trace_capacity": 256,      # sampled trace trees retained
     },
     # persistent XLA compilation cache (core/compile_cache.py) — the
     # serving cold-start story: executables compile once per cluster,

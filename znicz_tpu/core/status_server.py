@@ -15,7 +15,11 @@ a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
 * ``/debug/profile?seconds=N`` — on-demand ``jax.profiler`` capture
   (core/profiler.py; returns the trace directory),
 * ``/debug/profiler`` — the performance-introspection report (cost
-  registry, device-memory ledger, step-time breakdown).
+  registry, device-memory ledger, step-time breakdown),
+* ``/debug/timeseries`` — the in-process metric time-series rings
+  (core/timeseries.py),
+* ``/debug/trace/<rid>`` — sampled per-request span trees
+  (znicz_tpu/serving/reqtrace.py).
 
 The HTTP plumbing (handler ``_send`` helpers, daemon-thread lifecycle,
 idempotent ``stop()``) lives in :class:`HttpServerBase` /
@@ -139,10 +143,40 @@ class HandlerBase(BaseHTTPRequestHandler):
           ``root.common.profiler.capture_seconds_cap``) and reply with
           the trace directory; 409 while another capture runs,
         * ``GET /debug/profiler`` — the performance-introspection
-          report (cost registry, memory ledger, step breakdown).
+          report (cost registry, memory ledger, step breakdown),
+        * ``GET /debug/timeseries`` — the in-process metric
+          time-series rings + trailing rates
+          (``core/timeseries.py``; 404-style empty when disabled),
+        * ``GET /debug/trace`` / ``GET /debug/trace/<rid>`` — the
+          sampled per-request span trees
+          (``znicz_tpu/serving/reqtrace.py``).
 
         Returns True when the request was handled."""
         path, _, query = self.path.partition("?")
+        if path == "/debug/timeseries":
+            from znicz_tpu.core import timeseries
+            self._send_json(200, timeseries.snapshot())
+            return True
+        if path == "/debug/trace" or path.startswith("/debug/trace/"):
+            from znicz_tpu.serving import reqtrace
+            rid = path[len("/debug/trace/"):] \
+                if path.startswith("/debug/trace/") else ""
+            if not rid:
+                self._send_json(200, {
+                    "enabled": reqtrace.enabled(),
+                    "rids": reqtrace.rids()})
+                return True
+            tree = reqtrace.get(rid)
+            if tree is None:
+                self._send_json(404, {
+                    "error": "no sampled trace for rid %r (sampling "
+                             "%s; see root.common.serving."
+                             "trace_sample_n)"
+                             % (rid, "on" if reqtrace.enabled()
+                                else "off")})
+                return True
+            self._send_json(200, tree)
+            return True
         if path == "/debug/health":
             from znicz_tpu.core import health
             st = health.status()
@@ -220,6 +254,12 @@ class HttpServerBase(Logger):
                 target=self._httpd.serve_forever,
                 name=type(self).__name__.lower(), daemon=True)
             self._thread.start()
+        # arm the metric time-series sampler when its knob is on —
+        # every HTTP surface (status dashboard, serving front end)
+        # serves /debug/timeseries, so the server lifecycle is the one
+        # natural arming point (a no-op single predicate when off)
+        from znicz_tpu.core import timeseries
+        timeseries.maybe_start()
         self.info("%s on http://%s:%d/", type(self).__name__,
                   self.host, self.port)
         return self

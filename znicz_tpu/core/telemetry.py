@@ -253,15 +253,18 @@ def export_trace(path):
 # ---------------------------------------------------------------------------
 
 def journal_enabled():
-    """The flight recorder records when telemetry, the health monitor
-    OR the fault-injection registry is on — a health-only run still
-    wants its black box, and a chaos run must journal what it injected
-    and how recovery went."""
+    """The flight recorder records when telemetry, the health monitor,
+    the fault-injection registry OR the serving SLO tracker is on — a
+    health-only run still wants its black box, a chaos run must
+    journal what it injected and how recovery went, and an SLO-only
+    run must land its ``slo.burn`` threshold crossings."""
     if _cfg.get("enabled", False):
         return True
     if root.common.health.get("enabled", False):
         return True
-    return bool(root.common.faults.get("enabled", False))
+    if root.common.faults.get("enabled", False):
+        return True
+    return bool(root.common.serving.get("slo_enabled", False))
 
 
 def record_event(kind, **fields):
@@ -654,14 +657,95 @@ def _prom_name(name):
     return "znicz_" + s
 
 
+#: help-string registry: one-liner per series FAMILY, keyed by the
+#: longest-matching dotted prefix of the (pre-sanitization) series
+#: name.  Emitted as ``# HELP`` ahead of every ``# TYPE`` line of the
+#: exposition; modules owning a family register theirs via
+#: :func:`register_help` (serving/slo.py, core/timeseries.py)
+_HELP = {
+    "analysis": "static/runtime analysis layer (graftlint, locksmith)",
+    "faults": "deterministic fault injection (core/faults.py)",
+    "health": "numeric training-health monitor (core/health.py)",
+    "jax.backend_compiles": "XLA backend compilations",
+    "jax.compile_seconds": "XLA backend compile wall time",
+    "jax.traces": "jaxpr traces (re-traces mean a missing jit cache)",
+    "jax.trace_seconds": "jaxpr trace wall time",
+    "jax.persistent_cache_hits":
+        "persistent compilation-cache hits (core/compile_cache.py)",
+    "jax.persistent_cache_misses": "persistent compilation-cache "
+                                   "misses",
+    "launcher": "supervised-restart lifecycle (launcher.py)",
+    "loader": "minibatch loader pipeline",
+    "memory": "device-memory ledger (core/profiler.py)",
+    "profiler": "performance introspection (core/profiler.py)",
+    "registry": "multi-model registry lifecycle "
+                "(serving/registry.py)",
+    "serving.request_seconds": "end-to-end request latency "
+                               "(admission to reply)",
+    "serving.queue_wait_seconds": "time queued before a dispatch "
+                                  "slot took the request",
+    "serving.assembly_seconds": "batch concatenation time",
+    "serving.device_seconds": "engine dispatch time per request",
+    "serving.batch_rows": "coalesced rows per dispatch",
+    "serving.batch_fill": "coalesced rows over the dispatched bucket",
+    "serving.pad_overhead": "padding fraction of the dispatched "
+                            "bucket",
+    "serving.tail_seconds": "per-scenario batch-1 tail latency "
+                            "(serving/latency.py)",
+    "serving": "online inference serving tier (znicz_tpu/serving/)",
+    "snapshotter": "snapshot export/restore (core/snapshotter.py)",
+    "trainer": "fused training control plane",
+    "transfer": "host<->device transfer meters",
+    "unit": "unit-graph execution",
+    "workflow": "workflow lifecycle",
+}
+
+
+def register_help(prefix, text):
+    """Register (or override) the one-line help for a series-family
+    prefix — the ``# HELP`` text every series under it exports."""
+    _HELP[str(prefix)] = str(text)
+    return prefix
+
+
+def help_for(name):
+    """The registered help for a dotted series name: longest dotted
+    prefix wins; a generic family fallback guarantees every exported
+    series carries a ``# HELP`` line."""
+    parts = name.split(".")
+    for i in range(len(parts), 0, -1):
+        text = _HELP.get(".".join(parts[:i]))
+        if text is not None:
+            return text
+    return "znicz_tpu telemetry series (family %s)" % parts[0]
+
+
+def escape_help(text):
+    """Escape a ``# HELP`` string per the Prometheus text exposition
+    format: backslash and line feed."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value):
+    """Escape a label VALUE per the exposition format: backslash,
+    double quote and line feed (in that order — escaping the quote
+    first would double-escape the added backslashes)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prometheus_text():
     """Prometheus text exposition (format version 0.0.4) of the whole
-    registry — what ``/metrics`` serves."""
+    registry — what ``/metrics`` serves.  Every series family gets a
+    ``# HELP`` line ahead of its ``# TYPE`` (the help-string registry
+    above; :func:`register_help` extends it)."""
     with _lock:
         metrics = sorted(_metrics.values(), key=lambda m: m.name)
     lines = []
     for m in metrics:
         name = _prom_name(m.name)
+        lines.append("# HELP %s %s"
+                     % (name, escape_help(help_for(m.name))))
         if m.kind == "counter":
             lines.append("# TYPE %s counter" % name)
             lines.append("%s %s" % (name, m.value))
@@ -680,7 +764,8 @@ def prometheus_text():
             for bound, c in zip(m.buckets, bucket_counts):
                 acc += c
                 lines.append('%s_bucket{le="%s"} %d'
-                             % (name, _fmt(bound), acc))
+                             % (name, escape_label_value(_fmt(bound)),
+                                acc))
             acc += bucket_counts[-1]
             lines.append('%s_bucket{le="+Inf"} %d' % (name, acc))
             lines.append("%s_sum %s" % (name, _fmt(total)))

@@ -1,0 +1,261 @@
+"""Metric time-series — bounded in-process history of the registry.
+
+PR 1's telemetry registry (:mod:`znicz_tpu.core.telemetry`) is
+*cumulative*: ``/metrics`` answers "how many so far", never "how fast
+right now".  The serving autoscaler direction (ROADMAP item 2) and any
+operator staring at a tail-latency incident need the **over-time**
+view: queue depth a minute ago, the request rate across the last
+burn window, whether a counter spiked when the journal says a breaker
+opened.  This module keeps that history in process:
+
+* a background **sampler** (daemon thread, period
+  ``root.common.telemetry.timeseries.interval_ms``) snapshots every
+  counter/gauge whose family matches the curated ``prefixes`` knob —
+  plus the ``p50``/``p99`` of matching histograms — into bounded
+  timestamped rings (``capacity`` points per series, oldest drop
+  first);
+* **query helpers** — :func:`rate` (per-second increase of a counter
+  over a trailing window) and :func:`windowed_delta` (absolute
+  increase) — the exact quantities a burn-rate alert or an autoscaler
+  consumes;
+* ``GET /debug/timeseries`` on every ``HandlerBase`` server (status
+  dashboard AND serving front end) serves :func:`snapshot`;
+  ``tools/profile_summary.py --timeseries`` renders a saved payload.
+
+Disabled-by-default discipline (the health.py contract): everything
+gates on ``root.common.telemetry.timeseries.enabled``.  When off,
+:func:`maybe_start` returns without touching anything, the thread
+never exists, and no ring is ever allocated — the standing cost is ONE
+config predicate (pinned by a monkeypatch-boom test).  Tests drive
+:func:`sample_once` directly with an injectable ``now`` so the math is
+checkable with zero sleeps.
+"""
+
+import collections
+import threading
+import time
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
+
+#: the config node (stable object identity — config.py declares it)
+_cfg = root.common.telemetry.timeseries
+
+_lock = locksmith.lock("timeseries.registry")
+
+telemetry.register_help(
+    "timeseries", "metric time-series sampler (core/timeseries.py): "
+                  "sweeps completed and series ring count")
+
+#: name -> _Series; created lazily per sampled series
+_series = {}
+
+_thread = None
+_stop = threading.Event()
+
+#: monotonic count of completed sampler sweeps (tests + /debug view)
+_sweeps = 0
+
+
+def enabled():
+    """The one gate — a live read of
+    ``root.common.telemetry.timeseries.enabled``."""
+    return bool(_cfg.get("enabled", False))
+
+
+def enable(**overrides):
+    for k, v in overrides.items():
+        setattr(root.common.telemetry.timeseries, k, v)
+    root.common.telemetry.timeseries.enabled = True
+    return True
+
+
+def disable():
+    root.common.telemetry.timeseries.enabled = False
+    return False
+
+
+class _Series(object):
+    """One bounded timestamped ring: (unix_seconds, value) points."""
+
+    __slots__ = ("name", "kind", "points")
+
+    def __init__(self, name, kind, capacity):
+        self.name = name
+        self.kind = kind
+        self.points = collections.deque(maxlen=capacity)
+
+
+def _prefixes():
+    raw = _cfg.get("prefixes",
+                   "serving,slo,jax,trainer,transfer,loader")
+    return tuple(p.strip() for p in str(raw).split(",") if p.strip())
+
+
+def _wanted(name, prefixes):
+    return name.split(".")[0] in prefixes
+
+
+def sample_once(now=None):
+    """One sampler sweep: append the current value of every selected
+    counter/gauge (and matching histograms' p50/p99) to its ring.
+    Returns the number of series touched (0 when the gate is off —
+    the disabled path reads ONE predicate and nothing else)."""
+    if not enabled():
+        return 0
+    snap = telemetry.snapshot()
+    t = float(now if now is not None else time.time())
+    prefixes = _prefixes()
+    cap = int(_cfg.get("capacity", 512))
+    touched = 0
+    with _lock:
+        for kind_key, kind in (("counters", "counter"),
+                               ("gauges", "gauge")):
+            for name, value in snap[kind_key].items():
+                if not _wanted(name, prefixes):
+                    continue
+                s = _series.get(name)
+                if s is None:
+                    s = _series[name] = _Series(name, kind, cap)
+                s.points.append((t, float(value)))
+                touched += 1
+        for name, st in snap["histograms"].items():
+            if not _wanted(name, prefixes) or not st.get("count"):
+                continue
+            for q in ("p50", "p99"):
+                if st.get(q) is None:
+                    continue
+                qname = "%s.%s" % (name, q)
+                s = _series.get(qname)
+                if s is None:
+                    s = _series[qname] = _Series(qname, "quantile", cap)
+                s.points.append((t, float(st[q])))
+                touched += 1
+    global _sweeps
+    _sweeps += 1
+    if telemetry.enabled():
+        telemetry.counter("timeseries.sweeps").inc()
+        telemetry.gauge("timeseries.series").set(len(_series))
+    return touched
+
+
+def _run():
+    while not _stop.is_set():
+        if not enabled():
+            return  # gate flipped off: the thread retires itself
+        try:
+            sample_once()
+        except Exception:  # noqa: BLE001 - a sampler must never die
+            pass
+        _stop.wait(float(_cfg.get("interval_ms", 1000.0)) / 1e3)
+
+
+def maybe_start():
+    """Start the background sampler iff the gate is on and no thread
+    runs (idempotent; called by ``HttpServerBase.start`` so arming the
+    knob before a server starts is all an operator does).  Returns
+    True when a sampler is running after the call."""
+    if not enabled():
+        return False
+    global _thread
+    with _lock:
+        if _thread is not None and _thread.is_alive():
+            return True
+        _stop.clear()
+        _thread = threading.Thread(target=_run, name="timeseries",
+                                   daemon=True)
+        _thread.start()
+    return True
+
+
+def stop():
+    """Stop the sampler thread (keeps the collected rings)."""
+    global _thread
+    with _lock:
+        thread, _thread = _thread, None
+    _stop.set()
+    if thread is not None:
+        thread.join(timeout=5)
+    _stop.clear()
+
+
+def reset():
+    """Drop every ring and the sweep count (tests, bench isolation)."""
+    global _sweeps
+    stop()
+    with _lock:
+        _series.clear()
+    _sweeps = 0
+
+
+def series_names():
+    with _lock:
+        return sorted(_series)
+
+
+def points(name):
+    """The (t, value) points of one series, oldest first."""
+    with _lock:
+        s = _series.get(name)
+        return list(s.points) if s is not None else []
+
+
+def _window_points(pts, window_s, now=None):
+    if not pts:
+        return []
+    if window_s is None:
+        return pts
+    horizon = float(now if now is not None else pts[-1][0]) \
+        - float(window_s)
+    return [p for p in pts if p[0] >= horizon]
+
+
+def windowed_delta(name, window_s=None, now=None):
+    """Absolute increase of ``name`` across the trailing ``window_s``
+    seconds (whole ring when None).  None with fewer than two points
+    in the window — no delta is not a zero delta."""
+    pts = _window_points(points(name), window_s, now)
+    if len(pts) < 2:
+        return None
+    return pts[-1][1] - pts[0][1]
+
+
+def rate(name, window_s=None, now=None):
+    """Per-second increase of a counter series over the trailing
+    window (the PromQL ``rate()`` analogue on the in-process rings).
+    None with fewer than two points or zero elapsed time."""
+    pts = _window_points(points(name), window_s, now)
+    if len(pts) < 2:
+        return None
+    dt = pts[-1][0] - pts[0][0]
+    if dt <= 0:
+        return None
+    return (pts[-1][1] - pts[0][1]) / dt
+
+
+def snapshot(window_s=None):
+    """The JSON payload ``GET /debug/timeseries`` serves: every ring's
+    points plus per-counter trailing rates (over ``window_s``, whole
+    ring when None) — directly renderable by
+    ``tools/profile_summary.py --timeseries``."""
+    with _lock:
+        items = [(s.name, s.kind, list(s.points))
+                 for s in _series.values()]
+    out = {"enabled": enabled(), "sweeps": _sweeps,
+           "interval_ms": float(_cfg.get("interval_ms", 1000.0)),
+           "series": {}, "rates": {}}
+    for name, kind, pts in sorted(items):
+        out["series"][name] = {
+            "kind": kind, "points": [[round(t, 3), v] for t, v in pts]}
+        if kind == "counter" and len(pts) >= 2:
+            dt = pts[-1][0] - pts[0][0]
+            if dt > 0:
+                win = [p for p in pts
+                       if window_s is None
+                       or p[0] >= pts[-1][0] - window_s]
+                if len(win) >= 2 and win[-1][0] > win[0][0]:
+                    out["rates"][name] = round(
+                        (win[-1][1] - win[0][1])
+                        / (win[-1][0] - win[0][0]), 6)
+    return out

@@ -24,7 +24,13 @@ Three pillars (docs/serving.md):
   :mod:`znicz_tpu.serving.accuracy` — the low-precision data path
   (f32 / bf16 / int8 per-channel weight quantization) and its
   measured per-bucket accuracy-delta harness (docs/serving.md
-  "Precision modes").
+  "Precision modes");
+* :mod:`znicz_tpu.serving.slo` /
+  :mod:`znicz_tpu.serving.reqtrace` — the serving SLO plane
+  (docs/observability.md "SLO plane & request traces"): per-model
+  error budgets + multi-window burn rates fed from request admission
+  (``GET /slo``), and head-sampled per-request span trees
+  (``GET /debug/trace/<rid>``).
 """
 
 from znicz_tpu.serving.engine import (  # noqa: F401 - re-export
@@ -40,11 +46,12 @@ from znicz_tpu.serving.continuous import (  # noqa: F401 - re-export
     ContinuousBatcher)
 from znicz_tpu.serving.registry import (  # noqa: F401 - re-export
     ModelRegistry, UnknownModelError)
+from znicz_tpu.serving.slo import SloTracker  # noqa: F401
 from znicz_tpu.serving.server import ServingServer  # noqa: F401
 
 __all__ = ["InferenceEngine", "MicroBatcher", "ContinuousBatcher",
            "ModelRegistry", "UnknownModelError", "ServingServer",
            "BatcherStoppedError", "QueueFullError",
            "RequestTimeoutError", "default_buckets",
-           "CircuitBreaker", "CircuitOpenError",
+           "CircuitBreaker", "CircuitOpenError", "SloTracker",
            "SERVING_DTYPES", "normalize_dtype"]

@@ -47,6 +47,7 @@ from znicz_tpu.core import telemetry
 from znicz_tpu.analysis import locksmith
 import numpy
 
+from znicz_tpu.serving import reqtrace
 from znicz_tpu.serving.batcher import (_DISPATCH_GRACE, _Request,
                                        BatcherStoppedError,
                                        QueueFullError,
@@ -99,8 +100,15 @@ class ContinuousBatcher(Logger):
         self._threads = []
         self._inflight = 0
         #: request-id propagation is opt-in by signature (the
-        #: micro-batcher's rule): cached per model name — engines
-        #: persist across reloads, so the answer is stable
+        #: micro-batcher's rule): cached per model name as
+        #: (WEAK ref to the resolved target, answer).  The target
+        #: rides along so the cache invalidates itself when the model
+        #: is REPLACED (registry remove + re-add, or a swapped plain
+        #: callable) — a negative probe must not outlive the engine
+        #: it probed.  Weak, because a strong ref would pin a REMOVED
+        #: model's engine (and its device buffers) for the batcher's
+        #: lifetime, breaking registry.remove()'s free-with-the-last-
+        #: reference contract
         self._rid_aware = {}
 
     # -- model resolution ---------------------------------------------------
@@ -369,15 +377,30 @@ class ContinuousBatcher(Logger):
             span_attrs = {"rows": rows, "requests": len(live)}
             if model is not None:
                 span_attrs["model"] = model
-            rid_aware = self._rid_aware.get(model)
-            if rid_aware is None:
+            cached = self._rid_aware.get(model)
+            if cached is None or cached[0]() is not engine:
+                # probe (or RE-probe after a model replace: the
+                # resolved engine object changed — or was collected —
+                # so a cached negative from the old generation's
+                # callable must not stick to an rid-aware successor)
                 import inspect
+                import weakref
                 try:
                     rid_aware = "request_ids" in \
                         inspect.signature(predict).parameters
                 except (TypeError, ValueError):
                     rid_aware = False
-                self._rid_aware[model] = rid_aware
+                try:
+                    ref = weakref.ref(engine)
+                except TypeError:
+                    # non-weakrefable target (exotic callable): a
+                    # dead ref each dispatch just re-probes — correct,
+                    # merely unmemoized for that target
+                    def ref():
+                        return None
+                self._rid_aware[model] = (ref, rid_aware)
+            else:
+                rid_aware = cached[1]
             with telemetry.span("serving.batch", **span_attrs):
                 t_dev = time.monotonic()
                 if rid_aware:
@@ -418,6 +441,7 @@ class ContinuousBatcher(Logger):
                     "serving.queue_wait_seconds", model=model))
         slow_ms = float(root.common.serving.get("slow_request_ms",
                                                 1000.0) or 0.0)
+        tracing = reqtrace.enabled()
         offset = 0
         for r in live:
             total = done - r.arrived
@@ -429,6 +453,15 @@ class ContinuousBatcher(Logger):
                 if m_latency is not None:
                     m_latency.observe(total)
                     m_queue_wait.observe(waited)
+            if tracing and r.rid and reqtrace.sampled(r.rid):
+                # the batcher's legs of the sampled span tree — the
+                # device leg lands inside dispatch via the engine
+                reqtrace.add_span(r.rid, "queue_wait", r.arrived, now)
+                reqtrace.add_span(r.rid, "assembly", t_asm,
+                                  t_asm + asm_dt)
+                reqtrace.add_span(r.rid, "dispatch", t_dev,
+                                  t_dev + dev_dt, rows=rows,
+                                  requests=len(live), bucket=bucket)
             if slow_ms > 0.0 and total * 1e3 > slow_ms:
                 self.warning(
                     "slow request%s: total %.1f ms (queue %.1f ms, "
@@ -443,7 +476,12 @@ class ContinuousBatcher(Logger):
                     queue_ms=round(waited * 1e3, 3),
                     assembly_ms=round(asm_dt * 1e3, 3),
                     device_ms=round(dev_dt * 1e3, 3),
-                    rows=r.rows, batch_rows=rows, bucket=bucket)
+                    rows=r.rows, batch_rows=rows, bucket=bucket,
+                    # the rid doubles as a trace exemplar when this
+                    # request was head-sampled (/debug/trace/<rid>)
+                    trace_sampled=bool(
+                        tracing and r.rid
+                        and reqtrace.sampled(r.rid)))
             # resolve LAST: the caller's view of the trace must already
             # be complete when it wakes
             r.future.set_result(

@@ -55,6 +55,7 @@ the flight recorder as ``serving.reload`` events.
 import json
 import os
 import threading
+import time
 import zipfile
 
 import numpy
@@ -64,7 +65,7 @@ from znicz_tpu.core.logger import Logger
 from znicz_tpu.core import faults
 from znicz_tpu.core import telemetry
 from znicz_tpu.analysis import locksmith
-from znicz_tpu.serving import quant
+from znicz_tpu.serving import quant, reqtrace
 
 
 def default_buckets(max_batch):
@@ -941,6 +942,7 @@ class InferenceEngine(Logger):
         # raise may sit between allow() and the try
         probe_slot = breaker.allow() if breaker is not None else False
         try:
+            t_fwd0 = time.monotonic()
             if not telemetry.enabled():
                 y = numpy.asarray(_forward())[:n]
             else:
@@ -956,6 +958,7 @@ class InferenceEngine(Logger):
                 # /metrics); named engines carry the model label
                 telemetry.counter(self._label(
                     "serving.predictions", bucket=bucket)).inc()
+            t_fwd1 = time.monotonic()
         except (ValueError, TypeError):
             # shape/dtype errors surfacing at trace time are the
             # CLIENT's fault (server.py maps them to 400) — no evidence
@@ -979,6 +982,15 @@ class InferenceEngine(Logger):
             raise
         if breaker is not None:
             breaker.record_success()
+        if request_ids and reqtrace.enabled():
+            # the device leg of the sampled span trees: the jitted
+            # executable's run (retries included), nested inside the
+            # batcher's dispatch span.  A coalesced batch's requests
+            # share the dispatch, so each sampled rid gets the span
+            for r in request_ids:
+                if reqtrace.sampled(r):
+                    reqtrace.add_span(r, "device", t_fwd0, t_fwd1,
+                                      bucket=bucket, rows=n)
         if first:
             m.warm.add(bucket)
             if telemetry.enabled():

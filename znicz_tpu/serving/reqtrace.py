@@ -1,0 +1,224 @@
+"""Per-request trace trees — the rid-stitched view of one prediction.
+
+PR 3 gave every request an ``X-Request-Id`` and *aggregate* breakdown
+histograms (queue wait / assembly / device p50s); what no surface
+answered was "where did THIS request's 480 ms go?".  This module
+stitches the existing rid propagation (HTTP front end →
+MicroBatcher/ContinuousBatcher → engine) into a real span tree per
+**head-sampled** request:
+
+* ``admission`` — HTTP receipt → batcher submission (parse, routing,
+  readiness checks);
+* ``queue_wait`` — queued until a dispatch slot took the request;
+* ``assembly`` — batch concatenation (shared by the coalesced batch);
+* ``dispatch`` — the engine call as the batcher saw it (padding,
+  breaker admission, retries included);
+* ``device`` — the jitted executable run inside the engine (nested in
+  ``dispatch``);
+* ``reply`` — future resolution → response bytes on the socket.
+
+The five non-overlapping kinds (everything but the nested ``device``)
+partition the request's wall time — the functional test pins
+parts-sum ≈ wall.  Sampling is by admission count: every
+``root.common.serving.trace_sample_n``-th request gets a tree (1 =
+all, 0 = off, the default); trees live in a bounded ring
+(``trace_capacity``), retrievable as ``GET /debug/trace/<rid>`` on
+both servers (the payload carries the span list AND a
+``traceEvents`` block in the telemetry Chrome-trace schema, loadable
+at ui.perfetto.dev).  Slow-request journal events carry their rid as
+the exemplar to look up here; ``slo.burn`` events do the same.
+
+Gate discipline: every hook guards with :func:`enabled` — ONE config
+predicate — and an unsampled rid costs one dict lookup.  When off,
+nothing allocates (monkeypatch-boom pinned).
+"""
+
+import collections
+import time
+
+from znicz_tpu.core.config import root
+from znicz_tpu.analysis import locksmith
+
+_cfg = root.common.serving
+
+#: the six span kinds of a complete tree (device nests in dispatch)
+SPAN_KINDS = ("admission", "queue_wait", "assembly", "dispatch",
+              "device", "reply")
+
+#: the non-overlapping kinds whose durations partition the wall time
+TOP_LEVEL_KINDS = ("admission", "queue_wait", "assembly", "dispatch",
+                   "reply")
+
+_lock = locksmith.lock("serving.reqtrace")
+#: rid -> _Trace, insertion-ordered (the bounded ring)
+_traces = collections.OrderedDict()
+#: admissions seen since process start — the head-sampling cursor
+_admissions = 0
+
+
+def enabled():
+    """The one gate every hook checks — a live read of
+    ``root.common.serving.trace_sample_n``."""
+    return int(_cfg.get("trace_sample_n", 0) or 0) > 0
+
+
+def enable(sample_n=1):
+    root.common.serving.trace_sample_n = int(sample_n)
+    return True
+
+
+def disable():
+    root.common.serving.trace_sample_n = 0
+    return False
+
+
+class _Trace(object):
+    __slots__ = ("rid", "model", "t0", "t_end", "spans")
+
+    def __init__(self, rid, t0):
+        self.rid = rid
+        self.model = None
+        self.t0 = t0
+        self.t_end = None
+        self.spans = []
+
+
+def begin(rid, now=None):
+    """Head-sample one admission: every ``trace_sample_n``-th call
+    creates a tree for ``rid``.  Returns True when this rid was
+    sampled (the caller then owns closing it via :func:`finish`).
+
+    Request ids come from clients, so reuse is normal (a retry
+    resends its ``X-Request-Id``): a FINISHED tree under the same rid
+    is replaced (newest wins — the rid is the lookup key), but a
+    still-LIVE tree is never clobbered — the in-flight request's
+    remaining spans must not land on a stranger's timeline."""
+    if not enabled():
+        return False
+    n = int(_cfg.get("trace_sample_n", 0) or 0)
+    if n <= 0 or not rid:
+        return False
+    cap = int(_cfg.get("trace_capacity", 256) or 256)
+    t0 = float(now if now is not None else time.monotonic())
+    global _admissions
+    with _lock:
+        _admissions += 1
+        if (_admissions - 1) % n:
+            return False
+        live = _traces.get(rid)
+        if live is not None and live.t_end is None:
+            return False
+        _traces.pop(rid, None)  # replace a finished tree IN ORDER
+        _traces[rid] = _Trace(rid, t0)
+        while len(_traces) > cap:
+            _traces.popitem(last=False)
+    return True
+
+
+def sampled(rid):
+    """Is ``rid`` a LIVE sampled trace?  One dict lookup — cheap
+    enough for the per-request guards in the batchers/engine.  A
+    finished tree answers False: a later request reusing the rid (a
+    client retry) must not append spans — timed against the old
+    tree's origin — to the stored result."""
+    if rid is None:
+        return False
+    with _lock:
+        tr = _traces.get(rid)
+        return tr is not None and tr.t_end is None
+
+
+def add_span(rid, kind, t0, t1, **attrs):
+    """Record one span on ``rid``'s tree (no-op for unsampled rids
+    and for trees already closed by :func:`finish` — see
+    :func:`sampled`).  ``t0``/``t1`` are ``time.monotonic()`` stamps
+    — the same clock every component uses, so spans stitch across
+    threads."""
+    if kind not in SPAN_KINDS:
+        raise ValueError("unknown span kind %r (known: %s)"
+                         % (kind, ", ".join(SPAN_KINDS)))
+    with _lock:
+        tr = _traces.get(rid)
+        if tr is None or tr.t_end is not None:
+            return False
+        tr.spans.append((kind, float(t0), float(t1),
+                         attrs or None))
+    return True
+
+
+def set_model(rid, model):
+    with _lock:
+        tr = _traces.get(rid)
+        if tr is not None and model is not None:
+            tr.model = model
+
+
+def finish(rid, now=None, model=None):
+    """Close the tree (stamps the total wall time)."""
+    t = float(now if now is not None else time.monotonic())
+    with _lock:
+        tr = _traces.get(rid)
+        if tr is None:
+            return False
+        tr.t_end = t
+        if model is not None:
+            tr.model = model
+    return True
+
+
+def rids():
+    """Sampled rids, newest first (the /debug/trace index)."""
+    with _lock:
+        return list(reversed(_traces))
+
+
+def get(rid):
+    """The span tree for ``rid`` (None when unsampled/evicted):
+    relative-millisecond spans, completeness verdict, and a
+    ``traceEvents`` block in the telemetry Chrome-trace schema."""
+    with _lock:
+        tr = _traces.get(rid)
+        if tr is None:
+            return None
+        spans = list(tr.spans)
+        t0, t_end, model = tr.t0, tr.t_end, tr.model
+    out_spans = []
+    events = []
+    kinds = set()
+    for kind, s0, s1, attrs in sorted(spans, key=lambda s: s[1]):
+        kinds.add(kind)
+        span = {"kind": kind,
+                "start_ms": round((s0 - t0) * 1e3, 3),
+                "duration_ms": round((s1 - s0) * 1e3, 3)}
+        if attrs:
+            span["attrs"] = attrs
+        out_spans.append(span)
+        ev = {"name": kind, "ph": "X", "cat": "znicz.request",
+              "ts": round((s0 - t0) * 1e6, 3),
+              "dur": round((s1 - s0) * 1e6, 3),
+              "pid": 0, "tid": 0}
+        if attrs:
+            ev["args"] = attrs
+        events.append(ev)
+    wall_ms = (round((t_end - t0) * 1e3, 3)
+               if t_end is not None else None)
+    parts_ms = round(sum(s["duration_ms"] for s in out_spans
+                         if s["kind"] in TOP_LEVEL_KINDS), 3)
+    return {
+        "rid": rid,
+        "model": model,
+        "complete": kinds >= set(SPAN_KINDS) and t_end is not None,
+        "span_kinds": sorted(kinds),
+        "wall_ms": wall_ms,
+        "parts_ms": parts_ms,
+        "spans": out_spans,
+        "traceEvents": events,
+    }
+
+
+def reset():
+    """Drop every trace and the sampling cursor (tests)."""
+    global _admissions
+    with _lock:
+        _traces.clear()
+        _admissions = 0

@@ -50,13 +50,20 @@ Endpoints:
 * ``GET /metrics`` — the telemetry registry in Prometheus text format
   (per-model series carry ``model_<name>`` labels).
 * ``GET /statusz`` (and ``/``) — JSON serving stats (registry + queue
-  + compile-cache blocks).
+  + compile-cache + slo blocks).
+* ``GET /slo`` — the server-side SLO plane
+  (:mod:`znicz_tpu.serving.slo`, behind
+  ``root.common.serving.slo_enabled``): per-model good/total from
+  request admission, fast/slow-window burn rates, error budget
+  remaining — the feed the autoscaler consumes.
 * ``GET /debug/health`` / ``GET /debug/events`` /
-  ``GET /debug/profile?seconds=N`` / ``GET /debug/profiler`` — the
+  ``GET /debug/profile?seconds=N`` / ``GET /debug/profiler`` /
+  ``GET /debug/timeseries`` / ``GET /debug/trace/<rid>`` — the
   health monitor status, the flight-recorder journal, on-demand
-  ``jax.profiler`` capture and the performance-introspection report
-  (shared ``HandlerBase`` endpoints — same contract as the training
-  status server).
+  ``jax.profiler`` capture, the performance-introspection report,
+  the in-process metric time-series rings and the sampled
+  per-request span trees (shared ``HandlerBase`` endpoints — same
+  contract as the training status server).
 
 CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
 
@@ -75,6 +82,7 @@ import argparse
 import io
 import json
 import math
+import time
 import uuid
 
 import numpy
@@ -83,6 +91,7 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.status_server import (BodyTooLargeError, HandlerBase,
                                           HttpServerBase)
 from znicz_tpu.core import compile_cache, telemetry
+from znicz_tpu.serving import reqtrace, slo
 from znicz_tpu.serving.batcher import (BatcherStoppedError, MicroBatcher,
                                        QueueFullError,
                                        RequestTimeoutError)
@@ -131,6 +140,11 @@ class ServingServer(HttpServerBase):
         #: balancers stop routing here while in-flight work flushes
         self._draining = False
         self._drained = False
+        #: server-side SLO plane (serving/slo.py): per-model
+        #: good/total accounting from request admission, burn rates,
+        #: error budgets — fed by _predict behind the slo.enabled()
+        #: gate, served at GET /slo and the /statusz slo block
+        self.slo = slo.SloTracker()
 
     def stop(self):
         super(ServingServer, self).stop()
@@ -175,6 +189,8 @@ class ServingServer(HttpServerBase):
             payload = dict(self.engine.stats())
             payload["compile_cache"] = compile_cache.stats()
         payload["queued_rows"] = self.batcher.queued_rows
+        if slo.enabled():
+            payload["slo"] = self.slo.status()
         if telemetry.enabled():
             serving = telemetry.serving_summary()
             if serving is not None:
@@ -246,7 +262,30 @@ class ServingServer(HttpServerBase):
         return rid[:64] if rid else uuid.uuid4().hex[:12]
 
     def _predict(self, handler, model=None):
+        """One /predict request: the inner handler answers it; this
+        wrapper measures the SLO clock from ADMISSION (queue time,
+        batching, dispatch — everything the client experiences), opens
+        the sampled trace tree, and feeds the per-model SLO tracker
+        with the final status code (serving/slo.py accounting rules:
+        429/503/504/500 and over-SLO 200s burn the budget; 400-class
+        client faults do not)."""
         rid = self._request_id(handler)
+        t_admit = time.monotonic()
+        traced = reqtrace.enabled() and reqtrace.begin(rid,
+                                                       now=t_admit)
+        code, slo_model = self._predict_inner(handler, rid, model,
+                                              t_admit, traced)
+        if traced:
+            reqtrace.finish(rid, model=slo_model)
+        if slo.enabled():
+            self.slo.record(slo_model, code,
+                            (time.monotonic() - t_admit) * 1e3,
+                            rid=rid)
+
+    def _predict_inner(self, handler, rid, model, t_admit, traced):
+        """The /predict state machine; returns ``(status_code,
+        model_name)`` for the SLO/trace wrapper after the reply went
+        out."""
         echo = {"X-Request-Id": rid}
         if self._draining:
             # graceful shutdown: honest fast 503 so the balancer
@@ -256,7 +295,7 @@ class ServingServer(HttpServerBase):
                 503, {"error": "server draining", "ready": False,
                       "request_id": rid},
                 headers=dict(echo, **{"Retry-After": "1"}))
-            return
+            return 503, model
         try:
             inputs, timeout_ms, raw, body_model = \
                 self._parse_predict(handler)
@@ -265,24 +304,29 @@ class ServingServer(HttpServerBase):
             # close in _read_body — answer honestly and drop the socket
             handler._send_json(413, {"error": str(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 413, model
         except Exception as e:  # noqa: BLE001 - client error
             handler._send_json(400, {"error": repr(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 400, model
         # the URL path segment wins over the body's "model" field
         model = model if model is not None else body_model
+        slo_model = model
         try:
             engine = self._engine_for(model)
+            if slo_model is None and self.registry is not None:
+                # the default model carries its real name in the SLO
+                # accounting — budgets are per model, not per route
+                slo_model = self.registry.default
         except UnknownModelError as e:
             handler._send_json(404, {"error": str(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 404, slo_model
         if not engine.ready:
             handler._send_json(503, {"error": "model warming up",
                                      "ready": False, "model": model,
                                      "request_id": rid}, headers=echo)
-            return
+            return 503, slo_model
         try:
             # parse straight into the routed model's compute dtype — a
             # float64 intermediate would cost a second full-batch copy
@@ -291,8 +335,13 @@ class ServingServer(HttpServerBase):
         except Exception as e:  # noqa: BLE001 - client error
             handler._send_json(400, {"error": repr(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 400, slo_model
         try:
+            if traced:
+                # admission span: HTTP receipt -> batcher submission
+                # (parse + routing + readiness checks)
+                reqtrace.add_span(rid, "admission", t_admit,
+                                  time.monotonic())
             if self._routed_batcher:
                 y = self.batcher.predict(x, model=model,
                                          timeout_ms=timeout_ms,
@@ -304,7 +353,7 @@ class ServingServer(HttpServerBase):
             # the model was removed between resolution and dispatch
             handler._send_json(404, {"error": str(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 404, slo_model
         except BatcherStoppedError:
             # the submit raced drain()/stop(): same honest 503 the
             # pre-admission _draining check produces
@@ -312,15 +361,15 @@ class ServingServer(HttpServerBase):
                 503, {"error": "server draining", "ready": False,
                       "request_id": rid},
                 headers=dict(echo, **{"Retry-After": "1"}))
-            return
+            return 503, slo_model
         except QueueFullError as e:
             handler._send_json(429, {"error": str(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 429, slo_model
         except RequestTimeoutError as e:
             handler._send_json(504, {"error": str(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 504, slo_model
         except CircuitOpenError as e:
             # circuit breaking: the bucket's dispatch path is known-bad
             # — reject fast with the cooldown as the Retry-After hint
@@ -331,18 +380,19 @@ class ServingServer(HttpServerBase):
                 headers=dict(echo, **{
                     "Retry-After":
                         str(max(1, int(math.ceil(e.retry_after))))}))
-            return
+            return 503, slo_model
         except (ValueError, TypeError) as e:
             # shape/dtype mismatches surface at trace time as
             # ValueError/TypeError — the client's fault, not ours
             handler._send_json(400, {"error": str(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 400, slo_model
         except Exception as e:  # noqa: BLE001 - always answer HTTP
             self.warning("predict %s failed: %r", rid, e)
             handler._send_json(500, {"error": repr(e),
                                      "request_id": rid}, headers=echo)
-            return
+            return 500, slo_model
+        t_reply = time.monotonic()
         if raw:
             buf = io.BytesIO()
             numpy.save(buf, numpy.ascontiguousarray(y))
@@ -357,6 +407,10 @@ class ServingServer(HttpServerBase):
             if y.ndim == 2:
                 payload["argmax"] = [int(i) for i in y.argmax(axis=1)]
             handler._send_json(200, payload, headers=echo)
+        if traced:
+            # reply span: future resolved -> response bytes written
+            reqtrace.add_span(rid, "reply", t_reply, time.monotonic())
+        return 200, slo_model
 
     def _reload(self, handler, model=None):
         try:
@@ -474,6 +528,10 @@ class ServingServer(HttpServerBase):
                             "default": "default"})
                 elif path == "/metrics":
                     self._send_metrics()
+                elif path == "/slo":
+                    # the error-budget feed (serving/slo.py) — the
+                    # payload the ROADMAP item-2 autoscaler consumes
+                    self._send_json(200, server.slo.status())
                 elif path in ("/", "/statusz"):
                     self._send_json(200, server.statusz())
                 elif self._handle_debug():
