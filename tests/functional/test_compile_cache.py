@@ -161,6 +161,57 @@ def test_mixed_dtype_registry_warm_restart_zero_fresh_compiles(
     assert second["digest"] == first["digest"]
 
 
+def test_fleet_scale_up_shares_cache_zero_fresh_compiles(tmp_path):
+    """ISSUE 15 fleet pin: a router-driven SCALE-UP reuses the
+    fleet's shared compile-cache directory — the first replica
+    cold-compiles its warmup ladder into the cache, and the replica
+    ``scale_up()`` spawns reaches ready with ZERO fresh compiles
+    (every warmup "compile" is a persistent-cache load), making
+    autoscaling spin-up nearly free."""
+    import urllib.request
+
+    from znicz_tpu.serving.router import FleetRouter
+    from znicz_tpu.testing import build_fc_package_zip
+
+    zip_path = build_fc_package_zip(tmp_path / "fleet_model.zip",
+                                    [4, 8, 3], seed=5)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    router = FleetRouter(
+        ["m=" + str(zip_path), "--max-batch", "8"],
+        replicas=1, compile_cache_dir=str(tmp_path / "xla_cache"),
+        env=env).start()
+
+    def compile_counters(replica):
+        with urllib.request.urlopen(replica.url + "/metrics",
+                                    timeout=30) as resp:
+            text = resp.read().decode()
+        out = {}
+        for line in text.splitlines():
+            for name in ("znicz_jax_backend_compiles",
+                         "znicz_jax_persistent_cache_hits"):
+                if line.startswith(name + " "):
+                    out[name] = float(line.split()[-1])
+        return (out.get("znicz_jax_backend_compiles", 0.0),
+                out.get("znicz_jax_persistent_cache_hits", 0.0))
+
+    try:
+        first = router.replicas()[0]
+        compiles1, hits1 = compile_counters(first)
+        # the cold replica REALLY compiled (the pin means something)
+        assert compiles1 - hits1 > 0
+        second = router.scale_up()
+        compiles2, hits2 = compile_counters(second)
+        # the scale-up replica's entire warmup deserialized from the
+        # shared cache: zero fresh compiles
+        assert compiles2 > 0
+        assert compiles2 == hits2, (compiles2, hits2)
+        assert router.up_count() == 2
+    finally:
+        router.stop()
+
+
 def test_watch_counts_fresh_compiles_not_cache_loads():
     """fresh = backend_compiles - persistent_cache_hits: the installed
     jax ticks backend_compiles around the whole compile-OR-load step,

@@ -1,0 +1,310 @@
+"""The multi-replica serving fleet over REAL subprocesses (ISSUE 15):
+least-outstanding routing, aggregated operator surfaces, retry safety
+(kill a replica mid-dispatch → honest 503, NO duplicate dispatch),
+dead-replica ejection with safe peer retry, and the scale-down
+graceful drain losing zero in-flight requests.
+
+Every fleet here spawns real ``python -m znicz_tpu serve`` replica
+processes behind a :class:`~znicz_tpu.serving.router.FleetRouter`, so
+the tests exercise the same process topology production runs."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from znicz_tpu.serving.router import DEAD, FleetRouter
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENV = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+MAX_BATCH = 8
+N_IN, N_OUT = 6, 3
+
+
+def _synth_zip(directory):
+    """A tiny deterministic FC package (6 → 8 → 3): fast replica
+    warmup, deterministic outputs — replies are bit-identical no
+    matter which replica answers."""
+    from znicz_tpu.testing import build_fc_package_zip
+    return build_fc_package_zip(os.path.join(directory, "synth.zip"),
+                                [N_IN, 8, N_OUT], seed=42)
+
+
+def _predict(url, x, rid=None, model="m", priority=None,
+             timeout=60):
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    if priority:
+        headers["X-Priority"] = priority
+    req = urllib.request.Request(
+        url + "/predict/" + model,
+        json.dumps({"inputs": numpy.asarray(x).tolist()}).encode(),
+        headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(
+            resp.headers)
+
+
+def _get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _x(seed, rows=2):
+    return numpy.random.RandomState(seed).uniform(
+        -1.0, 1.0, (rows, N_IN))
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """One shared 2-replica fleet (SLO tracking armed on the
+    replicas) for the read-mostly tests."""
+    tmp = tmp_path_factory.mktemp("fleet")
+    router = FleetRouter(
+        ["m=" + _synth_zip(str(tmp)), "--max-batch", str(MAX_BATCH),
+         "--config", "common.serving.slo_enabled=True"],
+        replicas=2, compile_cache_dir=str(tmp / "cache"),
+        env=ENV).start()
+    url = "http://127.0.0.1:%d" % router.port
+    yield router, url
+    router.stop()
+
+
+def test_routing_balances_and_echoes_rid(fleet):
+    router, url = fleet
+    served0 = {r.rid: r.served for r in router.replicas()}
+    for i in range(8):
+        code, doc, headers = _predict(url, _x(i), rid="route-%d" % i)
+        assert code == 200
+        assert doc["model"] == "m"
+        assert len(doc["outputs"]) == 2
+        assert headers["X-Request-Id"] == "route-%d" % i
+    served = [r.served - served0[r.rid] for r in router.replicas()]
+    # least-outstanding with rotating ties: sequential traffic splits
+    # evenly across the two replicas
+    assert sorted(served) == [4, 4], served
+
+
+def test_replies_bit_identical_across_replicas(fleet):
+    """The fleet is homogeneous: the same request answered twice
+    (landing on BOTH replicas by rotation) returns bit-identical
+    outputs."""
+    _, url = fleet
+    x = _x(99)
+    replies = [_predict(url, x)[1]["outputs"] for _ in range(4)]
+    for other in replies[1:]:
+        assert other == replies[0]
+
+
+def test_priority_rides_through_the_router(fleet):
+    _, url = fleet
+    code, doc, _ = _predict(url, _x(1), priority="high")
+    assert code == 200
+    # an unknown priority is the replica's 400, relayed verbatim
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _predict(url, _x(1), priority="hgih")
+    assert err.value.code == 400
+    assert "unknown priority" in err.value.read().decode()
+
+
+def test_aggregated_surfaces_match_per_replica_sums(fleet):
+    router, url = fleet
+    for i in range(6):
+        assert _predict(url, _x(200 + i))[0] == 200
+    replicas = [r for r in router.replicas() if r.state == "up"]
+    health = _get(url, "/healthz")
+    assert health["ready"] is True and health["fleet"] is True
+    assert health["replicas_up"] == len(replicas) == 2
+    models = _get(url, "/models")
+    assert "m" in models["models"]
+    assert models["fleet"]["replicas_up"] == 2
+    # /metrics: the aggregated exposition is the per-series SUM
+    def counter(text, name):
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.split()[-1])
+        return 0.0
+    with urllib.request.urlopen(url + "/metrics",
+                                timeout=30) as resp:
+        agg = resp.read().decode()
+    per_replica = []
+    for r in replicas:
+        with urllib.request.urlopen(r.url + "/metrics",
+                                    timeout=30) as resp:
+            per_replica.append(resp.read().decode())
+    for name in ("znicz_serving_batches",
+                 "znicz_jax_backend_compiles"):
+        total = sum(counter(t, name) for t in per_replica)
+        assert counter(agg, name) >= total > 0
+    # /slo: per-model good/total summed across replicas
+    slo = _get(url, "/slo")
+    assert slo["fleet"] is True
+    agg_m = slo["models"]["m"]
+    good = total = 0
+    for r in replicas:
+        block = _get(r.url, "/slo")["models"].get("m", {})
+        good += block.get("good", 0)
+        total += block.get("total", 0)
+    assert agg_m["good"] == good > 0
+    assert agg_m["total"] == total
+    # /statusz: the fleet block + live queue total
+    statusz = _get(url, "/statusz")
+    assert statusz["fleet"]["up"] == 2
+    assert statusz["queued_rows_total"] == 0
+
+
+def test_admitted_oracle_visible_per_replica(fleet):
+    router, url = fleet
+    assert _predict(url, _x(7), rid="oracle-1")[0] == 200
+    admitted = [_get(r.url, "/admitted/oracle-1")["admitted"]
+                for r in router.replicas() if r.state == "up"]
+    # exactly ONE replica admitted it — the peer never saw the rid
+    assert sorted(admitted) == [False, True]
+
+
+def test_dead_replica_ejected_and_safe_retry_on_peer(fleet):
+    """SIGKILL one replica: a fresh request that lands on its closed
+    port provably never went out (connect refused) and retries on the
+    peer — the fleet keeps answering; the monitor ejects the corpse.
+    Run LAST against the shared fleet (it halves it)."""
+    router, url = fleet
+    victim = router.replicas()[0]
+    victim.proc.kill()
+    victim.proc.wait(timeout=30)
+    # drop the parked keep-alive conns so the next pick hits a plain
+    # connect-refused (the provably-never-sent retry path)
+    victim.close_conns()
+    for i in range(4):
+        assert _predict(url, _x(300 + i))[0] == 200
+    deadline = time.monotonic() + 15
+    while victim.state != DEAD and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert victim.state == DEAD
+    assert _get(url, "/healthz")["replicas_up"] == 1
+
+
+@pytest.mark.parametrize("scenario", ["kill_mid_dispatch"])
+def test_kill_mid_dispatch_honest_503_no_duplicate(tmp_path,
+                                                   scenario):
+    """THE retry-safety pin: a request already admitted to a
+    replica's batcher is NEVER re-sent to a peer.  A stall fault
+    holds the dispatch; the replica is SIGKILLed mid-flight; the
+    router answers an honest 503 (admission unknowable) and the
+    peer's admitted-rid oracle proves the rid never reached it."""
+    # at=5: warmup burns hits 1..4 (buckets 1,2,4,8) — the FIRST real
+    # traffic dispatch stalls 8 s
+    rules = ("{'serving.forward': {'kind': 'stall', "
+             "'stall_ms': 8000, 'at': 5}}")
+    router = FleetRouter(
+        ["m=" + _synth_zip(str(tmp_path)), "--max-batch",
+         str(MAX_BATCH),
+         "--config", "common.faults.enabled=True",
+         "--config", "common.faults.rules=" + rules],
+        replicas=2, compile_cache_dir=str(tmp_path / "cache"),
+        env=ENV).start()
+    url = "http://127.0.0.1:%d" % router.port
+    result = {}
+
+    def fire():
+        try:
+            result["reply"] = _predict(url, _x(1), rid="victim-rid",
+                                       timeout=60)
+        except urllib.error.HTTPError as e:
+            result["code"] = e.code
+            result["body"] = json.loads(e.read())
+    try:
+        t = threading.Thread(target=fire)
+        t.start()
+        # the admitted oracle tells us which replica holds the
+        # stalled dispatch
+        victim = peer = None
+        deadline = time.monotonic() + 30
+        while victim is None and time.monotonic() < deadline:
+            for r in router.replicas():
+                try:
+                    if _get(r.url,
+                            "/admitted/victim-rid")["admitted"]:
+                        victim = r
+                    else:
+                        peer = r
+                except (OSError, ValueError):
+                    pass
+            time.sleep(0.05)
+        assert victim is not None, "request never admitted anywhere"
+        victim.proc.kill()
+        t.join(timeout=60)
+        assert result.get("code") == 503, result
+        assert result["body"]["retry_safe"] is False
+        assert "retry unsafe" in result["body"]["error"]
+        # NO duplicate dispatch: the peer never saw the rid...
+        assert _get(peer.url,
+                    "/admitted/victim-rid")["admitted"] is False
+        # ... and the fleet keeps answering (the peer's own stall
+        # rule may hold this reply a few seconds — that is the
+        # fault, not the fleet)
+        assert _predict(url, _x(2), timeout=60)[0] == 200
+    finally:
+        router.stop()
+
+
+def test_scale_down_drain_loses_zero_inflight(tmp_path):
+    """The autoscaler's retire path under live traffic: replies keep
+    coming, every request answers 200, outputs stay bit-identical to
+    the quiet-fleet answers, and the retired replica exits 0 (the
+    graceful drain served everything it admitted)."""
+    router = FleetRouter(
+        ["m=" + _synth_zip(str(tmp_path)), "--max-batch",
+         str(MAX_BATCH)],
+        replicas=2, compile_cache_dir=str(tmp_path / "cache"),
+        env=ENV).start()
+    url = "http://127.0.0.1:%d" % router.port
+    try:
+        # the reference answers, taken before any scale churn
+        inputs = [_x(1000 + i) for i in range(8)]
+        want = [_predict(url, x)[1]["outputs"] for x in inputs]
+        stop = threading.Event()
+        failures, replies = [], []
+        lock = threading.Lock()
+
+        def client(k):
+            i = 0
+            while not stop.is_set():
+                try:
+                    code, doc, _ = _predict(url, inputs[i % 8])
+                    with lock:
+                        replies.append((i % 8, code,
+                                        doc["outputs"]))
+                except Exception as e:  # noqa: BLE001 - asserted
+                    with lock:
+                        failures.append(repr(e))
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        victim = router.retire(wait_s=60)
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not failures, failures[:5]
+        assert len(replies) > 20
+        assert all(code == 200 for _, code, _ in replies)
+        # bit-identical to the no-scale-down reference
+        for idx, _, outputs in replies:
+            assert outputs == want[idx]
+        # the drain completed: SIGTERM -> flush -> exit 0
+        assert victim.proc.wait(timeout=60) == 0
+        assert victim.reason == "retired"
+        assert router.up_count() == 1
+    finally:
+        router.stop()

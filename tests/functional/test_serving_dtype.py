@@ -291,7 +291,8 @@ def test_continuous_batcher_lane_key_carries_dtype(trained):
     batcher.submit(x, model="a")
     batcher.submit(x, model="b")
     keys = sorted(batcher._queues)
-    assert keys == [("a", (13,), "f32"), ("b", (13,), "int8")]
+    assert keys == [("a", (13,), "f32", "normal"),
+                    ("b", (13,), "int8", "normal")]
     batcher._running = False
     for q in batcher._queues.values():
         while q.reqs:

@@ -1,0 +1,200 @@
+"""Autoscaler decision policy (znicz_tpu/serving/autoscaler.py) —
+pure ``decide()`` inputs-in/action-out on a fake clock (zero fleets,
+zero sleeps), plus the gather+execute ``step()`` against a stub
+fleet."""
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.serving.autoscaler import (Autoscaler, HOLD,
+                                          SCALE_DOWN, SCALE_UP)
+
+
+class FakeClock(object):
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FakeFleet(object):
+    """Just enough FleetRouter for step(): canned signals +
+    recorded actions."""
+
+    def __init__(self, alive=2, slo=None, queued=0):
+        self.alive = alive
+        self.slo = slo or {"models": {}}
+        self.queued = queued
+        self.actions = []
+
+    def alive_count(self):
+        return self.alive
+
+    def aggregate_slo(self):
+        return self.slo
+
+    def queued_rows_total(self):
+        return self.queued
+
+    def scale_up(self):
+        self.alive += 1
+        self.actions.append("up")
+
+    def retire(self):
+        self.alive -= 1
+        self.actions.append("down")
+
+
+@pytest.fixture
+def knobs(monkeypatch):
+    fleet = root.common.serving.fleet
+    for key, value in (("min_replicas", 1), ("max_replicas", 4),
+                       ("scale_up_burn_threshold", 2.0),
+                       ("scale_up_queue_rows", 100.0),
+                       ("scale_down_budget_min", 0.97),
+                       ("scale_down_evals", 3),
+                       ("cooldown_s", 30.0)):
+        monkeypatch.setattr(fleet, key, value)
+    return fleet
+
+
+def _mk(alive=2, **fleet_kw):
+    clock = FakeClock()
+    scaler = Autoscaler(FakeFleet(alive=alive, **fleet_kw),
+                        clock=clock)
+    return scaler, clock
+
+
+def test_below_min_always_scales_up(knobs):
+    scaler, clock = _mk()
+    action, reason = scaler.decide(alive=0, burn_fast=None,
+                                   burn_slow=None,
+                                   budget_remaining=None,
+                                   queue_rows=0)
+    assert action == SCALE_UP and "min_replicas" in reason
+    # ... even mid-cooldown: a died replica must be replaced
+    scaler._last_action_t = clock()
+    action, _ = scaler.decide(alive=0, burn_fast=None,
+                              burn_slow=None, budget_remaining=None,
+                              queue_rows=0)
+    assert action == SCALE_UP
+
+
+def test_both_burn_windows_over_threshold_scale_up(knobs):
+    scaler, _ = _mk()
+    action, reason = scaler.decide(alive=2, burn_fast=3.0,
+                                   burn_slow=2.5,
+                                   budget_remaining=0.4,
+                                   queue_rows=0)
+    assert action == SCALE_UP and "burn" in reason
+    # ONE hot window does not page the autoscaler (the multi-window
+    # rule: a brief blip must not buy hardware)
+    action, _ = scaler.decide(alive=2, burn_fast=3.0, burn_slow=0.5,
+                              budget_remaining=0.9, queue_rows=0)
+    assert action == HOLD
+
+
+def test_queue_depth_leads_burn(knobs):
+    scaler, _ = _mk()
+    action, reason = scaler.decide(alive=2, burn_fast=None,
+                                   burn_slow=None,
+                                   budget_remaining=None,
+                                   queue_rows=300)  # 150/replica
+    assert action == SCALE_UP and "queued rows" in reason
+
+
+def test_max_replicas_caps_scale_up(knobs):
+    scaler, _ = _mk()
+    action, reason = scaler.decide(alive=4, burn_fast=5.0,
+                                   burn_slow=5.0,
+                                   budget_remaining=0.0,
+                                   queue_rows=0)
+    assert action == HOLD and "max_replicas" in reason
+
+
+def test_cooldown_blocks_repeat_scale_up(knobs):
+    scaler, clock = _mk()
+    assert scaler.decide(alive=2, burn_fast=3.0, burn_slow=3.0,
+                         budget_remaining=0.4, queue_rows=0)[0] \
+        == SCALE_UP
+    scaler._last_action_t = clock()
+    clock.t += 10.0      # inside the 30 s cooldown
+    action, reason = scaler.decide(alive=3, burn_fast=3.0,
+                                   burn_slow=3.0,
+                                   budget_remaining=0.4,
+                                   queue_rows=0)
+    assert action == HOLD and "cooldown" in reason
+    clock.t += 25.0      # past it
+    assert scaler.decide(alive=3, burn_fast=3.0, burn_slow=3.0,
+                         budget_remaining=0.4, queue_rows=0)[0] \
+        == SCALE_UP
+
+
+def test_scale_down_needs_consecutive_green(knobs):
+    """Hysteresis: 3 consecutive comfortably-green decisions before a
+    retire; one red sample resets the streak."""
+    scaler, _ = _mk()
+    green = dict(alive=2, burn_fast=0.1, burn_slow=0.1,
+                 budget_remaining=1.0, queue_rows=0)
+    assert scaler.decide(**green)[0] == HOLD
+    assert scaler.decide(**green)[0] == HOLD
+    action, reason = scaler.decide(**green)
+    assert action == SCALE_DOWN and "consecutive" in reason
+    # a red decision resets the streak
+    scaler2, _ = _mk()
+    assert scaler2.decide(**green)[0] == HOLD
+    assert scaler2.decide(alive=2, burn_fast=3.0, burn_slow=3.0,
+                          budget_remaining=0.2, queue_rows=0)[0] \
+        == SCALE_UP
+    assert scaler2.decide(**green)[0] == HOLD  # streak restarted at 1
+
+
+def test_scale_down_floors_at_min(knobs):
+    scaler, _ = _mk()
+    green = dict(alive=1, burn_fast=0.0, burn_slow=0.0,
+                 budget_remaining=1.0, queue_rows=0)
+    for _ in range(5):
+        action, reason = scaler.decide(**green)
+        assert action == HOLD
+    assert "min_replicas" in reason
+
+
+def test_no_traffic_is_green_not_red(knobs):
+    """A quiet fleet (no SLO samples at all) counts toward the green
+    streak — idle replicas over min should eventually retire."""
+    scaler, _ = _mk()
+    quiet = dict(alive=3, burn_fast=None, burn_slow=None,
+                 budget_remaining=None, queue_rows=0)
+    assert scaler.decide(**quiet)[0] == HOLD
+    assert scaler.decide(**quiet)[0] == HOLD
+    assert scaler.decide(**quiet)[0] == SCALE_DOWN
+
+
+def test_step_gathers_executes_and_records(knobs):
+    """step() pulls the fleet aggregates (max burn / min budget over
+    models), executes the decision, and records it for /statusz."""
+    slo = {"models": {
+        "a": {"burn_rate": {"fast": 3.0, "slow": 2.6},
+              "error_budget_remaining": 0.3},
+        "b": {"burn_rate": {"fast": 0.2, "slow": 0.1},
+              "error_budget_remaining": 1.0},
+    }}
+    scaler, _ = _mk(alive=2, slo=slo)
+    record = scaler.step()
+    assert record["action"] == SCALE_UP
+    assert record["burn_fast"] == 3.0      # the fleet MAX
+    assert record["burn_slow"] == 2.6
+    assert record["budget_remaining"] == 0.3   # the fleet MIN
+    assert scaler.fleet.actions == ["up"]
+    assert scaler.status()["last_decision"]["action"] == SCALE_UP
+
+
+def test_step_scale_down_executes_retire(knobs):
+    scaler, _ = _mk(alive=3)
+    for _ in range(2):
+        assert scaler.step()["action"] == HOLD
+    record = scaler.step()
+    assert record["action"] == SCALE_DOWN
+    assert scaler.fleet.actions == ["down"]
+    assert scaler._green_streak == 0       # reset after the action

@@ -1,0 +1,293 @@
+"""Priority lanes in the continuous batcher (ISSUE 15) + the
+admitted-rid ring + loadgen's priority mix: shed-first admission for
+the low lanes, high-first dispatch within a model, lane-key purity,
+the router's idempotency oracle, and the seeded per-priority traffic
+tape/report."""
+
+import os
+import sys
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.serving.batcher import QueueFullError
+from znicz_tpu.serving.continuous import (ContinuousBatcher,
+                                          PRIORITIES,
+                                          normalize_priority)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+
+
+def _rows(n, width=3):
+    return numpy.arange(n * width, dtype=numpy.float64).reshape(
+        n, width)
+
+
+class GatedModel(object):
+    """Fake engine recording dispatch order; ``gate`` blocks
+    dispatches so tests pile up a deterministic queue."""
+
+    def __init__(self, max_batch=8):
+        import threading
+        self.max_batch = max_batch
+        self.sample_shape = None
+        self.batches = []
+        self.gate = threading.Event()
+        self.gate.set()
+        self.lock = threading.Lock()
+
+    def bucket_for(self, n):
+        return self.max_batch
+
+    def predict(self, x):
+        self.gate.wait(10)
+        with self.lock:
+            self.batches.append(len(x))
+        return numpy.asarray(x) + 1.0
+
+
+# -- the vocabulary ---------------------------------------------------------
+def test_normalize_priority_rules():
+    assert normalize_priority(None) == "normal"
+    assert normalize_priority("high") == "high"
+    assert normalize_priority("  LOW ") == "low"
+    assert normalize_priority("Normal") == "normal"
+    assert sorted(PRIORITIES) == ["high", "low", "normal"]
+
+
+def test_unknown_priority_is_loud():
+    """A typo'd priority must 400, never silently ride a lane."""
+    with pytest.raises(ValueError, match="unknown priority"):
+        normalize_priority("hgih")
+    model = GatedModel()
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0)
+    b._running = True
+    with pytest.raises(ValueError, match="unknown priority"):
+        b.submit(_rows(1), priority="urgent")
+    b._running = False
+
+
+def test_lane_key_carries_priority_and_stays_pure():
+    """Same model/shape at two priorities lands in two lanes — a
+    dispatch never mixes priorities."""
+    model = GatedModel()
+    b = ContinuousBatcher(model, queue_limit=64, timeout_ms=0)
+    b._running = True  # no workers: queues stay inspectable
+    b.submit(_rows(1), priority="high", request_id="p-hi")
+    b.submit(_rows(1), priority="low")
+    b.submit(_rows(1))
+    keys = sorted(k[3] for k in b._queues)
+    assert keys == ["high", "low", "normal"]
+    assert all(len(k) == 4 for k in b._queues)
+    b._running = False
+    for q in b._queues.values():
+        while q.reqs:
+            q.reqs.popleft().future.cancel()
+
+
+def test_low_sheds_first_high_admits_to_the_full_queue(monkeypatch):
+    """The overload contract: with the queue at 60% occupancy the low
+    lane (50% ceiling) rejects 429 while normal and high still admit;
+    with a three-tier curve (normal lowered to 85 — the default keeps
+    normal at the full queue) normal rejects at 90% and high still
+    admits to the limit."""
+    monkeypatch.setattr(root.common.serving.priority_queue_pct,
+                        "normal", 85.0)
+    model = GatedModel(max_batch=100)
+    b = ContinuousBatcher(model, queue_limit=100, timeout_ms=0)
+    b._running = True  # no workers: occupancy is exact
+    b.submit(_rows(60), priority="high")
+    assert b.queued_rows == 60
+    with pytest.raises(QueueFullError, match="low priority"):
+        b.submit(_rows(1), priority="low")
+    b.submit(_rows(10), priority="normal")
+    b.submit(_rows(20), priority="high")
+    assert b.queued_rows == 90
+    with pytest.raises(QueueFullError, match="normal priority"):
+        b.submit(_rows(1), priority="normal")
+    b.submit(_rows(10), priority="high")   # up to the full limit
+    with pytest.raises(QueueFullError, match="high priority"):
+        b.submit(_rows(1), priority="high")
+    b._running = False
+    for q in b._queues.values():
+        while q.reqs:
+            q.reqs.popleft().future.cancel()
+
+
+def test_shed_curve_is_a_live_config_read(monkeypatch):
+    """An operator retuning priority_queue_pct at runtime changes the
+    NEXT admission."""
+    model = GatedModel(max_batch=100)
+    b = ContinuousBatcher(model, queue_limit=100, timeout_ms=0)
+    b._running = True
+    b.submit(_rows(30), priority="high")
+    monkeypatch.setattr(root.common.serving.priority_queue_pct,
+                        "low", 10.0)
+    with pytest.raises(QueueFullError):
+        b.submit(_rows(1), priority="low")
+    monkeypatch.setattr(root.common.serving.priority_queue_pct,
+                        "low", 90.0)
+    b.submit(_rows(1), priority="low")
+    b._running = False
+    for q in b._queues.values():
+        while q.reqs:
+            q.reqs.popleft().future.cancel()
+
+
+def test_dispatch_prefers_the_high_lane():
+    """Within a model, a queued high-priority request dispatches
+    before an EARLIER-arrived low-priority one."""
+    model = GatedModel(max_batch=1)
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0)
+    model.gate.clear()
+    b.start()
+    try:
+        blocker = b.submit(_rows(1))       # occupies the one slot
+        import time
+        time.sleep(0.1)
+        low = b.submit(_rows(1), priority="low")
+        time.sleep(0.05)                   # low arrived FIRST
+        high = b.submit(_rows(1), priority="high")
+        model.gate.set()
+        high.result(timeout=5)
+        low.result(timeout=5)
+        blocker.result(timeout=5)
+        # three batch-1 dispatches; the high lane ran before low:
+        # order of completion proves dispatch order under 1 slot
+        assert model.batches == [1, 1, 1]
+        assert high.done() and low.done()
+    finally:
+        b.stop()
+
+
+def test_priority_dispatch_order_is_deterministic():
+    """The scheduler rank is (priority, head arrival): with all three
+    lanes queued behind a blocked slot, service order is high,
+    normal, low."""
+    import time
+    model = GatedModel(max_batch=1)
+    b = ContinuousBatcher(model, max_inflight=1, queue_limit=64,
+                          timeout_ms=0)
+    model.gate.clear()
+    b.start()
+    order = []
+    try:
+        blocker = b.submit(_rows(1))
+        time.sleep(0.1)
+        futures = {}
+        for prio in ("low", "normal", "high"):   # worst-first arrival
+            futures[prio] = b.submit(_rows(1), priority=prio)
+            time.sleep(0.02)
+        for prio, f in futures.items():
+            f.add_done_callback(
+                lambda _f, p=prio: order.append(p))
+        model.gate.set()
+        for f in futures.values():
+            f.result(timeout=5)
+        blocker.result(timeout=5)
+        assert order == ["high", "normal", "low"]
+    finally:
+        b.stop()
+
+
+# -- the admitted-rid ring --------------------------------------------------
+def test_admitted_ring_records_and_bounds(monkeypatch):
+    monkeypatch.setattr(root.common.serving, "admitted_rid_capacity",
+                        4)
+    model = GatedModel()
+    b = ContinuousBatcher(model, queue_limit=1024, timeout_ms=0)
+    b._running = True
+    for i in range(6):
+        b.submit(_rows(1), request_id="rid-%d" % i)
+    assert not b.rid_admitted("rid-0")   # evicted (capacity 4)
+    assert not b.rid_admitted("rid-1")
+    for i in range(2, 6):
+        assert b.rid_admitted("rid-%d" % i)
+    assert not b.rid_admitted(None)
+    assert not b.rid_admitted("never-seen")
+    b._running = False
+    for q in b._queues.values():
+        while q.reqs:
+            q.reqs.popleft().future.cancel()
+
+
+def test_shed_request_is_never_marked_admitted():
+    """THE retry-safety invariant: a 429'd request never entered a
+    lane, so the router may resend it to a peer — rid_admitted must
+    say False."""
+    model = GatedModel(max_batch=100)
+    b = ContinuousBatcher(model, queue_limit=10, timeout_ms=0)
+    b._running = True
+    b.submit(_rows(9), priority="high", request_id="kept")
+    with pytest.raises(QueueFullError):
+        b.submit(_rows(5), priority="high", request_id="shed")
+    assert b.rid_admitted("kept")
+    assert not b.rid_admitted("shed")
+    b._running = False
+    for q in b._queues.values():
+        while q.reqs:
+            q.reqs.popleft().future.cancel()
+
+
+# -- loadgen: the seeded priority tape + report -----------------------------
+def _specs():
+    import loadgen
+    return [loadgen.ModelSpec("m", (4,), max_batch=8)]
+
+
+def test_make_plan_priority_mix_is_seeded_and_nonperturbing():
+    import loadgen
+    mix = "high:1,normal:2,low:1"
+    a = loadgen.make_plan(50.0, 2.0, 7, _specs(), priority_mix=mix)
+    b = loadgen.make_plan(50.0, 2.0, 7, _specs(), priority_mix=mix)
+    assert a == b                       # byte-identical per seed
+    plain = loadgen.make_plan(50.0, 2.0, 7, _specs())
+    # the mix rides a DEDICATED stream: arrivals/models/rows identical
+    assert [(t, mi, rows) for t, mi, rows, _ in a] == \
+        [(t, mi, rows) for t, mi, rows, _ in plain]
+    assert all(p is None for _, _, _, p in plain)
+    drawn = {p for _, _, _, p in a}
+    assert drawn == {"high", "normal", "low"}
+    other = loadgen.make_plan(50.0, 2.0, 8, _specs(),
+                              priority_mix=mix)
+    assert [p for _, _, _, p in other] != [p for _, _, _, p in a]
+
+
+def test_parse_priority_mix_validates():
+    import loadgen
+    assert loadgen.parse_priority_mix("high:1, low:3") == \
+        [("high", 1.0), ("low", 3.0)]
+    with pytest.raises(ValueError, match="unknown priority"):
+        loadgen.parse_priority_mix("hgih:1")
+    with pytest.raises(ValueError, match="PRIO:WEIGHT"):
+        loadgen.parse_priority_mix("high")
+    with pytest.raises(ValueError, match="empty"):
+        loadgen.parse_priority_mix(" , ")
+
+
+def test_report_per_priority_blocks():
+    """Per-priority goodput/shed accounting straight from records:
+    high all-good, low all-shed."""
+    import loadgen
+    specs = _specs()
+    records = [
+        (0, 1, 0.010, 200, "high"),
+        (0, 2, 0.020, 200, "high"),
+        (0, 1, 0.500, 200, "normal"),   # over the 100 ms SLO
+        (0, 1, 0.001, 429, "low"),
+        (0, 1, 0.001, 429, "low"),
+    ]
+    out = loadgen.report(records, scheduled=5, duration_s=1.0,
+                         slo_ms=100.0, seed=7, models=specs)
+    pp = out["per_priority"]
+    assert pp["high"]["goodput_pct"] == 100.0
+    assert pp["high"]["shed_429"] == 0
+    assert pp["normal"]["goodput_pct"] == 0.0
+    assert pp["normal"]["ok"] == 1
+    assert pp["low"]["shed_429"] == 2
+    assert pp["low"]["goodput_pct"] == 0.0
+    assert pp["low"]["latency_ms"]["p50"] is None  # no OK latencies

@@ -76,7 +76,15 @@ GATED = ("value", "f32_images_per_sec", "cifar_caffe_images_per_sec",
          # fast path that slows down or stops stamping fails the
          # round
          "serving_f32_batch1_requests_per_sec",
-         "serving_f32_fast_requests_per_sec")
+         "serving_f32_fast_requests_per_sec",
+         # the multi-replica fleet (ISSUE 15): 2-replica wall_rps vs
+         # 1-replica through the real router (100% = perfect linear
+         # scaling) and the high-priority lane's goodput under 3x
+         # overload — a fleet that stops scaling, or a priority
+         # plane that stops protecting the high lane, fails the
+         # round like any throughput drop
+         "serving_fleet_scaling_efficiency_pct",
+         "serving_priority_high_goodput_under_overload_pct")
 
 #: latency-style keys (lower is better): a RISE past the threshold
 #: fails; zero/missing when the previous round had a number fails too
@@ -261,6 +269,27 @@ def selftest(threshold=0.10):
              serving_f32_batch1_requests_per_sec=1000.0 * 0.95,
              serving_tail_p99_ms=2.0 * (1.0 + threshold)),
         tail_old, threshold)
+    # the fleet gates (ISSUE 15), proven on a synthetic round: a
+    # scaling-efficiency drop, a ZERO stamp (the crash guard) and a
+    # VANISHED high-priority-goodput key must all fail; fleet wobble
+    # passes
+    fleet_old = {"serving_fleet_scaling_efficiency_pct": 83.0,
+                 "serving_priority_high_goodput_under_overload_pct":
+                     97.0}
+    fl_drop, _ = compare(
+        dict(fleet_old,
+             serving_fleet_scaling_efficiency_pct=83.0 * 0.85),
+        fleet_old, threshold)
+    fl_zero, _ = compare(
+        dict(fleet_old,
+             serving_priority_high_goodput_under_overload_pct=0.0),
+        fleet_old, threshold)
+    fleet_gone = dict(fleet_old)
+    del fleet_gone["serving_priority_high_goodput_under_overload_pct"]
+    fl_gone, _ = compare(fleet_gone, fleet_old, threshold)
+    fl_wobble, _ = compare(
+        {k: v * 0.95 for k, v in fleet_old.items()},
+        fleet_old, threshold)
     # the SLO-plane overhead gate (ISSUE 14), proven on a synthetic
     # round: a large overhead RISE and a zero (crash-guard) stamp must
     # both fail; small wobble passes (inverted gating — the plane's
@@ -281,6 +310,7 @@ def selftest(threshold=0.10):
             or srv_drop or srv_p99_up or srv_p99_zero \
             or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
             or tl_drop or tl_p99_up or tl_gone or not tl_wobble \
+            or fl_drop or fl_zero or fl_gone or not fl_wobble \
             or ob_rise or ob_zero or not ob_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
@@ -290,13 +320,16 @@ def selftest(threshold=0.10):
               "dtype_drop_rejected=%s dtype_vanished_rejected=%s "
               "dtype_wobble_passed=%s tail_batch1_drop_rejected=%s "
               "tail_p99_rise_rejected=%s tail_vanished_rejected=%s "
-              "tail_wobble_passed=%s obs_rise_rejected=%s "
+              "tail_wobble_passed=%s fleet_drop_rejected=%s "
+              "fleet_zero_rejected=%s fleet_vanished_rejected=%s "
+              "fleet_wobble_passed=%s obs_rise_rejected=%s "
               "obs_zero_rejected=%s obs_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
                  not dt_gone, dt_wobble, not tl_drop, not tl_p99_up,
-                 not tl_gone, tl_wobble, not ob_rise, not ob_zero,
+                 not tl_gone, tl_wobble, not fl_drop, not fl_zero,
+                 not fl_gone, fl_wobble, not ob_rise, not ob_zero,
                  ob_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
@@ -306,6 +339,8 @@ def selftest(threshold=0.10):
           "int8 drop and vanished bf16 key rejected, dtype wobble "
           "passes; tail batch-1 req/s drop, steady-p99 rise and "
           "vanished scenario-p99 key rejected, tail wobble passes; "
+          "fleet scaling-efficiency drop, zero stamp and vanished "
+          "priority-goodput key rejected, fleet wobble passes; "
           "SLO-plane overhead rise and zero-stamp rejected, "
           "overhead wobble passes (threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))

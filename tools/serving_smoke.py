@@ -1,4 +1,4 @@
-"""CI smoke: the serving tier end to end, in four acts.
+"""CI smoke: the serving tier end to end, in six acts.
 
 **Act 1 — single engine (the PR 2 contract):** train a tiny wine
 model, snapshot it, bring up the HTTP front end, fire 64 CONCURRENT
@@ -52,6 +52,19 @@ time-series sampler):
   ``GET /debug/trace/<rid>`` with all six span kinds,
 * ``GET /debug/timeseries`` is non-empty and its counter rates agree
   with the registry's own deltas.
+
+**Act 6 — the multi-replica fleet (ISSUE 15):** a 2-replica fleet of
+REAL serving subprocesses sharing one compile cache behind the
+front-end router, under a seeded priority-mixed open-loop burst at
+~3x the probed capacity (the real ``tools/loadgen.py`` CLI with
+``--priority-mix`` and the ``--assert-goodput-pct high:75`` gate):
+
+* HIGH-priority goodput holds under the overload while the LOW lane
+  sheds as fast 429s (the priority-lane contract, over HTTP),
+* the router's aggregated ``/slo`` and ``/metrics`` equal the
+  per-replica sums,
+* one replica is SIGKILLed mid-burst and the fleet keeps answering
+  (the corpse is ejected from rotation; the survivor serves).
 
 **Act 4 — the batch-1 latency fast path (ISSUE 12):** the SAME wine
 snapshot served strict (f32) and fast (f32-fast) behind one registry:
@@ -194,6 +207,7 @@ def main():
     precision_smoke(snapshot)
     latency_smoke(snapshot)
     slo_smoke(snapshot)
+    fleet_smoke(tmp)
 
 
 def _second_model_package(tmp):
@@ -635,6 +649,148 @@ def slo_smoke(snapshot):
         root.common.telemetry.timeseries.enabled = saved_ts
         faults.clear()
         faults.disable()
+
+
+def fleet_smoke(tmp):
+    """Act 6: the 2-replica fleet under a priority-mixed overload
+    burst + a mid-burst SIGKILL (ISSUE 15)."""
+    import subprocess
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import loadgen
+    from znicz_tpu.serving.router import FleetRouter
+
+    telemetry.reset()
+    # a model heavy enough that the SERVER is the bottleneck (the
+    # shed must happen in the replica batchers, not as client-side
+    # queueing) and a queue sized so the high lane's full-queue wait
+    # stays well inside the SLO while the low lane's tightened
+    # ceiling sheds under pressure
+    from znicz_tpu.testing import build_fc_package_zip
+    zip_path = build_fc_package_zip(
+        os.path.join(tmp, "fleet_model.zip"),
+        [20, 768, 768, 768, 4], seed=42, scale=0.05)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    router = FleetRouter(
+        ["m=" + zip_path, "--max-batch", str(MAX_BATCH),
+         "--timeout-ms", "0", "--queue-limit", "96",
+         "--config", "common.serving.slo_enabled=True",
+         # a tighter low-lane ceiling (25% of the queue): the shed
+         # gap between lanes must be unmistakable, not statistical
+         "--config", "common.serving.priority_queue_pct="
+                     "{'low': 25.0, 'normal': 85.0, 'high': 100.0}"],
+        replicas=2,
+        compile_cache_dir=os.path.join(tmp, "fleet_cache"),
+        env=env).start()
+    url = "http://127.0.0.1:%d" % router.port
+    try:
+        models = loadgen.discover_models(url)
+        pool = loadgen.DaemonPool(96)
+        submit = loadgen.http_submit(url, pool, binary=True)
+        probe = loadgen.run(
+            loadgen.make_plan(2500.0, 1.0, 7, models),
+            models, submit, 2000.0, 1.0, 7)
+        capacity = max(probe.get("wall_rps") or 0.0, 50.0)
+        # the seeded priority-mixed overload burst, through the REAL
+        # CLI: the high lane must hold its goodput gate while the
+        # low lane sheds — the --assert-goodput-pct high:75 exit
+        # code IS the assertion
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "loadgen.py"),
+             url, "--rate", str(int(capacity * 3.0)),
+             "--duration", "3", "--seed", "7", "--npy",
+             "--slo-ms", "2000", "--concurrency", "256",
+             "--priority-mix", "high:1,normal:2,low:2",
+             "--assert-goodput-pct", "high:75"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, \
+            "high-priority goodput gate failed:\n%s\n%s" % (
+                proc.stdout[-1500:], proc.stderr[-1500:])
+        report = json.loads(proc.stdout.splitlines()[-1])
+        pp = report["per_priority"]
+        assert pp["low"]["shed_429"] > 0, \
+            "overload never shed the low lane: %s" % pp["low"]
+        assert (pp["low"]["goodput_pct"] or 0.0) < \
+            pp["high"]["goodput_pct"], pp
+        # aggregated /slo and /metrics equal the per-replica sums
+        ups = [r for r in router.replicas() if r.state == "up"]
+        assert len(ups) == 2
+
+        def fetch_json(u, path):
+            with urllib.request.urlopen(u + path,
+                                        timeout=30) as resp:
+                return json.loads(resp.read())
+
+        def counter_of(u, name):
+            with urllib.request.urlopen(u + "/metrics",
+                                        timeout=30) as resp:
+                text = resp.read().decode()
+            for line in text.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+            return 0.0
+
+        slo = fetch_json(url, "/slo")
+        good = total = 0
+        for r in ups:
+            block = fetch_json(r.url, "/slo")["models"].get("m", {})
+            good += block.get("good", 0)
+            total += block.get("total", 0)
+        assert slo["models"]["m"]["good"] == good > 0
+        assert slo["models"]["m"]["total"] == total
+        batches_sum = sum(counter_of(r.url, "znicz_serving_batches")
+                          for r in ups)
+        batches_agg = counter_of(url, "znicz_serving_batches")
+        assert batches_agg >= batches_sum > 0, \
+            (batches_agg, batches_sum)
+        # mid-burst SIGKILL: fire a second (unasserted) burst and
+        # kill one replica while it runs — the fleet keeps answering
+        victim = ups[0]
+        survivor = ups[1]
+        burst = {}
+
+        def run_burst():
+            burst["report"] = loadgen.run(
+                loadgen.make_plan(capacity, 3.0, 11, models,
+                                  priority_mix="high:1,low:1"),
+                models, submit, 2000.0, 3.0, 11)
+
+        t = __import__("threading").Thread(target=run_burst)
+        t.start()
+        time.sleep(1.0)
+        victim.proc.kill()
+        t.join(timeout=120)
+        after = burst["report"]
+        assert after["ok"] > 0, after
+        # the fleet still answers after the kill, on the survivor
+        x = numpy.random.RandomState(3).uniform(-1, 1, (2, 20))
+        req = urllib.request.Request(
+            url + "/predict/m",
+            json.dumps({"inputs": x.tolist()}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+        deadline = time.monotonic() + 15
+        while victim.state != "dead" and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert victim.state == "dead"
+        health = fetch_json(url, "/healthz")
+        assert health["replicas_up"] == 1
+        assert survivor.state == "up"
+        print("fleet smoke OK: 2 replicas, %.0f rps capacity, 3x "
+              "overload burst -> high goodput %.1f%% (gate 75%%) vs "
+              "low %.1f%% with %d low 429s; /slo + /metrics equal "
+              "per-replica sums; mid-burst SIGKILL -> %d completions"
+              ", survivor serving, corpse ejected"
+              % (capacity, pp["high"]["goodput_pct"],
+                 pp["low"]["goodput_pct"] or 0.0,
+                 pp["low"]["shed_429"], after["ok"]))
+    finally:
+        router.stop()
 
 
 if __name__ == "__main__":

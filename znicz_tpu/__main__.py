@@ -31,20 +31,11 @@ from znicz_tpu.launcher import list_samples, run_workflow
 
 
 def apply_override(root_cfg, assignment):
-    """Apply one ``dotted.path=value`` override onto the config root.
-    Values parse as Python literals, falling back to strings."""
-    path, sep, raw = assignment.partition("=")
-    if not sep:
-        raise SystemExit("--config needs KEY=VALUE, got %r" % assignment)
-    try:
-        value = ast.literal_eval(raw)
-    except (ValueError, SyntaxError):
-        value = raw
-    parts = path.strip().split(".")
-    node = root_cfg
-    for p in parts[:-1]:
-        node = getattr(node, p)
-    setattr(node, parts[-1], value)
+    """Apply one ``dotted.path=value`` override onto the config root
+    (delegates to the ONE shared parser in core/config.py — the serve
+    CLI's ``--config`` uses the same rule)."""
+    from znicz_tpu.core.config import apply_override as _apply
+    _apply(assignment, root_cfg=root_cfg)
 
 
 def _generic_population_evaluator(sites):

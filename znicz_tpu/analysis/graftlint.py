@@ -63,8 +63,16 @@ import re
 #: first dotted segment of every legal telemetry series name — extend
 #: ONLY with a reviewed family prefix (each series is a /metrics entry)
 SERIES_PREFIXES = frozenset((
-    "analysis", "faults", "health", "jax", "launcher", "loader",
-    "memory", "profiler", "registry", "serving",
+    "analysis", "faults",
+    # the multi-replica serving fleet (ISSUE 15): replica-count
+    # gauges + autoscaler decision counters (serving/router.py,
+    # serving/autoscaler.py) and the front-end router's proxy/retry
+    # counters
+    "fleet",
+    "health", "jax", "launcher", "loader",
+    "memory", "profiler", "registry",
+    "router",
+    "serving",
     # the serving SLO plane (ISSUE 14): per-model good/total,
     # burn-rate and error-budget series (serving/slo.py) and the
     # time-series sampler's own meters (core/timeseries.py)
@@ -75,8 +83,11 @@ SERIES_PREFIXES = frozenset((
 #: legal ``labeled()`` label keys — a bounded set by design (every
 #: (key, value) pair mints a new series)
 LABEL_KEYS = frozenset((
-    "bucket", "breaker", "device", "dtype", "model", "scenario",
-    "site",
+    "bucket", "breaker", "device", "dtype", "model",
+    # the priority lanes (ISSUE 15): bounded by the PRIORITIES
+    # vocabulary in serving/continuous.py (high/normal/low)
+    "priority",
+    "scenario", "site",
 ))
 
 #: identifiers that mark a label VALUE as derived from request data —

@@ -368,6 +368,54 @@ declare("common", {
         # default); when off every hook is ONE config predicate.
         "trace_sample_n": 0,
         "trace_capacity": 256,      # sampled trace trees retained
+        # priority lanes (serving/continuous.py): each request carries
+        # a priority ("high" | "normal" | "low"; X-Priority header or
+        # the body's "priority" field).  Under queue pressure the low
+        # lanes shed FIRST: a priority admits only while the queued
+        # rows sit under its share of queue_limit, so under overload
+        # low-priority traffic turns into fast 429s while
+        # high-priority goodput holds.  "normal" (the default lane)
+        # keeps the FULL queue — default traffic admits exactly as it
+        # always did; lower it (e.g. 85) for three-tier shedding.
+        # High additionally wins at DISPATCH (lane rank), so it holds
+        # goodput even where admission ceilings tie.
+        "priority_queue_pct": {
+            "low": 50.0,        # low admits under 50% occupancy
+            "normal": 100.0,    # default traffic: full queue
+            "high": 100.0,      # high admits up to queue_limit
+        },
+        # admitted-request-id ring (serving/continuous.py): the
+        # batcher remembers the last N admitted rids so the fleet
+        # router can prove a request never reached a replica's batcher
+        # before retrying it on a peer (GET /admitted/<rid>)
+        "admitted_rid_capacity": 4096,
+        # multi-replica serving fleet (serving/router.py +
+        # serving/autoscaler.py) — see docs/serving.md "Fleet
+        # topology" for every knob's meaning
+        "fleet": {
+            "replicas": 2,           # serve --fleet default size
+            "spawn_timeout_s": 180.0,  # replica must print its URL +
+                                       # pass /healthz within this
+            "probe_interval_s": 1.0,   # health-monitor poll period
+            "probe_failures": 3,       # consecutive failed probes
+                                       # before an ejection
+            "route_retries": 2,        # peer retries per request when
+                                       # a resend is provably safe
+            # the autoscaler (serving/autoscaler.py):
+            "min_replicas": 1,
+            "max_replicas": 4,
+            "autoscale_interval_s": 5.0,  # decision cadence
+            "scale_up_burn_threshold": 2.0,  # fleet fast+slow burn
+                                             # over this -> scale up
+            "scale_up_queue_rows": 256.0,    # fleet queued rows per
+                                             # replica over this ->
+                                             # scale up
+            "scale_down_budget_min": 0.97,   # budget comfortably
+                                             # green before a retire
+            "scale_down_evals": 3,   # consecutive green decisions
+                                     # before a scale-down (hysteresis)
+            "cooldown_s": 30.0,      # min seconds between actions
+        },
     },
     # persistent XLA compilation cache (core/compile_cache.py) — the
     # serving cold-start story: executables compile once per cluster,
@@ -380,6 +428,30 @@ declare("common", {
         "min_entry_size_bytes": -1,     # ... and tiny executables
     },
 })
+
+
+def apply_override(assignment, root_cfg=None):
+    """Apply one CLI ``dotted.path=value`` override onto the config
+    root (the ``--config`` flag of the training launcher AND the
+    serve CLI — one parser, one literal-or-string rule).  Values
+    parse as Python literals, falling back to strings; a leading
+    ``root.`` is accepted and stripped."""
+    import ast
+    path, sep, raw = assignment.partition("=")
+    if not sep:
+        raise SystemExit("--config needs KEY=VALUE, got %r"
+                         % assignment)
+    try:
+        value = ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        value = raw
+    parts = path.strip().split(".")
+    if parts and parts[0] == "root":
+        parts = parts[1:]
+    node = root_cfg if root_cfg is not None else root
+    for p in parts[:-1]:
+        node = getattr(node, p)
+    setattr(node, parts[-1], value)
 
 
 def get(value, default=None):

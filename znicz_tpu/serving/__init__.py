@@ -30,7 +30,16 @@ Three pillars (docs/serving.md):
   (docs/observability.md "SLO plane & request traces"): per-model
   error budgets + multi-window burn rates fed from request admission
   (``GET /slo``), and head-sampled per-request span trees
-  (``GET /debug/trace/<rid>``).
+  (``GET /debug/trace/<rid>``);
+* :class:`znicz_tpu.serving.router.FleetRouter` /
+  :class:`znicz_tpu.serving.autoscaler.Autoscaler` — the
+  multi-replica fleet plane (docs/serving.md "Fleet topology"):
+  N replica subprocesses sharing one compile cache behind a
+  least-outstanding-requests router with idempotent-safe peer
+  retries and fleet-aggregated operator endpoints, scaled by the
+  SLO-burn-driven autoscaler (``serve --fleet N [--autoscale]``);
+  priority lanes in the continuous batcher shed low-priority traffic
+  first under overload.
 """
 
 from znicz_tpu.serving.engine import (  # noqa: F401 - re-export
@@ -43,7 +52,9 @@ from znicz_tpu.serving.batcher import (  # noqa: F401 - re-export
 from znicz_tpu.serving.breaker import (  # noqa: F401 - re-export
     CircuitBreaker, CircuitOpenError)
 from znicz_tpu.serving.continuous import (  # noqa: F401 - re-export
-    ContinuousBatcher)
+    ContinuousBatcher, PRIORITIES, normalize_priority)
+from znicz_tpu.serving.router import FleetRouter  # noqa: F401
+from znicz_tpu.serving.autoscaler import Autoscaler  # noqa: F401
 from znicz_tpu.serving.registry import (  # noqa: F401 - re-export
     ModelRegistry, UnknownModelError)
 from znicz_tpu.serving.slo import SloTracker  # noqa: F401
@@ -54,4 +65,5 @@ __all__ = ["InferenceEngine", "MicroBatcher", "ContinuousBatcher",
            "BatcherStoppedError", "QueueFullError",
            "RequestTimeoutError", "default_buckets",
            "CircuitBreaker", "CircuitOpenError", "SloTracker",
-           "SERVING_DTYPES", "normalize_dtype"]
+           "SERVING_DTYPES", "normalize_dtype", "FleetRouter",
+           "Autoscaler", "PRIORITIES", "normalize_priority"]

@@ -1,0 +1,255 @@
+"""SLO-burn-driven autoscaling over a replica fleet.
+
+The serving SLO plane (serving/slo.py, PR 14) made every model's
+error budget a measured in-process number and the ROADMAP called it
+"THE item-2 autoscaler feed".  This module closes that loop: a
+background controller reads the FLEET's aggregated burn rates +
+queued rows (:meth:`~znicz_tpu.serving.router.FleetRouter
+.aggregate_slo` / ``queued_rows_total``) and drives
+``FleetRouter.scale_up()`` / ``FleetRouter.retire()``.
+
+Decision policy (all knobs live under ``root.common.serving.fleet``,
+live config reads — retune at runtime):
+
+* **scale up** when the fleet is under ``min_replicas``, OR when both
+  burn windows (fast AND slow, aggregated as the fleet max) sit at or
+  over ``scale_up_burn_threshold`` — the same multi-window pairing the
+  ``slo.burn`` page uses, so the autoscaler reacts exactly when an
+  operator would be paged — OR when the queued rows per replica exceed
+  ``scale_up_queue_rows`` (burn is a trailing signal; queue depth
+  leads it).  Capped at ``max_replicas``.
+* **scale down** when the budget is comfortably green
+  (``error_budget_remaining`` — fleet min — at or above
+  ``scale_down_budget_min``), the fast burn is under 1.0 (spending
+  slower than sustainable) and the queue is quiet, for
+  ``scale_down_evals`` CONSECUTIVE decisions (hysteresis: one green
+  sample never retires a replica).  Floor at ``min_replicas``.  The
+  retire is the graceful-drain path — the replica leaves rotation
+  first, serves everything it admitted, then exits: zero dropped
+  requests (pinned by ``tests/functional/test_fleet_router.py``).
+* **cooldown**: at least ``cooldown_s`` between scale ACTIONS in
+  either direction — a fresh replica must have time to absorb load
+  before the burn numbers justify another move.
+
+Every decision — including the no-ops — journals an
+``autoscaler.decision`` event; actions additionally journal
+``autoscaler.scale_up`` / ``autoscaler.scale_down`` with the signal
+values that justified them, so an operator can replay WHY the fleet
+grew at 3 AM.  ``fleet.autoscaler_decisions`` /
+``fleet.autoscaler_scale_ups`` / ``fleet.autoscaler_scale_downs``
+counters meter the loop.
+
+The decision function (:meth:`Autoscaler.decide`) is pure — inputs
+in, ``(action, reason)`` out — so the policy unit-tests with zero
+fleets and zero sleeps; :meth:`Autoscaler.step` gathers the live
+inputs and executes.  The clock is injectable (cooldown math tests
+run on a fake clock).
+"""
+
+import threading
+import time
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import telemetry
+
+_fleet = root.common.serving.fleet
+
+telemetry.register_help(
+    "fleet.autoscaler",
+    "SLO-burn-driven autoscaler (serving/autoscaler.py): decision "
+    "and scale-action counters")
+
+#: decision outcomes
+SCALE_UP, SCALE_DOWN, HOLD = "scale_up", "scale_down", "hold"
+
+
+class Autoscaler(Logger):
+    """Burn-rate + queue-depth autoscaling controller over a
+    :class:`~znicz_tpu.serving.router.FleetRouter` (see module
+    docstring)."""
+
+    def __init__(self, fleet, clock=time.monotonic):
+        super(Autoscaler, self).__init__(logger_name="Autoscaler")
+        self.fleet = fleet
+        self._clock = clock
+        self._green_streak = 0
+        self._last_action_t = None
+        self._last = {}            # the latest decision (status())
+        self._thread = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- knobs (live reads) -------------------------------------------------
+    @staticmethod
+    def knobs():
+        return {
+            "min": int(_fleet.get("min_replicas", 1)),
+            "max": int(_fleet.get("max_replicas", 4)),
+            "interval_s": float(_fleet.get("autoscale_interval_s",
+                                           5.0)),
+            "burn_threshold": float(_fleet.get(
+                "scale_up_burn_threshold", 2.0)),
+            "queue_rows": float(_fleet.get("scale_up_queue_rows",
+                                           256.0)),
+            "budget_min": float(_fleet.get("scale_down_budget_min",
+                                           0.97)),
+            "down_evals": int(_fleet.get("scale_down_evals", 3)),
+            "cooldown_s": float(_fleet.get("cooldown_s", 30.0)),
+        }
+
+    # -- the policy (pure) --------------------------------------------------
+    def decide(self, alive, burn_fast, burn_slow, budget_remaining,
+               queue_rows, now=None):
+        """One decision: ``(action, reason)``.  ``alive`` counts the
+        replicas that exist (up or spawning); burn/budget are the
+        fleet aggregates (None = no traffic yet); ``queue_rows`` is
+        the fleet-wide queued-row total.  Mutates only the hysteresis
+        streak + cooldown bookkeeping."""
+        k = self.knobs()
+        now = self._clock() if now is None else now
+        in_cooldown = (self._last_action_t is not None and
+                       now - self._last_action_t < k["cooldown_s"])
+        if alive < k["min"]:
+            # below the floor beats every other rule (a died replica
+            # must be replaced even mid-cooldown)
+            self._green_streak = 0
+            return SCALE_UP, "below min_replicas (%d < %d)" % (
+                alive, k["min"])
+        queue_per_replica = queue_rows / max(alive, 1)
+        burning = (burn_fast is not None and burn_slow is not None
+                   and burn_fast >= k["burn_threshold"]
+                   and burn_slow >= k["burn_threshold"])
+        queue_deep = queue_per_replica > k["queue_rows"]
+        if burning or queue_deep:
+            self._green_streak = 0
+            reason = ("burn fast %.2f / slow %.2f over threshold %.2f"
+                      % (burn_fast or 0.0, burn_slow or 0.0,
+                         k["burn_threshold"]) if burning else
+                      "queued rows per replica %.0f over %.0f"
+                      % (queue_per_replica, k["queue_rows"]))
+            if alive >= k["max"]:
+                return HOLD, "overloaded but at max_replicas: " + \
+                    reason
+            if in_cooldown:
+                return HOLD, "overloaded but in cooldown: " + reason
+            return SCALE_UP, reason
+        green = ((budget_remaining is None
+                  or budget_remaining >= k["budget_min"])
+                 and (burn_fast is None or burn_fast < 1.0)
+                 and queue_per_replica < k["queue_rows"] * 0.25)
+        if not green:
+            self._green_streak = 0
+            return HOLD, "inside SLO, not comfortably green"
+        self._green_streak += 1
+        if alive <= k["min"]:
+            return HOLD, "green but at min_replicas"
+        if self._green_streak < k["down_evals"]:
+            return HOLD, "green streak %d of %d" % (
+                self._green_streak, k["down_evals"])
+        if in_cooldown:
+            return HOLD, "green but in cooldown"
+        return SCALE_DOWN, (
+            "budget %.3f >= %.3f for %d consecutive decisions"
+            % (budget_remaining if budget_remaining is not None
+               else 1.0, k["budget_min"], self._green_streak))
+
+    # -- the loop -----------------------------------------------------------
+    def _signals(self):
+        """Gather the live fleet inputs for one decision."""
+        slo = self.fleet.aggregate_slo()
+        burn_fast = burn_slow = budget = None
+        for m in (slo.get("models") or {}).values():
+            for window, var in (("fast", "burn_fast"),
+                                ("slow", "burn_slow")):
+                burn = (m.get("burn_rate") or {}).get(window)
+                if burn is None:
+                    continue
+                if var == "burn_fast":
+                    burn_fast = burn if burn_fast is None else \
+                        max(burn_fast, burn)
+                else:
+                    burn_slow = burn if burn_slow is None else \
+                        max(burn_slow, burn)
+            b = m.get("error_budget_remaining")
+            if b is not None:
+                budget = b if budget is None else min(budget, b)
+        return {
+            "alive": self.fleet.alive_count(),
+            "burn_fast": burn_fast,
+            "burn_slow": burn_slow,
+            "budget_remaining": budget,
+            "queue_rows": self.fleet.queued_rows_total(),
+        }
+
+    def step(self):
+        """One gather → decide → execute pass.  Returns the decision
+        record (also served under /statusz autoscaler)."""
+        signals = self._signals()
+        action, reason = self.decide(**signals)
+        now = self._clock()
+        record = dict(signals, action=action, reason=reason,
+                      t=round(now, 3))
+        with self._lock:
+            self._last = record
+        if telemetry.enabled():
+            telemetry.counter("fleet.autoscaler_decisions").inc()
+        telemetry.record_event("autoscaler.decision", **record)
+        if action == SCALE_UP:
+            self._last_action_t = now
+            telemetry.record_event("autoscaler.scale_up", **record)
+            if telemetry.enabled():
+                telemetry.counter("fleet.autoscaler_scale_ups").inc()
+            self.info("scaling up: %s", reason)
+            try:
+                self.fleet.scale_up()
+            except Exception as e:  # noqa: BLE001 - keep the loop up
+                self.warning("scale-up failed: %r", e)
+                record["error"] = repr(e)
+        elif action == SCALE_DOWN:
+            self._last_action_t = now
+            self._green_streak = 0
+            telemetry.record_event("autoscaler.scale_down", **record)
+            if telemetry.enabled():
+                telemetry.counter(
+                    "fleet.autoscaler_scale_downs").inc()
+            self.info("scaling down: %s", reason)
+            try:
+                self.fleet.retire()
+            except Exception as e:  # noqa: BLE001 - keep the loop up
+                self.warning("scale-down failed: %r", e)
+                record["error"] = repr(e)
+        return record
+
+    def _loop(self):
+        while not self._stop.wait(self.knobs()["interval_s"]):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 - the loop survives
+                self.warning("autoscaler step failed: %r", e)
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)
+
+    def status(self):
+        with self._lock:
+            last = dict(self._last)
+        return {
+            "knobs": self.knobs(),
+            "green_streak": self._green_streak,
+            "last_action_t": self._last_action_t,
+            "last_decision": last,
+        }

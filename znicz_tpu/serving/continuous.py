@@ -6,9 +6,11 @@ batching *window* (size-or-deadline) and dispatches it with ONE worker
 trickle traffic always pays ``max_delay_ms``.  Continuous batching
 inverts the control flow:
 
-* requests land in per-``(model, sample-shape, serve-dtype)`` FIFO
-  queues the moment they arrive (the dtype leg keeps dispatches
-  dtype-pure across precision-changing hot reloads);
+* requests land in per-``(model, sample-shape, serve-dtype,
+  priority)`` FIFO queues the moment they arrive (the dtype leg keeps
+  dispatches dtype-pure across precision-changing hot reloads; the
+  priority leg keeps every dispatch priority-pure so a low-priority
+  flood never rides inside a high-priority batch);
 * ``max_inflight`` dispatch slots (worker threads) each grab the next
   coalescible run of requests THE MOMENT they free up — a request
   admits into the next in-flight shape bucket as soon as there is
@@ -16,9 +18,21 @@ inverts the control flow:
   immediate batch-of-1 (no window wait); saturated server = arrivals
   coalesce naturally while every slot is busy, so dispatches run full
   without ever scheduling a timer;
-* slots pick the next MODEL round-robin (and the oldest-waiting shape
-  queue within it), so a burst against one model cannot starve the
-  others — cross-model fairness is positional, not probabilistic.
+* slots pick the next MODEL round-robin (and, within the model, the
+  highest-priority lane whose head has waited longest), so a burst
+  against one model cannot starve the others — cross-model fairness
+  is positional, not probabilistic — while a model's own high-priority
+  work always dispatches ahead of its low-priority backlog.
+
+**Priority lanes** (the overload contract): every request carries a
+priority — ``"high"`` / ``"normal"`` / ``"low"`` (default
+``"normal"``).  Admission is priority-aware: a priority only admits
+while the queued rows sit under its share of ``queue_limit``
+(``root.common.serving.priority_queue_pct``, live config read), so
+under overload the low lanes shed FIRST as fast 429s while
+high-priority traffic keeps admitting up to the full queue, and
+dispatch prefers the high lanes — high-priority goodput holds while
+low-priority absorbs the shed (pinned by the overload bench).
 
 The PR 2 contracts carry over unchanged: a bounded global queue
 (``queue_limit`` rows) rejects with :class:`QueueFullError` → 429;
@@ -54,8 +68,30 @@ from znicz_tpu.serving.batcher import (_DISPATCH_GRACE, _Request,
                                        RequestTimeoutError)
 
 
+#: priority vocabulary, best-first: the dispatch rank AND the /metrics
+#: label values (bounded by construction — unknown strings are LOUD)
+PRIORITIES = {"high": 0, "normal": 1, "low": 2}
+
+
+def normalize_priority(priority):
+    """The one priority spelling rule: None -> "normal"; anything else
+    must be a known lane name.  An unknown priority is a client error
+    (HTTP 400), never a silent default — a typo'd "hgih" must not
+    quietly ride the shed-first lane."""
+    if priority is None:
+        return "normal"
+    p = str(priority).strip().lower()
+    if p not in PRIORITIES:
+        raise ValueError(
+            "unknown priority %r (accepted: %s)"
+            % (priority, "/".join(sorted(PRIORITIES,
+                                         key=PRIORITIES.get))))
+    return p
+
+
 class _Queue(object):
-    """One (model, trailing-shape, serve-dtype) admission lane."""
+    """One (model, trailing-shape, serve-dtype, priority) admission
+    lane."""
 
     __slots__ = ("reqs", "max_batch")
 
@@ -92,9 +128,23 @@ class ContinuousBatcher(Logger):
         timeout_ms = (timeout_ms if timeout_ms is not None
                       else cfg.get("timeout_ms", 1000.0))
         self.timeout = float(timeout_ms) / 1e3 if timeout_ms else None
-        self._queues = {}          # (model, shape, dtype) -> _Queue
+        self._queues = {}    # (model, shape, dtype, prio) -> _Queue
         self._rows_queued = 0
         self._last_model = None    # round-robin cursor
+        #: bounded admitted-request-id ring: the fleet router's
+        #: idempotency oracle (GET /admitted/<rid>) — a rid in here
+        #: reached a dispatch lane and may have run, so a router must
+        #: NEVER resend it to a peer.  deque of (rid, wall-time)
+        #: evicts oldest; the set gives O(1) membership under the
+        #: condition lock.  Eviction bookkeeping (count + the oldest
+        #: RETAINED admission time) lets the oracle say how far back
+        #: its history is complete — a miss is only PROOF of
+        #: non-admission over the covered window (admitted_status).
+        self._admitted_cap = int(cfg.get("admitted_rid_capacity",
+                                         4096) or 0)
+        self._admitted_ring = collections.deque()
+        self._admitted_set = set()
+        self._admitted_evictions = 0
         self._cond = locksmith.condition("serving.continuous")
         self._running = False
         self._threads = []
@@ -168,11 +218,14 @@ class ContinuousBatcher(Logger):
             t.join(timeout=30)
 
     # -- submission ---------------------------------------------------------
-    def submit(self, x, model=None, timeout_ms=None, request_id=None):
+    def submit(self, x, model=None, timeout_ms=None, request_id=None,
+               priority=None):
         """Enqueue; returns a Future of the output rows.  ``model``
-        routes within a registry (None = default model)."""
+        routes within a registry (None = default model); ``priority``
+        picks the admission/dispatch lane (None = "normal")."""
         if not self._running:
             raise BatcherStoppedError("batcher is not running")
+        priority = normalize_priority(priority)
         engine = self._peek(model)
         x = numpy.asarray(x)
         sample = getattr(engine, "sample_shape", None)
@@ -203,21 +256,47 @@ class ContinuousBatcher(Logger):
         # precision mode must not coalesce requests parsed for the old
         # generation's dtype into the new generation's dispatches —
         # each dispatch stays dtype-pure (plain callables have no
-        # serve_dtype; their lane key gains a stable None)
+        # serve_dtype; their lane key gains a stable None).  The
+        # priority leg keeps dispatches priority-pure and lets
+        # _next_key prefer the high lanes.
         key = (model, x.shape[1:],
-               getattr(engine, "serve_dtype", None))
+               getattr(engine, "serve_dtype", None), priority)
+        # priority-aware admission ceiling: this priority's share of
+        # queue_limit (live config read — an operator can retune the
+        # shed curve at runtime); "high" rides the full queue
+        pct = root.common.serving.priority_queue_pct.get(
+            priority, 100.0)
+        limit = min(self.queue_limit,
+                    int(self.queue_limit * float(pct) / 100.0))
         with self._cond:
             if not self._running:
                 raise BatcherStoppedError("batcher is not running")
-            if self._rows_queued + rows > self.queue_limit:
+            if self._rows_queued + rows > limit:
                 if telemetry.enabled():
                     telemetry.counter("serving.rejected").inc()
+                    telemetry.counter(telemetry.labeled(
+                        "serving.rejected", priority=priority)).inc()
                     if model is not None:
                         telemetry.counter(telemetry.labeled(
                             "serving.rejected", model=model)).inc()
                 raise QueueFullError(
-                    "queue full (%d rows queued, limit %d)"
-                    % (self._rows_queued, self.queue_limit))
+                    "queue full for %s priority (%d rows queued, "
+                    "%s-lane limit %d of %d)"
+                    % (priority, self._rows_queued, priority, limit,
+                       self.queue_limit))
+            if request_id and self._admitted_cap > 0 and \
+                    request_id not in self._admitted_set:
+                # record BEFORE the enqueue is visible to a dispatch
+                # slot: a router probing /admitted/<rid> after a
+                # broken connection must never see "not admitted" for
+                # a request a slot is already running.  Each rid rides
+                # the ring once, so ring and set stay consistent.
+                self._admitted_ring.append((request_id, time.time()))
+                self._admitted_set.add(request_id)
+                while len(self._admitted_ring) > self._admitted_cap:
+                    dropped, _ = self._admitted_ring.popleft()
+                    self._admitted_set.discard(dropped)
+                    self._admitted_evictions += 1
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = _Queue(max_batch)
@@ -234,7 +313,8 @@ class ContinuousBatcher(Logger):
             self._cond.notify()
         return future
 
-    def predict(self, x, model=None, timeout_ms=None, request_id=None):
+    def predict(self, x, model=None, timeout_ms=None, request_id=None,
+                priority=None):
         """Blocking submit; the wait is bounded at deadline + dispatch
         grace when the request carries one (same contract as the
         micro-batcher)."""
@@ -242,7 +322,7 @@ class ContinuousBatcher(Logger):
         timeout = (self.timeout if timeout_ms is None
                    else (float(timeout_ms) / 1e3 or None))
         future = self.submit(x, model=model, timeout_ms=timeout_ms,
-                             request_id=request_id)
+                             request_id=request_id, priority=priority)
         if timeout is None:
             return future.result()
         try:
@@ -262,20 +342,48 @@ class ContinuousBatcher(Logger):
     def inflight(self):
         return self._inflight
 
+    def rid_admitted(self, rid):
+        """Was ``rid`` ever admitted to a dispatch lane?  True means
+        the request may have dispatched (or still be running) here,
+        so a resend on a peer risks a duplicate dispatch.  Bounded
+        history — see :meth:`admitted_status` for the coverage
+        metadata a caller needs to treat a miss as PROOF."""
+        if not rid:
+            return False
+        with self._cond:
+            return rid in self._admitted_set
+
+    def admitted_status(self, rid):
+        """The fleet router's idempotency oracle, with coverage: a
+        MISS only proves non-admission for requests admitted after
+        ``oldest_retained_ts`` (or for all time when ``evictions`` is
+        0) — an evicted rid and a never-seen rid are
+        indistinguishable, and the router must treat a request sent
+        before the covered window as unknowable, never as
+        safe-to-resend."""
+        with self._cond:
+            return {
+                "admitted": bool(rid) and rid in self._admitted_set,
+                "evictions": self._admitted_evictions,
+                "oldest_retained_ts": (self._admitted_ring[0][1]
+                                       if self._admitted_ring
+                                       else None),
+            }
+
     # -- the dispatch slots -------------------------------------------------
     def _worker(self):
         while True:
             taken = self._take()
             if taken is None:
                 return
-            model, batch = taken
+            model, batch, priority = taken
             with self._cond:
                 self._inflight += 1
                 if telemetry.enabled():
                     telemetry.gauge("serving.inflight").set(
                         self._inflight)
             try:
-                self._run_batch(model, batch)
+                self._run_batch(model, batch, priority=priority)
             finally:
                 with self._cond:
                     self._inflight -= 1
@@ -286,8 +394,10 @@ class ContinuousBatcher(Logger):
     def _next_key(self):
         """Round-robin fairness: the next model (cyclically after the
         last-served one) with pending work; within the model, the
-        shape lane whose HEAD request has waited longest.  Called
-        under the condition lock."""
+        highest-PRIORITY lane first, then the lane whose HEAD request
+        has waited longest — a model's high-priority work never sits
+        behind its low-priority backlog.  Called under the condition
+        lock."""
         pending = {}
         for key, q in self._queues.items():
             if q.reqs:
@@ -300,7 +410,8 @@ class ContinuousBatcher(Logger):
             models = models[i:] + models[:i]
         model = models[0]
         key = min(pending[model],
-                  key=lambda k: self._queues[k].reqs[0].arrived)
+                  key=lambda k: (PRIORITIES.get(k[3], 1),
+                                 self._queues[k].reqs[0].arrived))
         self._last_model = model
         return key
 
@@ -336,9 +447,9 @@ class ContinuousBatcher(Logger):
             if telemetry.enabled():
                 telemetry.gauge("serving.queue_depth").set(
                     self._rows_queued)
-            return key[0], batch
+            return key[0], batch, key[3]
 
-    def _run_batch(self, model, batch):
+    def _run_batch(self, model, batch, priority="normal"):
         now = time.monotonic()
         live = []
         for r in batch:
@@ -426,12 +537,16 @@ class ContinuousBatcher(Logger):
             telemetry.histogram("serving.pad_overhead").observe(
                 (bucket - rows) / float(bucket))
         latency = queue_wait = device_time = None
-        m_latency = m_queue_wait = None
+        m_latency = m_queue_wait = p_latency = None
         if telemetry.enabled():
             latency = telemetry.histogram("serving.request_seconds")
             queue_wait = telemetry.histogram(
                 "serving.queue_wait_seconds")
             device_time = telemetry.histogram("serving.device_seconds")
+            # the per-priority view (bounded: 3 lanes) — the overload
+            # bench reads high-lane latency separately from the shed
+            p_latency = telemetry.histogram(telemetry.labeled(
+                "serving.request_seconds", priority=priority))
             if model is not None:
                 # the per-model view (satellite: multi-model metrics
                 # must not collide): latency + queue wait labeled
@@ -450,6 +565,7 @@ class ContinuousBatcher(Logger):
                 latency.observe(total)
                 queue_wait.observe(waited)
                 device_time.observe(dev_dt)
+                p_latency.observe(total)
                 if m_latency is not None:
                     m_latency.observe(total)
                     m_queue_wait.observe(waited)

@@ -1,0 +1,1143 @@
+"""Fleet front end — N replica processes behind ONE routing surface.
+
+Everything a horizontally-scaled serving fleet needs already exists in
+one process: the ModelRegistry + persistent compile cache make a cold
+replica spin-up nearly free (warm restart = ZERO fresh compiles,
+pinned by ``tests/functional/test_compile_cache.py``), SIGTERM drains
+gracefully, and the SLO plane measures every model's error budget.
+This module is the step from one process to N (ROADMAP item 2 — the
+Veles master/slave launcher heritage, PAPER.md §0):
+
+* :class:`Replica` — one serving subprocess (``python -m znicz_tpu
+  serve ... --port 0``), spawned with the fleet's SHARED compile-cache
+  directory so every replica after the first deserializes its warmup
+  executables instead of compiling them.  The replica's URL is parsed
+  from its startup banner; a reader thread keeps the pipe drained and
+  retains the last output lines for post-mortems.
+* :class:`FleetRouter` — the HTTP front end operators talk to:
+
+  - ``POST /predict[/<model>]`` spreads traffic with
+    **least-outstanding-requests** balancing over the UP replicas
+    (ties rotate), forwarding the body plus the ``X-Request-Id`` /
+    ``X-Priority`` / ``Content-Type`` headers verbatim;
+  - **retry safety** (the idempotency rule): a request is re-sent to
+    a peer ONLY when it provably never entered a replica's batcher —
+    the connect failed before anything was sent, or the replica
+    answered a pre-admission refusal (503-draining / 429-shed /
+    503-warming).  A connection that breaks AFTER the request went
+    out consults the replica's admitted-rid oracle
+    (``GET /admitted/<rid>``, serving/continuous.py); an admitted or
+    UNKNOWABLE (replica dead) rid answers an honest 503 — the fleet
+    NEVER dispatches one request twice;
+  - a dead or draining replica is ejected from rotation (the health
+    monitor probes ``/healthz`` every
+    ``root.common.serving.fleet.probe_interval_s`` and reaps exited
+    processes) and its in-flight work is retried on a peer when the
+    rule above allows;
+  - **fleet-aggregated operator surfaces**: ``GET /metrics`` (the
+    per-series SUM over every replica's exposition, the router's own
+    series appended), ``GET /slo`` (per-model good/bad/total summed,
+    burn rates aggregated as the fleet MAX, budget as the fleet MIN —
+    the conservative paging view), ``GET /healthz`` (per-replica
+    states; 200 while ANY replica is up), ``GET /models`` (one
+    replica's payload — the fleet is homogeneous — plus a ``fleet``
+    block), and ``GET /statusz`` (router + per-replica stats).
+
+* scale operations for the autoscaler (serving/autoscaler.py):
+  :meth:`FleetRouter.scale_up` spawns + waits ready + enters
+  rotation; :meth:`FleetRouter.retire` ejects a replica from rotation
+  FIRST, then SIGTERMs it — the replica's graceful drain serves every
+  queued request before exiting, so a scale-down loses zero in-flight
+  requests (pinned by ``tests/functional/test_fleet_router.py``).
+
+Telemetry: ``router.requests`` / ``router.proxied`` /
+``router.retries`` / ``router.unsafe_503s`` /
+``router.replica_deaths`` / ``router.replica_ejections`` counters,
+``fleet.replicas`` / ``fleet.replicas_up`` gauges, and
+``fleet.replica_spawn`` / ``fleet.replica_dead`` /
+``fleet.replica_retired`` journal events.  CLI: ``python -m znicz_tpu
+serve ... --fleet N [--autoscale]`` (serving/server.py).
+"""
+
+import collections
+import http.client
+import json
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core.status_server import (BodyTooLargeError,
+                                          HandlerBase, HttpServerBase)
+from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
+
+_cfg = root.common.serving
+_fleet = root.common.serving.fleet
+
+telemetry.register_help(
+    "router", "fleet front end (serving/router.py): proxied "
+              "requests, peer retries, unsafe-retry 503s, replica "
+              "ejections")
+telemetry.register_help(
+    "fleet", "replica fleet state (serving/router.py): spawned/up "
+             "replica counts and scale events")
+
+#: the startup banner of ``python -m znicz_tpu serve`` — the replica's
+#: chosen port rides in it (the child binds port 0).  The host may be
+#: a name, not just a dotted quad: ``--config common.serving.host=``
+#: forwards to replicas by design
+_URL_RE = re.compile(r"on (http://[^/\s:]+:\d+)/")
+
+#: proxy timeout for one forwarded /predict (seconds) — generous: the
+#: replica's own queue deadline answers first in any healthy setup
+_PROXY_TIMEOUT = 120.0
+
+#: replica states
+SPAWNING, UP, DRAINING, DEAD = "spawning", "up", "draining", "dead"
+
+
+class _NeverSentError(Exception):
+    """The connect failed before one request byte went out — a resend
+    is safe by construction."""
+
+
+class _SentUnknownError(Exception):
+    """The connection broke after (part of) the request went out —
+    the replica may have admitted it; only the admitted-rid oracle
+    can clear a resend.  ``timed_out`` marks a PROXY TIMEOUT (the
+    connection may still be alive with the request buffered unread):
+    the oracle cannot clear those — "not admitted" only means "not
+    admitted YET", and the replica could still read + dispatch the
+    request after a resend, the exact duplicate the contract
+    forbids.  A reset/EOF, by contrast, killed the connection — the
+    replica can never read an unprocessed request off a dead socket,
+    so the oracle's answer is final."""
+
+    def __init__(self, message, timed_out=False):
+        super(_SentUnknownError, self).__init__(message)
+        self.timed_out = timed_out
+
+
+class _RawConn(object):
+    """One keep-alive socket to a replica with a buffered reader —
+    the proxy's request/response cycle hand-rolled.  ``http.client``
+    plus the email-parser header machinery costs ~0.5 ms of GIL per
+    round-trip; the relay only needs the status, three headers and
+    the exact-length body, which this reads in a tight loop."""
+
+    __slots__ = ("sock", "rfile")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.rfile = sock.makefile("rb")
+
+    def round_trip(self, request_bytes):
+        """Send one request; return ``(status, headers, body,
+        close)`` where ``headers`` carries only Content-Type /
+        Retry-After.  Raises ``OSError``/``ValueError`` on any
+        transport or framing failure (the caller maps it to the
+        retry-safety machinery)."""
+        self.sock.sendall(request_bytes)
+        line = self.rfile.readline(65537)
+        if not line:
+            raise OSError("connection closed before a status line")
+        parts = line.split(None, 2)
+        status = int(parts[1])
+        length = 0
+        close = False
+        headers = {}
+        while True:
+            h = self.rfile.readline(65537)
+            if h in (b"\r\n", b"\n", b""):
+                break
+            key, _, value = h.partition(b":")
+            key = key.strip().lower()
+            if key == b"content-length":
+                length = int(value.strip())
+            elif key == b"content-type":
+                headers["Content-Type"] = \
+                    value.strip().decode("latin-1")
+            elif key == b"retry-after":
+                headers["Retry-After"] = \
+                    value.strip().decode("latin-1")
+            elif key == b"connection" and \
+                    value.strip().lower() == b"close":
+                close = True
+        body = self.rfile.read(length) if length else b""
+        if length and len(body) != length:
+            raise OSError("short body (%d of %d bytes)"
+                          % (len(body), length))
+        return status, headers, body, close
+
+    def close(self):
+        try:
+            self.rfile.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Replica(Logger):
+    """One serving subprocess + its lifecycle bookkeeping."""
+
+    def __init__(self, rid, argv, env=None, keep_lines=60):
+        super(Replica, self).__init__(logger_name="Replica[%s]" % rid)
+        self.rid = rid
+        self.state = SPAWNING
+        self.reason = None          # why it left rotation
+        self.url = None
+        self.host = None
+        self.port = None
+        self.outstanding = 0        # in-flight proxied requests
+        self.served = 0
+        self.probe_failures = 0
+        self.started = time.monotonic()
+        #: parked keep-alive connections to this replica (the proxy
+        #: reuses them across requests — a fresh TCP connect per
+        #: forward costs more than the forward); bounded
+        self._conns = collections.deque()
+        self._conn_lock = threading.Lock()
+        self._url_event = threading.Event()
+        self._tail = collections.deque(maxlen=keep_lines)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "znicz_tpu", "serve"]
+            + list(argv) + ["--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        self._reader = threading.Thread(
+            target=self._drain_output, name="replica-%s-out" % rid,
+            daemon=True)
+        self._reader.start()
+
+    def _drain_output(self):
+        for line in self.proc.stdout:
+            self._tail.append(line.rstrip("\n"))
+            if self.url is None:
+                m = _URL_RE.search(line)
+                if m:
+                    self.url = m.group(1)
+                    host_port = self.url.split("//", 1)[1]
+                    self.host, _, port = host_port.partition(":")
+                    self.port = int(port)
+                    self._url_event.set()
+        self._url_event.set()  # EOF: stop any waiter, url may be None
+
+    def wait_ready(self, timeout_s):
+        """Block until the replica printed its URL AND answers
+        ``/healthz`` 200.  Returns True on ready."""
+        deadline = time.monotonic() + float(timeout_s)
+        self._url_event.wait(max(0.0, deadline - time.monotonic()))
+        if self.url is None:
+            return False
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return False
+            try:
+                with urllib.request.urlopen(self.url + "/healthz",
+                                            timeout=5) as resp:
+                    if resp.status == 200:
+                        return True
+            except urllib.error.HTTPError:
+                pass      # 503: still warming
+            except OSError:
+                pass      # not accepting yet
+            time.sleep(0.05)
+        return False
+
+    def tail(self):
+        """The retained last output lines (post-mortems)."""
+        return list(self._tail)
+
+    def get_conn(self):
+        """A parked keep-alive connection, or a fresh connect (which
+        raises :class:`_NeverSentError` on failure — nothing was
+        sent yet)."""
+        with self._conn_lock:
+            if self._conns:
+                return self._conns.popleft(), True
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=_PROXY_TIMEOUT)
+        except OSError as e:
+            raise _NeverSentError(repr(e))
+        return _RawConn(sock), False
+
+    def put_conn(self, conn):
+        with self._conn_lock:
+            if len(self._conns) < 64:
+                self._conns.append(conn)
+                return
+        conn.close()
+
+    def close_conns(self):
+        with self._conn_lock:
+            conns, self._conns = list(self._conns), \
+                collections.deque()
+        for conn in conns:
+            conn.close()
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            try:
+                self.proc.terminate()
+            except OSError:
+                pass
+
+    def kill(self):
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+
+    def stats(self):
+        return {
+            "id": self.rid, "state": self.state, "url": self.url,
+            "outstanding": self.outstanding, "served": self.served,
+            "reason": self.reason, "pid": self.proc.pid,
+            "exit_code": self.proc.poll(),
+            "uptime_s": round(time.monotonic() - self.started, 1),
+        }
+
+
+class FleetRouter(HttpServerBase):
+    """The fleet front end (see module docstring).
+
+    ``replica_argv`` is the ``serve`` CLI argument list every replica
+    runs (model specs + options, WITHOUT ``--port``/``--fleet``);
+    ``compile_cache_dir`` is appended as ``--compile-cache DIR`` so
+    the whole fleet shares one persistent cache (pass None to leave
+    the replica argv untouched); ``env`` extends the child
+    environment.
+    """
+
+    def __init__(self, replica_argv, replicas=None, port=0, host=None,
+                 compile_cache_dir=None, env=None):
+        super(FleetRouter, self).__init__(
+            port=port, host=host or _cfg.get("host", "127.0.0.1"),
+            logger_name="FleetRouter")
+        argv = list(replica_argv)
+        if compile_cache_dir is not None and \
+                "--compile-cache" not in argv:
+            argv += ["--compile-cache", str(compile_cache_dir)]
+        self._replica_argv = argv
+        self._env = env
+        self._n_initial = int(replicas if replicas is not None
+                              else _fleet.get("replicas", 2))
+        if self._n_initial < 1:
+            raise ValueError("a fleet needs at least 1 replica")
+        self._lock = locksmith.lock("serving.router")
+        self._replicas = []
+        self._next_id = 0
+        self._rr = 0               # least-outstanding tie-break cursor
+        self._draining = False
+        self._monitor = None
+        self._monitor_stop = threading.Event()
+        self.autoscaler = None     # attached by serve --autoscale
+
+    # -- fleet membership ---------------------------------------------------
+    def _spawn(self):
+        """Spawn one replica (no rotation entry yet)."""
+        with self._lock:
+            rid = "r%d" % self._next_id
+            self._next_id += 1
+        replica = Replica(rid, self._replica_argv, env=self._env)
+        with self._lock:
+            self._replicas.append(replica)
+        return replica
+
+    def _enter_rotation(self, replica):
+        replica.state = UP
+        replica.probe_failures = 0
+        telemetry.record_event("fleet.replica_spawn",
+                               replica=replica.rid, url=replica.url)
+        self._set_gauges()
+        self.info("replica %s up at %s", replica.rid, replica.url)
+
+    def start(self, wait_ready=True):
+        """Spawn the initial fleet (concurrently), wait until every
+        replica is ready, then open the routing surface."""
+        spawned = [self._spawn() for _ in range(self._n_initial)]
+        timeout_s = float(_fleet.get("spawn_timeout_s", 180.0))
+        if wait_ready:
+            for replica in spawned:
+                if not replica.wait_ready(timeout_s):
+                    tails = "\n".join(replica.tail()[-15:])
+                    self.shutdown_fleet()
+                    raise RuntimeError(
+                        "replica %s failed to become ready within "
+                        "%.0f s; last output:\n%s"
+                        % (replica.rid, timeout_s, tails))
+                self._enter_rotation(replica)
+        super(FleetRouter, self).start()
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-monitor",
+            daemon=True)
+        self._monitor.start()
+        return self
+
+    def scale_up(self, wait_ready=True):
+        """Spawn one replica and (optionally) wait it into rotation.
+        The shared compile cache makes this nearly free: the new
+        replica's warmup deserializes the fleet's executables (zero
+        fresh compiles — pinned)."""
+        replica = self._spawn()
+        if wait_ready:
+            if not replica.wait_ready(
+                    float(_fleet.get("spawn_timeout_s", 180.0))):
+                replica.state = DEAD
+                replica.reason = "spawn_failed"
+                replica.kill()
+                raise RuntimeError(
+                    "scale-up replica %s failed to become ready; "
+                    "last output:\n%s"
+                    % (replica.rid, "\n".join(replica.tail()[-15:])))
+            self._enter_rotation(replica)
+        return replica
+
+    def retire(self, rid=None, wait_s=None):
+        """Graceful scale-down: eject ONE replica from rotation, then
+        SIGTERM it — the replica's drain path serves everything it
+        already admitted before exiting, so no in-flight request is
+        dropped.  ``rid`` picks a specific replica (default: the UP
+        replica with the fewest outstanding requests, newest on
+        ties).  ``wait_s`` blocks until the process exits."""
+        with self._lock:
+            ups = [r for r in self._replicas if r.state == UP]
+            if rid is not None:
+                victims = [r for r in ups if r.rid == rid]
+            else:
+                victims = sorted(ups, key=lambda r: (r.outstanding,
+                                                     -r.started))
+            if not victims:
+                raise ValueError("no UP replica to retire (%s)"
+                                 % (rid or "fleet empty"))
+            victim = victims[0]
+            # out of rotation FIRST: no new work lands on it while
+            # it drains what it has
+            victim.state = DRAINING
+            victim.reason = "retired"
+        telemetry.record_event("fleet.replica_retired",
+                               replica=victim.rid)
+        self._set_gauges()
+        self.info("retiring replica %s (graceful drain)", victim.rid)
+        victim.terminate()
+        if wait_s:
+            deadline = time.monotonic() + float(wait_s)
+            while victim.proc.poll() is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+        return victim
+
+    def shutdown_fleet(self):
+        """SIGTERM every live replica and reap them (router stop)."""
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
+            r.terminate()
+        deadline = time.monotonic() + 30.0
+        for r in replicas:
+            while r.proc.poll() is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            if r.proc.poll() is None:
+                r.kill()
+            r.close_conns()
+            r.state = DEAD
+            r.reason = r.reason or "shutdown"
+
+    def stop(self):
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10)
+            self._monitor = None
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        super(FleetRouter, self).stop()
+        self.shutdown_fleet()
+
+    def drain(self):
+        """Graceful fleet shutdown (the SIGTERM path): refuse new
+        work, drain every replica, exit."""
+        self._draining = True
+        telemetry.record_event("fleet.drain")
+        self.stop()
+
+    # -- rotation -----------------------------------------------------------
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas)
+
+    def up_count(self):
+        with self._lock:
+            return sum(1 for r in self._replicas if r.state == UP)
+
+    def alive_count(self):
+        """Replicas that count toward fleet size: up, still spawning,
+        or draining out (a retire in progress must not read as
+        "below min_replicas" and trigger an immediate replacement
+        spawn for a replica the operator deliberately removed — it
+        leaves the count when its drain finishes)."""
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.state in (UP, SPAWNING, DRAINING))
+
+    def _pick(self, exclude=()):
+        """Least-outstanding-requests balancing over UP replicas;
+        ties rotate.  Claims one outstanding slot on the winner."""
+        with self._lock:
+            ups = [r for r in self._replicas
+                   if r.state == UP and r.rid not in exclude]
+            if not ups:
+                return None
+            lowest = min(r.outstanding for r in ups)
+            tied = [r for r in ups if r.outstanding == lowest]
+            replica = tied[self._rr % len(tied)]
+            self._rr += 1
+            replica.outstanding += 1
+            return replica
+
+    def _release(self, replica, served=False):
+        with self._lock:
+            replica.outstanding = max(0, replica.outstanding - 1)
+            if served:
+                replica.served += 1
+
+    def _eject(self, replica, state, reason):
+        with self._lock:
+            if replica.state == DEAD:
+                return False
+            replica.state = state
+            replica.reason = reason
+        replica.close_conns()
+        if telemetry.enabled():
+            telemetry.counter("router.replica_ejections").inc()
+        self._set_gauges()
+        return True
+
+    def _set_gauges(self):
+        if not telemetry.enabled():
+            return
+        with self._lock:
+            total = sum(1 for r in self._replicas
+                        if r.state != DEAD)
+            up = sum(1 for r in self._replicas if r.state == UP)
+        telemetry.gauge("fleet.replicas").set(total)
+        telemetry.gauge("fleet.replicas_up").set(up)
+
+    # -- health monitor -----------------------------------------------------
+    def _monitor_loop(self):
+        interval = float(_fleet.get("probe_interval_s", 1.0))
+        max_failures = int(_fleet.get("probe_failures", 3))
+        while not self._monitor_stop.wait(interval):
+            for replica in self.replicas():
+                self._probe(replica, max_failures)
+
+    def _probe(self, replica, max_failures):
+        code = replica.proc.poll()
+        if code is not None:
+            if replica.state in (UP, SPAWNING):
+                # an unplanned exit: eject + count a death (a
+                # DRAINING replica exiting 0 is a finished retire)
+                if self._eject(replica, DEAD, "exited_%s" % code):
+                    if telemetry.enabled():
+                        telemetry.counter(
+                            "router.replica_deaths").inc()
+                    telemetry.record_event(
+                        "fleet.replica_dead", replica=replica.rid,
+                        exit_code=code)
+                    self.warning("replica %s died (exit %s)",
+                                 replica.rid, code)
+            elif replica.state == DRAINING:
+                replica.state = DEAD
+                self._set_gauges()
+            return
+        if replica.state != UP:
+            return
+        try:
+            with urllib.request.urlopen(replica.url + "/healthz",
+                                        timeout=5) as resp:
+                payload = json.loads(resp.read())
+            replica.probe_failures = 0
+            if payload.get("draining"):
+                self._eject(replica, DRAINING, "draining")
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            replica.probe_failures = 0
+            try:
+                if json.loads(body).get("draining"):
+                    self._eject(replica, DRAINING, "draining")
+            except ValueError:
+                pass
+        except OSError:
+            replica.probe_failures += 1
+            if replica.probe_failures >= max_failures:
+                if self._eject(replica, DEAD, "unreachable"):
+                    telemetry.record_event(
+                        "fleet.replica_dead", replica=replica.rid,
+                        exit_code=None, reason="unreachable")
+                    self.warning("replica %s unreachable after %d "
+                                 "probes — ejected", replica.rid,
+                                 replica.probe_failures)
+                    replica.kill()
+
+    # -- the proxy ----------------------------------------------------------
+    def _send_to(self, replica, method, path, body, headers):
+        """One forwarded request over a (reused) keep-alive
+        connection.  Raises :class:`_NeverSentError` when the connect
+        failed (resend safe) and :class:`_SentUnknownError` when the
+        connection broke after bytes went out — including a stale
+        parked connection the replica had closed; the admitted-rid
+        oracle then clears (or forbids) the resend either way."""
+        head = ["%s %s HTTP/1.1" % (method, path),
+                "Host: %s:%d" % (replica.host, replica.port),
+                "Content-Length: %d" % len(body or b"")]
+        for key, value in headers.items():
+            head.append("%s: %s" % (key, value))
+        request_bytes = ("\r\n".join(head) + "\r\n\r\n").encode(
+            "latin-1") + (body or b"")
+        conn, reused = replica.get_conn()
+        try:
+            status, resp_headers, data, close = conn.round_trip(
+                request_bytes)
+        except socket.timeout as e:
+            conn.close()
+            raise _SentUnknownError("proxy timeout: " + repr(e),
+                                    timed_out=True)
+        except (OSError, ValueError, IndexError) as e:
+            conn.close()
+            raise _SentUnknownError(
+                ("stale-keepalive " if reused else "") + repr(e))
+        if close:
+            conn.close()
+        else:
+            replica.put_conn(conn)
+        return status, resp_headers, data
+
+    def _rid_admitted(self, replica, rid, sent_at):
+        """Ask the replica's admitted-rid oracle.  True/False, or
+        None when the answer cannot be trusted — dead/unreachable, a
+        batcher that does not track rids (a single-engine
+        micro-batcher replica), or a bounded ring whose history no
+        longer COVERS our send: once entries admitted after
+        ``sent_at`` have been evicted, an evicted rid and a
+        never-seen rid are indistinguishable, so a miss stops being
+        proof.  None means a resend is UNSAFE.  (``sent_at`` is wall
+        time — replicas run on this host, sharing the clock; a small
+        margin absorbs scheduling jitter.)"""
+        try:
+            with urllib.request.urlopen(
+                    replica.url + "/admitted/" + rid,
+                    timeout=5) as resp:
+                doc = json.loads(resp.read())
+            if not doc.get("tracked"):
+                return None
+            if doc.get("admitted"):
+                return True
+            if doc.get("evictions"):
+                oldest = doc.get("oldest_retained_ts")
+                if oldest is None or oldest > sent_at - 0.5:
+                    return None  # the miss may BE the eviction
+            return False
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _refused_pre_admission(status, data):
+        """``"draining"`` / ``"warming"`` / None for a reply that
+        PROVES the replica refused the request before its batcher
+        admitted it — the resend-safe 503s.  (429s are also
+        pre-admission, but a shed is the fleet's backpressure signal:
+        it relays to the client rather than retrying, or the router
+        would amplify overload.)"""
+        if status != 503:
+            return None
+        try:
+            doc = json.loads(data)
+        except ValueError:
+            return None
+        err = str(doc.get("error", ""))
+        if "draining" in err:
+            return "draining"
+        if "warming" in err:
+            return "warming"
+        return None
+
+    def _proxy_predict(self, handler, path):
+        if telemetry.enabled():
+            telemetry.counter("router.requests").inc()
+        rid = (handler.headers.get("X-Request-Id") or "").strip()
+        rid = rid[:64] if rid else uuid.uuid4().hex[:12]
+        echo = {"X-Request-Id": rid}
+        if self._draining:
+            handler._drain_body()
+            handler._send_json(
+                503, {"error": "router draining", "request_id": rid},
+                headers=dict(echo, **{"Retry-After": "1"}))
+            return
+        try:
+            body = handler._read_body()
+        except BodyTooLargeError as e:
+            handler._send_json(413, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
+            return
+        except ValueError as e:
+            handler._send_json(400, {"error": str(e),
+                                     "request_id": rid}, headers=echo)
+            return
+        fwd_headers = {"X-Request-Id": rid}
+        for name in ("Content-Type", "X-Priority"):
+            value = handler.headers.get(name)
+            if value:
+                fwd_headers[name] = value
+        retries = int(_fleet.get("route_retries", 2))
+        tried = set()
+        for attempt in range(retries + 1):
+            replica = self._pick(exclude=tried)
+            if replica is None:
+                handler._send_json(
+                    503, {"error": "no replica available",
+                          "request_id": rid},
+                    headers=dict(echo, **{"Retry-After": "1"}))
+                return
+            tried.add(replica.rid)
+            sent_at = time.time()
+            try:
+                status, resp_headers, data = self._send_to(
+                    replica, "POST", path, body, fwd_headers)
+            except _NeverSentError:
+                # nothing went out: resend is safe by construction
+                self._release(replica)
+                self._note_retry(replica, rid, "connect_failed")
+                continue
+            except _SentUnknownError as e:
+                self._release(replica)
+                # a proxy TIMEOUT never consults the oracle: the
+                # connection may still be alive with the request
+                # buffered, so "not admitted" would only mean "not
+                # admitted YET" — a resend could still double-
+                # dispatch when the replica catches up.  Only a
+                # dead connection (reset/EOF) makes the oracle's
+                # answer final.
+                admitted = (None if e.timed_out
+                            else self._rid_admitted(replica, rid,
+                                                    sent_at))
+                if admitted is False:
+                    # the replica is alive and its batcher never saw
+                    # this rid — the socket broke pre-admission
+                    self._note_retry(replica, rid, "not_admitted")
+                    continue
+                # admitted (may have dispatched) or unknowable (the
+                # replica died with the answer): an honest 503, never
+                # a duplicate dispatch
+                if telemetry.enabled():
+                    telemetry.counter("router.unsafe_503s").inc()
+                handler._send_json(
+                    503, {"error": "replica connection lost "
+                                   "mid-request; retry unsafe "
+                                   "(admission %s): %s"
+                                   % ("confirmed" if admitted
+                                      else "unknown", e),
+                          "request_id": rid,
+                          "retry_safe": False},
+                    headers=dict(echo, **{"Retry-After": "1"}))
+                return
+            served = status < 500
+            self._release(replica, served=served)
+            refusal = self._refused_pre_admission(status, data)
+            if refusal is not None:
+                # the replica said no BEFORE admission — a resend on
+                # a peer is safe.  Draining additionally leaves
+                # rotation for good; warming is transient (a model
+                # mid-hot-add), so the replica stays in rotation and
+                # only this request tries a peer
+                if refusal == "draining":
+                    self._eject(replica, DRAINING, "draining")
+                self._note_retry(replica, rid,
+                                 "refused_" + refusal)
+                continue
+            ctype = resp_headers.get("Content-Type") or \
+                "application/json"
+            out_headers = dict(echo)
+            if resp_headers.get("Retry-After"):
+                out_headers["Retry-After"] = \
+                    resp_headers["Retry-After"]
+            if telemetry.enabled():
+                telemetry.counter("router.proxied").inc()
+            _relay_reply(handler, status, ctype, data, out_headers)
+            return
+        handler._send_json(
+            503, {"error": "no replica accepted the request after "
+                           "%d attempts" % (retries + 1),
+                  "request_id": rid},
+            headers=dict(echo, **{"Retry-After": "1"}))
+
+    def _note_retry(self, replica, rid, why):
+        if telemetry.enabled():
+            telemetry.counter("router.retries").inc()
+        self.info("retrying %s on a peer (%s was %s)", rid,
+                  replica.rid, why)
+
+    def _admin_fanout(self, handler, method, path):
+        """Admin mutations (add/reload/remove a model) apply to EVERY
+        up replica — the fleet stays homogeneous.  Replies with the
+        per-replica outcomes; any failure is a 502."""
+        try:
+            body = handler._read_body()
+        except ValueError as e:
+            handler._send_json(400, {"error": str(e)})
+            return
+        results, ok = {}, True
+        for replica in self.replicas():
+            if replica.state != UP:
+                continue
+            try:
+                status, _, data = self._send_to(
+                    replica, method, path, body,
+                    {"Content-Type": "application/json"})
+                try:
+                    doc = json.loads(data)
+                except ValueError:
+                    doc = {"raw": data.decode("utf-8", "replace")}
+                results[replica.rid] = {"status": status,
+                                        "reply": doc}
+                ok = ok and status < 400
+            except (_NeverSentError, _SentUnknownError) as e:
+                results[replica.rid] = {"status": None,
+                                        "error": str(e)}
+                ok = False
+        handler._send_json(200 if ok else 502,
+                           {"ok": ok, "replicas": results})
+
+    # -- aggregation --------------------------------------------------------
+    def _fetch(self, replica, path, timeout=10):
+        with urllib.request.urlopen(replica.url + path,
+                                    timeout=timeout) as resp:
+            return resp.read()
+
+    def _up_payloads(self, path, parse_json=True):
+        """{rid: payload} over the UP replicas; fetch failures are
+        skipped (the monitor will eject)."""
+        out = {}
+        for replica in self.replicas():
+            if replica.state != UP:
+                continue
+            try:
+                raw = self._fetch(replica, path)
+                out[replica.rid] = (json.loads(raw) if parse_json
+                                    else raw.decode())
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def aggregate_metrics(self):
+        """One Prometheus exposition for the whole fleet: the
+        per-series SUM over every replica (counters add; gauges add —
+        fleet queue depth is the sum of replica queue depths), with
+        the router's own registry appended after."""
+        texts = list(self._up_payloads("/metrics",
+                                       parse_json=False).values())
+        merged = _merge_prometheus(texts)
+        own = telemetry.prometheus_text() if telemetry.enabled() \
+            else ""
+        return merged + ("\n" if merged and own else "") + own
+
+    def aggregate_slo(self):
+        """The fleet ``/slo``: per-model good/bad/total SUMMED across
+        replicas; burn rates aggregate as the fleet MAX and the
+        budget as the fleet MIN (the conservative paging view — one
+        replica burning its budget pages even when its peers are
+        green).  Per-replica payloads ride along."""
+        payloads = self._up_payloads("/slo")
+        models = {}
+        meta = None
+        for rid, doc in sorted(payloads.items()):
+            meta = meta or doc
+            for name, m in (doc.get("models") or {}).items():
+                agg = models.setdefault(name, {
+                    "good": 0, "bad": 0, "total": 0,
+                    "error_budget_remaining": None,
+                    "burn_rate": {"fast": None, "slow": None},
+                    "burning": False,
+                })
+                agg["good"] += int(m.get("good") or 0)
+                agg["bad"] += int(m.get("bad") or 0)
+                agg["total"] += int(m.get("total") or 0)
+                budget = m.get("error_budget_remaining")
+                if budget is not None:
+                    prev = agg["error_budget_remaining"]
+                    agg["error_budget_remaining"] = (
+                        budget if prev is None else min(prev, budget))
+                for window in ("fast", "slow"):
+                    burn = (m.get("burn_rate") or {}).get(window)
+                    if burn is not None:
+                        prev = agg["burn_rate"][window]
+                        agg["burn_rate"][window] = (
+                            burn if prev is None else max(prev, burn))
+                agg["burning"] = agg["burning"] or \
+                    bool(m.get("burning"))
+        for agg in models.values():
+            total = agg["total"]
+            agg["good_pct"] = (round(100.0 * agg["good"] / total, 3)
+                               if total else None)
+        out = {
+            "fleet": True,
+            "aggregation": {"counts": "sum", "burn_rate": "max",
+                            "error_budget_remaining": "min"},
+            "models": models,
+            "replicas": payloads,
+        }
+        for key in ("enabled", "slo_ms", "target_pct", "windows_s",
+                    "burn_threshold"):
+            if meta is not None and key in meta:
+                out[key] = meta[key]
+        return out
+
+    def queued_rows_total(self):
+        """Fleet-wide queued rows (the autoscaler's queue-depth
+        feed): the sum of every replica's /statusz queued_rows."""
+        total = 0
+        for doc in self._up_payloads("/statusz").values():
+            total += int(doc.get("queued_rows") or 0)
+        return total
+
+    def healthz(self):
+        with self._lock:
+            blocks = {r.rid: r.stats() for r in self._replicas}
+        up = sum(1 for b in blocks.values() if b["state"] == UP)
+        payload = {
+            "ready": up > 0 and not self._draining,
+            "degraded": 0 < up < sum(
+                1 for b in blocks.values() if b["state"] != DEAD),
+            "fleet": True,
+            "replicas_up": up,
+            "replicas": blocks,
+        }
+        if self._draining:
+            payload["draining"] = True
+            return 503, payload
+        return (200 if up else 503), payload
+
+    def statusz(self):
+        with self._lock:
+            blocks = [r.stats() for r in self._replicas]
+        payload = {
+            "fleet": {
+                "replicas": blocks,
+                "up": sum(1 for b in blocks if b["state"] == UP),
+                "draining": self._draining,
+                "replica_argv": self._replica_argv,
+            },
+            "queued_rows_total": self.queued_rows_total(),
+        }
+        if self.autoscaler is not None:
+            payload["autoscaler"] = self.autoscaler.status()
+        return payload
+
+    def models(self):
+        """One replica's /models payload (the fleet is homogeneous)
+        plus the fleet block — loadgen's ``discover_models`` works
+        against the router unchanged."""
+        payloads = self._up_payloads("/models")
+        doc = next(iter(payloads.values()), {"models": {}})
+        doc["fleet"] = {"replicas_up": len(payloads)}
+        return doc
+
+    # -- the handler --------------------------------------------------------
+    def make_handler(self):
+        router = self
+
+        class Handler(HandlerBase):
+            owner = router
+
+            def do_GET(self):
+                path = self.path.partition("?")[0]
+                if path == "/healthz":
+                    code, payload = router.healthz()
+                    self._send_json(code, payload)
+                elif path == "/metrics":
+                    self._send(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        router.aggregate_metrics().encode())
+                elif path == "/slo":
+                    self._send_json(200, router.aggregate_slo())
+                elif path == "/models":
+                    self._send_json(200, router.models())
+                elif path in ("/", "/statusz"):
+                    self._send_json(200, router.statusz())
+                elif self._handle_debug():
+                    pass
+                else:
+                    self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = self.path.partition("?")[0]
+                if path == "/predict" or \
+                        path.startswith("/predict/"):
+                    router._proxy_predict(self, path)
+                elif path == "/fleet/scale_up":
+                    # operator/autoscaler surface: spawn one replica,
+                    # wait it into rotation, reply with its stats
+                    self._drain_body()
+                    try:
+                        replica = router.scale_up()
+                    except Exception as e:  # noqa: BLE001 - to HTTP
+                        self._send_json(500, {"error": repr(e)})
+                        return
+                    self._send_json(200, {"scaled_up": True,
+                                          "replica": replica.stats()})
+                elif path == "/fleet/retire":
+                    try:
+                        doc = json.loads(
+                            self._read_body().decode() or "{}")
+                        victim = router.retire(
+                            rid=doc.get("replica"),
+                            wait_s=float(doc.get("wait_s") or 30.0))
+                    except ValueError as e:
+                        self._send_json(400, {"error": str(e)})
+                        return
+                    except Exception as e:  # noqa: BLE001 - to HTTP
+                        self._send_json(500, {"error": repr(e)})
+                        return
+                    self._send_json(200, {"retired": True,
+                                          "replica": victim.stats()})
+                elif path == "/reload" or \
+                        path.startswith("/models/"):
+                    router._admin_fanout(self, "POST", path)
+                else:
+                    self._drain_body()
+                    self._send_json(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                path = self.path.partition("?")[0]
+                if path.startswith("/models/"):
+                    router._admin_fanout(self, "DELETE", path)
+                else:
+                    self._drain_body()
+                    self._send_json(404, {"error": "not found"})
+
+        return Handler
+
+
+#: reason phrases for the fast relay write (the statuses a replica's
+#: /predict can produce)
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            413: "Payload Too Large", 429: "Too Many Requests",
+            500: "Internal Server Error", 503: "Service Unavailable",
+            504: "Gateway Timeout"}
+
+
+def _relay_reply(handler, status, ctype, data, headers):
+    """Write a proxied reply in ONE buffered send, bypassing
+    ``send_response``'s per-reply date formatting and logging — the
+    relay's reply path is as hot as its forward path."""
+    lines = ["HTTP/1.1 %d %s" % (status,
+                                 _REASONS.get(status, "Status")),
+             "Content-Type: %s" % ctype,
+             "Content-Length: %d" % len(data)]
+    for key, value in headers.items():
+        lines.append("%s: %s" % (key, value))
+    try:
+        handler.wfile.write(
+            ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+            + data)
+    except (BrokenPipeError, ConnectionResetError):
+        pass  # the client went away; nothing to tell it
+
+
+#: per-series aggregation overrides for ratio-style gauges, matched
+#: by sample-name prefix: summing two replicas' error budgets would
+#: read 2.0 on a healthy fleet (an alert on budget < 0.5 could never
+#: fire) — these take the same conservative view the /slo aggregation
+#: uses: budget = fleet MIN, burn = fleet MAX
+_MERGE_RULES = (
+    ("znicz_slo_error_budget_remaining", min),
+    ("znicz_slo_burn_rate", max),
+)
+
+
+def _merge_rule(name):
+    for prefix, rule in _MERGE_RULES:
+        if name.startswith(prefix):
+            return rule
+    return None  # default: sum
+
+
+def _merge_prometheus(texts):
+    """Merge Prometheus text expositions sample-by-sample: counters,
+    histogram buckets and additive gauges SUM (fleet queue depth =
+    the sum of replica queue depths); ratio gauges follow
+    ``_MERGE_RULES`` (budget = min, burn = max — the conservative
+    paging view, matching :meth:`FleetRouter.aggregate_slo`).
+    HELP/TYPE lines come from the first exposition that carries each
+    family; sample order follows first appearance."""
+    meta = {}           # family -> [help line, type line]
+    merged = {}         # full sample key (name{labels}) -> float
+    order = []          # sample keys, first-seen order
+    families = {}       # sample key -> family
+    for text in texts:
+        pending_help = pending_type = None
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                pending_help = line
+                continue
+            if line.startswith("# TYPE "):
+                pending_type = line
+                family = line.split()[2]
+                if family not in meta:
+                    meta[family] = [pending_help, pending_type]
+                continue
+            if line.startswith("#"):
+                continue
+            key, _, value = line.rpartition(" ")
+            if not key:
+                continue
+            try:
+                v = float(value)
+            except ValueError:
+                continue
+            if key not in merged:
+                merged[key] = v
+                order.append(key)
+                name = key.partition("{")[0]
+                # histogram samples (_bucket/_sum/_count) belong to
+                # the base family's HELP/TYPE block
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if name.endswith(suffix) and \
+                            name[:-len(suffix)] in meta:
+                        name = name[:-len(suffix)]
+                        break
+                families[key] = name
+            else:
+                rule = _merge_rule(key.partition("{")[0])
+                merged[key] = (rule(merged[key], v) if rule
+                               else merged[key] + v)
+    lines = []
+    emitted = set()
+    for key in order:
+        family = families[key]
+        if family not in emitted:
+            emitted.add(family)
+            help_line, type_line = meta.get(family, (None, None))
+            if help_line:
+                lines.append(help_line)
+            if type_line:
+                lines.append(type_line)
+        v = merged[key]
+        lines.append("%s %s" % (key, int(v) if v == int(v) else v))
+    return "\n".join(lines)

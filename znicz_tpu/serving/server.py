@@ -17,11 +17,16 @@ HTTP clients coalesce without any extra machinery.  Two modes:
 Endpoints:
 
 * ``POST /predict`` and ``POST /predict/<model>`` — JSON body
-  ``{"inputs": [[...], ...], "model": optional}`` (or a bare JSON
-  array), or a raw ``.npy`` payload with
+  ``{"inputs": [[...], ...], "model": optional, "priority":
+  optional}`` (or a bare JSON array), or a raw ``.npy`` payload with
   ``Content-Type: application/octet-stream``.  The path segment wins
   over the body's ``model`` field; neither = the registry's default
-  model.  Replies in kind: JSON ``{"outputs": ..., "argmax": ...,
+  model.  The request's priority lane (``high``/``normal``/``low``,
+  default normal — the ``X-Priority`` header wins over the body
+  field; unknown spellings 400) picks the continuous batcher's
+  admission/dispatch lane: low sheds first under overload
+  (serving/continuous.py "Priority lanes").  Replies in kind:
+  JSON ``{"outputs": ..., "argmax": ...,
   "model": ..., "model_version": ..., "request_id": ...}`` or raw
   ``.npy`` bytes.  Status codes: 400 malformed, 404 unknown model,
   413 body over ``root.common.serving.max_body_bytes`` (refused
@@ -56,6 +61,10 @@ Endpoints:
   ``root.common.serving.slo_enabled``): per-model good/total from
   request admission, fast/slow-window burn rates, error budget
   remaining — the feed the autoscaler consumes.
+* ``GET /admitted/<rid>`` — the batcher's admitted-request-id oracle
+  (was this rid ever admitted to a dispatch lane?): the fleet
+  router's retry-safety check (serving/router.py) — a resend of an
+  admitted rid on a peer would risk a duplicate dispatch.
 * ``GET /debug/health`` / ``GET /debug/events`` /
   ``GET /debug/profile?seconds=N`` / ``GET /debug/profiler`` /
   ``GET /debug/timeseries`` / ``GET /debug/trace/<rid>`` — the
@@ -76,6 +85,11 @@ CLI (the ``serve`` entry point of ``python -m znicz_tpu``)::
     # NAME=PATH@DTYPE (docs/serving.md "Precision modes"):
     python -m znicz_tpu serve model.zip --dtype int8
     python -m znicz_tpu serve a=m.zip@int8 b=m.zip   # same model, 2 dtypes
+    # multi-replica fleet: N replica subprocesses sharing one compile
+    # cache behind the front-end router (serving/router.py), with the
+    # SLO-burn autoscaler (serving/autoscaler.py) optionally armed:
+    python -m znicz_tpu serve wine=wine.zip --fleet 2 --autoscale \
+        --config common.serving.slo_enabled=True
 """
 
 import argparse
@@ -96,6 +110,7 @@ from znicz_tpu.serving.batcher import (BatcherStoppedError, MicroBatcher,
                                        QueueFullError,
                                        RequestTimeoutError)
 from znicz_tpu.serving.breaker import CircuitOpenError
+from znicz_tpu.serving.continuous import normalize_priority
 from znicz_tpu.serving.engine import InferenceEngine
 from znicz_tpu.serving.registry import ModelRegistry, UnknownModelError
 
@@ -230,19 +245,26 @@ class ServingServer(HttpServerBase):
 
     # -- request plumbing ---------------------------------------------------
     def _parse_predict(self, handler):
-        """(array-or-None, timeout_ms, raw_reply, model) from the
-        request body; the array stays unparsed (None) until the model
-        is known — it must parse straight into THAT model's dtype."""
+        """(array-or-None, timeout_ms, raw_reply, model, priority)
+        from the request body; the array stays unparsed (None) until
+        the model is known — it must parse straight into THAT model's
+        dtype.  The ``X-Priority`` header wins over the body's
+        ``priority`` field (the router forwards the header)."""
         body = handler._read_body()
         ctype = (handler.headers.get("Content-Type") or "").split(";")[0]
+        priority = (handler.headers.get("X-Priority") or "").strip() \
+            or None
         if ctype == "application/octet-stream" or \
                 body[:6] == b"\x93NUMPY":
-            return numpy.load(io.BytesIO(body)), None, True, None
+            return (numpy.load(io.BytesIO(body)), None, True, None,
+                    normalize_priority(priority))
         doc = json.loads(body.decode() or "null")
         if isinstance(doc, dict):
             inputs = doc.get("inputs")
             timeout_ms = doc.get("timeout_ms")
             model = doc.get("model")
+            if priority is None:
+                priority = doc.get("priority")
         else:
             inputs, timeout_ms, model = doc, None, None
         if inputs is None:
@@ -250,7 +272,10 @@ class ServingServer(HttpServerBase):
                              "(or a raw .npy payload)")
         if model is not None and not isinstance(model, str):
             raise ValueError('"model" must be a string')
-        return inputs, timeout_ms, False, model
+        # validate HERE (the 400 path): an unknown priority must fail
+        # before the request costs a parse or an admission attempt
+        priority = normalize_priority(priority)
+        return inputs, timeout_ms, False, model, priority
 
     @staticmethod
     def _request_id(handler):
@@ -297,7 +322,7 @@ class ServingServer(HttpServerBase):
                 headers=dict(echo, **{"Retry-After": "1"}))
             return 503, model
         try:
-            inputs, timeout_ms, raw, body_model = \
+            inputs, timeout_ms, raw, body_model, priority = \
                 self._parse_predict(handler)
         except BodyTooLargeError as e:
             # the unread oversized body already forced Connection:
@@ -345,8 +370,12 @@ class ServingServer(HttpServerBase):
             if self._routed_batcher:
                 y = self.batcher.predict(x, model=model,
                                          timeout_ms=timeout_ms,
-                                         request_id=rid)
+                                         request_id=rid,
+                                         priority=priority)
             else:
+                # the micro-batcher has one FIFO lane: priority is
+                # validated (a typo still 400s) but not enforced —
+                # priority lanes are a continuous-batcher feature
                 y = self.batcher.predict(x, timeout_ms=timeout_ms,
                                          request_id=rid)
         except UnknownModelError as e:
@@ -526,6 +555,23 @@ class ServingServer(HttpServerBase):
                             "models": {"default":
                                        server.engine.stats()},
                             "default": "default"})
+                elif path.startswith("/admitted/"):
+                    # the fleet router's idempotency oracle: was this
+                    # rid ever admitted to the batcher's dispatch
+                    # lanes?  admitted = a resend on a peer risks a
+                    # duplicate dispatch; the coverage fields say how
+                    # far back a MISS counts as proof (serving/
+                    # router.py retry safety rule)
+                    rid = path[len("/admitted/"):]
+                    probe = getattr(server.batcher,
+                                    "admitted_status", None)
+                    payload = {"rid": rid, "tracked":
+                               probe is not None}
+                    if probe is not None:
+                        payload.update(probe(rid))
+                    else:
+                        payload["admitted"] = False
+                    self._send_json(200, payload)
                 elif path == "/metrics":
                     self._send_metrics()
                 elif path == "/slo":
@@ -563,6 +609,96 @@ class ServingServer(HttpServerBase):
                     self._send_json(404, {"error": "not found"})
 
         return Handler
+
+
+def sys_argv_tail():
+    """The serve subcommand's raw argv (``python -m znicz_tpu serve
+    ...`` → everything after "serve") — the list the fleet mode strips
+    its router-only flags from."""
+    import sys
+    argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        argv = argv[1:]
+    return argv
+
+
+#: router-only serve flags, stripped from the replica argv
+#: (flag -> takes a value)
+_ROUTER_ONLY_FLAGS = {"--fleet": True, "--port": True, "--host": True,
+                      "--autoscale": False}
+
+
+def _replica_argv(raw_argv):
+    """The argv every fleet replica runs: the operator's serve args
+    minus the router-only flags (each replica binds its own port 0;
+    model specs, knob overrides and batching flags pass through)."""
+    out, i = [], 0
+    while i < len(raw_argv):
+        tok = raw_argv[i]
+        flag = tok.split("=", 1)[0]
+        if flag in _ROUTER_ONLY_FLAGS:
+            i += 1
+            if _ROUTER_ONLY_FLAGS[flag] and "=" not in tok and \
+                    i < len(raw_argv):
+                i += 1  # the flag's value
+            continue
+        out.append(tok)
+        i += 1
+    return out
+
+
+def _fleet_main(args, raw_argv):
+    """The ``serve --fleet N`` path: spawn the replica fleet behind
+    the front-end router (serving/router.py), optionally armed with
+    the autoscaler, and run the same SIGTERM-drain loop single-process
+    serving uses."""
+    from znicz_tpu.serving.autoscaler import Autoscaler
+    from znicz_tpu.serving.router import FleetRouter
+
+    telemetry.enable()  # the router's own series + journal
+    cfg = root.common.serving
+    replica_argv = _replica_argv(raw_argv)
+    if "--compile-cache" not in replica_argv:
+        # the fleet's whole cold-start story: every replica after the
+        # first deserializes the shared cache instead of compiling
+        replica_argv += ["--compile-cache",
+                         compile_cache.configured_dir()]
+    router = FleetRouter(
+        replica_argv, replicas=args.fleet,
+        port=(args.port if args.port is not None
+              else cfg.get("port", 8899)),
+        host=args.host).start()
+    if args.autoscale:
+        router.autoscaler = Autoscaler(router).start()
+    print("fleet of %d replica%s behind http://%s:%d/  (predict: "  # noqa
+          "POST /predict[/<model>]; fleet health: GET /healthz; "
+          "aggregated: GET /metrics, GET /slo%s)"
+          % (args.fleet, "" if args.fleet == 1 else "s",
+             router.host, router.port,
+             "; autoscaler armed" if args.autoscale else ""))
+    import signal
+    import threading
+    term = threading.Event()
+
+    def _on_term(signum, frame):
+        term.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # non-main thread (embedding) — CTRL-C only
+        pass
+    try:
+        while not term.wait(1.0):
+            if router._thread is None or \
+                    not router._thread.is_alive():
+                break
+    except KeyboardInterrupt:
+        print("shutting down fleet")  # noqa: T201 - CLI feedback
+    finally:
+        if term.is_set():
+            print("SIGTERM: draining the fleet")  # noqa: T201
+        router.drain()
+    return 0
 
 
 def main(argv=None):
@@ -619,7 +755,36 @@ def main(argv=None):
                              "root.common.compile_cache.dir) so a "
                              "restarted replica cold-starts with "
                              "zero fresh compiles")
+    parser.add_argument("--config", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="config-root override (e.g. common."
+                             "serving.slo_enabled=True) — applied "
+                             "here AND forwarded to every --fleet "
+                             "replica")
+    parser.add_argument("--fleet", type=int, default=None,
+                        metavar="N",
+                        help="serve a fleet of N replica "
+                             "subprocesses sharing one persistent "
+                             "compile cache behind the front-end "
+                             "router (serving/router.py): least-"
+                             "outstanding balancing, health-aware "
+                             "rotation, aggregated /metrics //slo/"
+                             "/healthz//models")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="fleet mode: arm the SLO-burn-driven "
+                             "autoscaler (serving/autoscaler.py; "
+                             "root.common.serving.fleet.* knobs)")
     args = parser.parse_args(argv)
+    from znicz_tpu.core.config import apply_override
+    for assignment in args.config:
+        apply_override(assignment)
+    if args.autoscale and args.fleet is None:
+        parser.error("--autoscale needs --fleet N")
+    if args.fleet is not None:
+        if args.fleet < 1:
+            parser.error("--fleet needs at least 1 replica")
+        return _fleet_main(args, list(argv) if argv is not None
+                           else sys_argv_tail())
 
     telemetry.enable()  # /metrics should work out of the box
     if args.compile_cache is not None:

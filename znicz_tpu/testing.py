@@ -28,6 +28,52 @@ from znicz_tpu.core.memory import Array
 from znicz_tpu.core.workflow import DummyWorkflow
 
 
+def build_fc_package_zip(path, dims, seed=42, scale=None,
+                         weights_transposed=True):
+    """Write a deterministic synthetic FC deployment-package zip
+    (``manifest.json`` + per-layer ``w<i>.npy``/``b<i>.npy``) — the
+    ONE builder the serving bench/smoke/fleet tests share instead of
+    each hand-rolling the manifest format.
+
+    ``dims`` is the full layer-width chain (``[in, hidden..., out]``;
+    hidden layers are ``all2all_tanh``, the head is ``softmax``);
+    ``scale`` multiplies the ``randn`` weights (None = raw randn);
+    ``weights_transposed`` is recorded per layer (True stores
+    ``(in, out)`` arrays — the export convention most tests use).
+    Returns ``path``.
+    """
+    import io
+    import json
+    import zipfile
+    r = numpy.random.RandomState(seed)
+    layers, arrays = [], {}
+    for i in range(len(dims) - 1):
+        kind = "softmax" if i == len(dims) - 2 else "all2all_tanh"
+        layers.append(
+            {"type": kind, "name": "l%d" % i,
+             "arrays": {"weights": "w%d.npy" % i,
+                        "bias": "b%d.npy" % i},
+             "include_bias": True,
+             "weights_transposed": bool(weights_transposed)})
+        shape = ((dims[i], dims[i + 1]) if weights_transposed
+                 else (dims[i + 1], dims[i]))
+        w = r.randn(*shape).astype(numpy.float32)
+        if scale is not None:
+            w *= scale
+        arrays["w%d.npy" % i] = w
+        arrays["b%d.npy" % i] = numpy.zeros(dims[i + 1],
+                                            numpy.float32)
+    manifest = {"format": 1, "layers": layers,
+                "input_sample_shape": [int(dims[0])]}
+    with zipfile.ZipFile(os.fspath(path), "w") as zf:
+        zf.writestr("manifest.json", json.dumps(manifest))
+        for fname, arr in arrays.items():
+            buf = io.BytesIO()
+            numpy.save(buf, arr)
+            zf.writestr(fname, buf.getvalue())
+    return path
+
+
 def _collect_outputs(unit, attrs):
     out = {}
     for attr in attrs:
