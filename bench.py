@@ -533,6 +533,10 @@ def main(profile_dir=None):
     # scale-up) + high-priority goodput under 3x overload — both flat
     # keys gated (tools/bench_gate.py)
     _stamp_serving_fleet(out)
+    # fleet-path tracing overhead (ISSUE 16): armed cross-process
+    # tracing vs disabled on the real router, plus the router's
+    # per-request hop overhead — both gated inverted
+    _stamp_serving_fleet_observability(out)
     prec = out.get("serving_precision", {}).get("dtypes")
     if prec and isinstance(out.get("roofline"), dict):
         # the roofline block grows the per-dtype serving axis: where
@@ -1127,6 +1131,167 @@ def _stamp_serving_fleet(out):
          .get("high", {}) or {}).get("goodput_pct") or 0.0)
 
 
+def _serving_fleet_observability_block(seed=11, max_batch=32,
+                                       measure_s=3.0):
+    """The FLEET-path tracing overhead measurement (ISSUE 16): the
+    same seeded open-loop mix against two sequential ``serve --fleet
+    1`` fleets sharing ONE persistent compile cache — first with the
+    observability plane at its shipped defaults (disabled), then with
+    cross-process tracing ARMED (every admission head-sampled at the
+    router, propagated to the replica, plus SLO tracking and the
+    time-series sampler).  The throughput delta is the armed plane's
+    fleet-path cost; separate spawns because the sampling knobs are
+    per-process config, and the shared cache keeps the second fleet's
+    warmup compile-free so no compile asymmetry pollutes the delta.
+
+    Also reads the armed router's ``/slo`` for the per-request hop
+    overhead (router wall minus the replica-reported ``X-Serving-Ms``)
+    and proves the armed lap really traced: the router's trace index
+    must hold sampled rids and at least one of them must stitch into
+    a cross-process tree.
+
+    Stamps follow the ISSUE 14 honest-zero rule: ``overhead_pct`` is
+    floored at 1.0 and ``router_hop_overhead_ms`` at 0.01, so an
+    honest ~zero measurement never reads as tools/bench_gate.py's
+    crash-guard zero; the unfloored values ride along as ``*_raw``."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading
+    import urllib.request
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import loadgen
+    from znicz_tpu.core.config import root
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_obs_")
+    slo_ms = float(root.common.serving.get("slo_ms", 100.0))
+    try:
+        zip_path = _fleet_model_zip(tmp)
+        cache_dir = os.path.join(tmp, "xla_cache")
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+
+        def lap(extra_argv, rid_prefix=None, armed=False):
+            proc = subprocess.Popen(
+                [_sys.executable, "-u", "-m", "znicz_tpu", "serve",
+                 "fleet_model=" + zip_path, "--fleet", "1",
+                 "--port", "0", "--max-batch", str(max_batch),
+                 "--queue-limit", "4096", "--timeout-ms", "0",
+                 "--compile-cache", cache_dir] + list(extra_argv),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo)
+            try:
+                url = None
+                deadline = time.monotonic() + 300.0
+                while time.monotonic() < deadline:
+                    line = proc.stdout.readline()
+                    if not line:
+                        break
+                    m = _FLEET_URL_RE.search(line)
+                    if m:
+                        url = m.group(1)
+                        break
+                if url is None:
+                    raise RuntimeError(
+                        "serve --fleet never printed its URL")
+                threading.Thread(target=proc.stdout.read,
+                                 daemon=True).start()
+                models = loadgen.discover_models(url)
+                pool = loadgen.DaemonPool(128)
+                submit = loadgen.http_submit(url, pool, binary=True,
+                                             rid_prefix=rid_prefix)
+                probe = loadgen.run(
+                    loadgen.make_plan(2500.0, 1.0, seed, models),
+                    models, submit, slo_ms, 1.0, seed)
+                capacity = max(probe.get("wall_rps") or 0.0, 20.0)
+                measured = loadgen.run(
+                    loadgen.make_plan(capacity * 3.0, measure_s,
+                                      seed + 1, models),
+                    models, submit, slo_ms, measure_s, seed + 1)
+
+                def fetch(path):
+                    with urllib.request.urlopen(
+                            url + path, timeout=30) as resp:
+                        return json.loads(resp.read())
+
+                extras = {}
+                if armed:
+                    extras["router_overhead_summary"] = (
+                        fetch("/slo").get("router_overhead_ms")
+                        or {})
+                    index = fetch("/debug/trace")
+                    rids = index.get("rids") or []
+                    extras["traces_sampled"] = len(rids)
+                    extras["fleet_index"] = bool(index.get("fleet"))
+                    stitched = False
+                    for rid in rids[:8]:  # newest first
+                        tree = fetch("/debug/trace/" + rid)
+                        if tree.get("stitched"):
+                            stitched = True
+                            break
+                    extras["stitched_tree"] = stitched
+                    extras["timeseries_sources"] = (
+                        fetch("/debug/timeseries").get("sources")
+                        or [])
+                return (measured.get("wall_rps") or 0.0), extras
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+        rps_off, _ = lap([])
+        rps_on, extras = lap(
+            ["--config", "common.serving.trace_sample_n=1",
+             "--config", "common.serving.slo_enabled=True",
+             "--config", "common.telemetry.timeseries.enabled=True"],
+            rid_prefix="benchobs", armed=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    raw = (1.0 - rps_on / max(rps_off, 1e-9)) * 100.0
+    hop_raw = (extras.get("router_overhead_summary", {})
+               .get("mean_ms") or 0.0)
+    return {
+        "measure_s": measure_s,
+        "disabled_requests_per_sec": round(rps_off, 1),
+        "armed_requests_per_sec": round(rps_on, 1),
+        "overhead_pct_raw": round(raw, 2),
+        "overhead_pct": round(max(raw, 1.0), 2),
+        "router_hop_overhead_ms_raw": round(hop_raw, 3),
+        "router_hop_overhead_ms": round(max(hop_raw, 0.01), 3),
+        "router_overhead_summary":
+            extras.get("router_overhead_summary", {}),
+        # proof the armed fleet actually traced cross-process (a knob
+        # that silently failed to arm would stamp a flattering zero)
+        "armed_traces_sampled": extras.get("traces_sampled", 0),
+        "armed_fleet_index": extras.get("fleet_index", False),
+        "armed_stitched_tree": extras.get("stitched_tree", False),
+        "armed_timeseries_sources":
+            extras.get("timeseries_sources", []),
+    }
+
+
+def _stamp_serving_fleet_observability(out):
+    """Stamp the fleet-tracing overhead block + the flat gated keys
+    (crash-guarded ZERO stamps gated INVERTED by tools/bench_gate.py
+    — a rise past the band, or a crash-guard zero where the previous
+    round had a number, fails the round) — shared by main(),
+    main_serving() and the ``--serving-fleet`` CI entry."""
+    try:
+        out["serving_fleet_observability"] = (
+            _serving_fleet_observability_block())
+    except Exception as e:  # noqa: BLE001 - never kill the primary
+        out["serving_fleet_observability"] = {"error": repr(e)}
+    block = out["serving_fleet_observability"]
+    out["serving_fleet_observability_overhead_pct"] = (
+        block.get("overhead_pct") or 0.0)
+    out["serving_router_hop_overhead_ms"] = (
+        block.get("router_hop_overhead_ms") or 0.0)
+
+
 #: the serving precision axis the bench sweeps (ISSUE 10; ISSUE 12
 #: adds the f32-fast batch-1 latency mode to the same roofline)
 PRECISION_DTYPES = ("f32", "f32_fast", "bf16", "int8")
@@ -1678,18 +1843,23 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
     # ISSUE 15: the multi-replica fleet block — same stamps as the
     # main bench
     _stamp_serving_fleet(out)
+    # ISSUE 16: the fleet-path tracing overhead block — same stamps
+    # as the main bench
+    _stamp_serving_fleet_observability(out)
     print(json.dumps(out))
 
 
 def main_serving_fleet():
-    """``--serving-fleet``: ONLY the fleet block + its flat gated
-    keys, as one JSON line — the CPU-feasible CI entry (tools/ci.sh
-    pipes it through ``bench_gate --assert-stamped`` so a fleet tier
-    whose crash guard stamped zeros fails the gate, not the bench)."""
+    """``--serving-fleet``: ONLY the fleet block + the fleet-tracing
+    overhead block (ISSUE 16) + their flat gated keys, as one JSON
+    line — the CPU-feasible CI entry (tools/ci.sh pipes it through
+    ``bench_gate --assert-stamped`` so a fleet tier whose crash guard
+    stamped zeros fails the gate, not the bench)."""
     from znicz_tpu.core import telemetry
     telemetry.reset()
     out = {"metric": "serving_fleet"}
     _stamp_serving_fleet(out)
+    _stamp_serving_fleet_observability(out)
     print(json.dumps(out))
 
 

@@ -89,3 +89,123 @@ def test_ring_bounds_and_disabled_gate(traced, monkeypatch):
     monkeypatch.setattr(root.common.serving, "trace_sample_n", 0)
     assert traced.enabled() is False
     assert traced.begin("off") is False
+
+
+# -- fleet tracing: router origin, propagation, the stitch (ISSUE 16) --------
+
+def _router_tree(rt, rid, t0=500.0, wait_s=0.012):
+    assert rt.begin(rid, now=t0, origin="router") is True
+    rt.add_span(rid, "route", t0, t0 + 0.001)
+    rt.add_span(rid, "conn_acquire", t0 + 0.001, t0 + 0.002,
+                reused=True)
+    rt.add_span(rid, "relay_send", t0 + 0.002, t0 + 0.003)
+    rt.add_span(rid, "replica_wait", t0 + 0.003, t0 + 0.003 + wait_s,
+                replica="fleet-2")
+    rt.add_span(rid, "relay_reply", t0 + 0.003 + wait_s,
+                t0 + 0.004 + wait_s)
+    rt.finish(rid, now=t0 + 0.004 + wait_s, model="m")
+
+
+def test_router_origin_vocabulary_and_partition(traced):
+    """A router tree is judged by ITS vocabulary: complete with the
+    five hop phases (no retry needed), and parts_ms sums the router
+    top-level kinds to ≈ the router wall."""
+    _router_tree(traced, "h1")
+    tree = traced.get("h1")
+    assert tree["origin"] == "router"
+    assert tree["complete"] is True
+    assert tree["wall_ms"] == pytest.approx(16.0)
+    assert tree["parts_ms"] == pytest.approx(16.0)
+
+
+def test_retry_kind_keeps_the_partition_exact(traced):
+    """A failed attempt collapses into ONE retry span covering its
+    whole window — the winning attempt's phase spans plus the retry
+    span still partition the wall with no overlap."""
+    t0 = 700.0
+    assert traced.begin("h2", now=t0, origin="router") is True
+    traced.add_span("h2", "route", t0, t0 + 0.001)
+    # the failed attempt: 4 ms, one span, attrs carry peer + reason
+    traced.add_span("h2", "retry", t0 + 0.001, t0 + 0.005,
+                    peer="fleet-1", reason="connect_failed")
+    traced.add_span("h2", "conn_acquire", t0 + 0.005, t0 + 0.006)
+    traced.add_span("h2", "relay_send", t0 + 0.006, t0 + 0.007)
+    traced.add_span("h2", "replica_wait", t0 + 0.007, t0 + 0.015,
+                    replica="fleet-2")
+    traced.add_span("h2", "relay_reply", t0 + 0.015, t0 + 0.016)
+    traced.finish("h2", now=t0 + 0.016)
+    tree = traced.get("h2")
+    assert tree["complete"] is True
+    assert tree["parts_ms"] == pytest.approx(tree["wall_ms"])
+
+
+def test_unknown_kind_still_loud_for_router_trees(traced):
+    traced.begin("h3", origin="router")
+    with pytest.raises(ValueError, match="unknown span kind"):
+        traced.add_span("h3", "hyperspace", 0.0, 1.0)
+
+
+def test_force_begin_bypasses_and_preserves_the_cursor(traced,
+                                                       monkeypatch):
+    """The replica honoring X-Trace-Sampled: 1 must sample exactly
+    that rid WITHOUT consuming its own head-sampling cadence."""
+    monkeypatch.setattr(root.common.serving, "trace_sample_n", 3)
+    assert traced.begin("a") is True       # admission 1 -> sampled
+    assert traced.begin("b") is False      # admission 2
+    assert traced.begin("c", force=True) is True   # no admission
+    assert traced.begin("d") is False      # admission 3
+    assert traced.begin("e") is True       # admission 4 -> sampled
+    monkeypatch.setattr(root.common.serving, "trace_sample_n", 0)
+    # the enabled() gate still rules: force cannot resurrect a
+    # disabled plane
+    assert traced.begin("f", force=True) is False
+
+
+def test_stitch_aligns_partitions_and_exports_two_tracks(traced):
+    """The Dapper stitch on hand-built trees: the replica origin
+    lands at wait.start + slack/2, the router partition survives, and
+    the Chrome export carries one track per process."""
+    from znicz_tpu.core import telemetry
+    _router_tree(traced, "h4")          # wall 16, wait 3..15 (12 ms)
+    _full_tree(traced, "rep", t0=900.0)  # replica wall 10 ms
+    stitched = traced.stitch(traced.get("h4"), traced.get("rep"),
+                             replica="fleet-2")
+    # slack = 12 - 10 = 2 ms -> origin at 3 + 1 = 4 ms
+    assert stitched["clock_offset_ms"] == pytest.approx(4.0)
+    assert stitched["stitched"] is True
+    assert stitched["complete"] is True
+    assert stitched["replica"] == "fleet-2"
+    assert stitched["router_wall_ms"] == pytest.approx(16.0)
+    assert stitched["replica_wall_ms"] == pytest.approx(10.0)
+    # the ROUTER partition survives the stitch (replica kinds must
+    # not inflate parts_ms — their time is inside replica_wait)
+    assert stitched["parts_ms"] == pytest.approx(16.0)
+    by_kind = {}
+    for span in stitched["spans"]:
+        by_kind.setdefault(span["kind"], span)
+    # the synthetic replica span nests inside the wait window...
+    wait = by_kind["replica_wait"]
+    anchor = by_kind["replica"]
+    assert wait["start_ms"] <= anchor["start_ms"]
+    assert anchor["start_ms"] + anchor["duration_ms"] <= \
+        wait["start_ms"] + wait["duration_ms"] + 1e-6
+    # ...and the replica's own spans shifted into the same window
+    assert by_kind["admission"]["process"] == "replica"
+    assert by_kind["admission"]["start_ms"] == pytest.approx(4.0)
+    # one Chrome trace, two process tracks, named metadata events
+    events = stitched["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == \
+        {"router", "replica fleet-2"}
+    assert {e["pid"] for e in events if e["ph"] == "X"} == {0, 1}
+    telemetry.validate_trace({"traceEvents": events})
+
+
+def test_stitch_clamps_a_jitter_inflated_replica_wall(traced):
+    """A replica wall LONGER than the router's wait window (clock
+    jitter) must still start inside the window, never before it."""
+    _router_tree(traced, "h5", wait_s=0.008)   # wait 3..11 (8 ms)
+    _full_tree(traced, "rep2", t0=950.0)       # replica wall 10 ms
+    stitched = traced.stitch(traced.get("h5"), traced.get("rep2"),
+                             replica="fleet-1")
+    assert stitched["clock_offset_ms"] == pytest.approx(3.0)

@@ -166,3 +166,75 @@ def test_sweeps_meter_on_telemetry(ts):
     snap = telemetry.snapshot()
     assert snap["counters"]["timeseries.sweeps"] == 2
     assert snap["gauges"]["timeseries.series"] >= 1
+
+
+# -- the fleet merge (router /debug/timeseries fan-out, ISSUE 16) ------------
+
+def test_step_merge_sums_step_functions():
+    merged = timeseries._step_merge(
+        {"a": [(1.0, 10.0), (3.0, 20.0)], "b": [(2.0, 5.0)]})
+    assert merged == [(1.0, 10.0), (2.0, 15.0), (3.0, 25.0)]
+
+
+def test_step_merge_max_for_quantiles():
+    merged = timeseries._step_merge(
+        {"a": [(1.0, 10.0), (3.0, 2.0)], "b": [(2.0, 5.0)]},
+        use_max=True)
+    assert merged == [(1.0, 10.0), (2.0, 10.0), (3.0, 5.0)]
+
+
+def test_step_merge_late_joiner_is_not_a_reset():
+    """A replica that joined the fleet late contributes nothing
+    before its first point — the merged counter never dips (a dip
+    would read as a counter reset to any rate() consumer)."""
+    merged = timeseries._step_merge(
+        {"a": [(1.0, 100.0), (4.0, 120.0)], "b": [(3.0, 10.0)]})
+    assert merged == [(1.0, 100.0), (3.0, 110.0), (4.0, 130.0)]
+    values = [v for _, v in merged]
+    assert values == sorted(values)
+
+
+def _snap(series, sweeps=1, enabled=True, interval=100.0):
+    return {"enabled": enabled, "sweeps": sweeps,
+            "interval_ms": interval, "series": series, "rates": {}}
+
+
+def test_merge_snapshots_counters_sum_with_attribution():
+    merged = timeseries.merge_snapshots({
+        "r1": _snap({"serving.batches": {
+            "kind": "counter",
+            "points": [[1.0, 10.0], [3.0, 20.0]]}}, sweeps=2),
+        "r2": _snap({"serving.batches": {
+            "kind": "counter", "points": [[2.0, 5.0]]}}),
+        "router": _snap({"router.requests": {
+            "kind": "counter", "points": [[1.0, 1.0], [3.0, 9.0]]}},
+            enabled=False),
+    })
+    assert merged["merged"] is True
+    assert merged["enabled"] is True          # any armed source wins
+    assert merged["sources"] == ["r1", "r2", "router"]
+    assert merged["sweeps"] == 4
+    batches = merged["series"]["serving.batches"]
+    assert batches["points"] == [[1.0, 10.0], [2.0, 15.0],
+                                 [3.0, 25.0]]
+    # per-source LAST values — the attribution block the fleet smoke
+    # checks the merged ring against
+    assert batches["sources"] == {"r1": 20.0, "r2": 5.0}
+    assert batches["points"][-1][1] == \
+        sum(batches["sources"].values())
+    # rate() works at the front door, on the merged ring
+    assert merged["rates"]["serving.batches"] == pytest.approx(7.5)
+    assert merged["rates"]["router.requests"] == pytest.approx(4.0)
+
+
+def test_merge_snapshots_quantiles_take_the_max():
+    merged = timeseries.merge_snapshots({
+        "r1": _snap({"serving.request_seconds.p99": {
+            "kind": "quantile", "points": [[1.0, 0.030]]}}),
+        "r2": _snap({"serving.request_seconds.p99": {
+            "kind": "quantile", "points": [[1.0, 0.050]]}}),
+    })
+    q = merged["series"]["serving.request_seconds.p99"]
+    assert q["points"] == [[1.0, 0.050]]
+    # the conservative tail view carries no rate (not a counter)
+    assert "serving.request_seconds.p99" not in merged["rates"]

@@ -1,0 +1,125 @@
+"""Critical-path analysis (tools/trace_summary.py, ISSUE 16):
+per-kind aggregation, dominant-kind attribution and the stitched-tree
+double-count guards — on synthetic ``/debug/trace`` payloads, no
+server, no sleeps."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import trace_summary  # noqa: E402
+
+
+def _serving_tree(rid="r1", wall=10.0, dispatch=5.0):
+    return {
+        "rid": rid, "model": "m", "origin": "serving",
+        "wall_ms": wall, "parts_ms": wall,
+        "spans": [
+            {"kind": "admission", "start_ms": 0.0,
+             "duration_ms": 1.0},
+            {"kind": "queue_wait", "start_ms": 1.0,
+             "duration_ms": 2.0},
+            {"kind": "assembly", "start_ms": 3.0, "duration_ms": 1.0},
+            {"kind": "dispatch", "start_ms": 4.0,
+             "duration_ms": dispatch},
+            # device nests in dispatch: near-as-long, never dominant
+            {"kind": "device", "start_ms": 4.2,
+             "duration_ms": dispatch - 0.5},
+            {"kind": "reply", "start_ms": 4.0 + dispatch,
+             "duration_ms": wall - 5.0 - dispatch},
+        ],
+    }
+
+
+def _stitched_tree(rid="s1"):
+    return {
+        "rid": rid, "model": "m", "origin": "router",
+        "stitched": True, "wall_ms": 20.0, "parts_ms": 20.0,
+        "spans": [
+            {"kind": "route", "start_ms": 0.0, "duration_ms": 1.0,
+             "process": "router"},
+            {"kind": "conn_acquire", "start_ms": 1.0,
+             "duration_ms": 1.0, "process": "router"},
+            {"kind": "relay_send", "start_ms": 2.0,
+             "duration_ms": 1.0, "process": "router"},
+            {"kind": "replica_wait", "start_ms": 3.0,
+             "duration_ms": 15.0, "process": "router"},
+            {"kind": "replica", "start_ms": 4.0, "duration_ms": 13.0,
+             "process": "router"},
+            {"kind": "admission", "start_ms": 4.0,
+             "duration_ms": 1.0, "process": "replica"},
+            {"kind": "dispatch", "start_ms": 5.0, "duration_ms": 9.0,
+             "process": "replica"},
+            {"kind": "reply", "start_ms": 16.0, "duration_ms": 1.0,
+             "process": "replica"},
+            {"kind": "relay_reply", "start_ms": 18.0,
+             "duration_ms": 2.0, "process": "router"},
+        ],
+    }
+
+
+def test_top_level_kinds_follow_the_origin():
+    assert "dispatch" in trace_summary.top_level_kinds(
+        _serving_tree())
+    assert "route" not in trace_summary.top_level_kinds(
+        _serving_tree())
+    router_only = {"origin": "router"}
+    assert "replica_wait" in trace_summary.top_level_kinds(
+        router_only)
+    assert "dispatch" not in trace_summary.top_level_kinds(
+        router_only)
+    # a stitched tree competes BOTH vocabularies
+    both = trace_summary.top_level_kinds(_stitched_tree())
+    assert {"route", "dispatch"} <= both
+
+
+def test_dominant_kind_skips_nested_kinds():
+    """device rides inside dispatch — dispatch must win even with a
+    device span nearly as long."""
+    kind, ms = trace_summary.dominant_kind(_serving_tree())
+    assert kind == "dispatch"
+    assert ms == pytest.approx(5.0)
+
+
+def test_stitched_dominance_excludes_replica_wait():
+    """In a stitched tree the replica subtree re-tells the
+    replica_wait window in finer kinds — the wait span itself (15 ms)
+    must not out-dominate the replica's dispatch (9 ms)."""
+    kind, ms = trace_summary.dominant_kind(_stitched_tree())
+    assert kind == "dispatch"
+    assert ms == pytest.approx(9.0)
+
+
+def test_summarize_aggregates_and_ranks():
+    trees = [_serving_tree("r1", wall=10.0),
+             _serving_tree("r2", wall=30.0, dispatch=20.0),
+             _stitched_tree("s1")]
+    report = trace_summary.summarize(trees, top=2)
+    assert report["traces"] == 3
+    # nested kinds never reach the per-kind table
+    assert "device" not in report["kinds"]
+    assert "replica" not in report["kinds"]
+    assert report["kinds"]["dispatch"]["count"] == 3
+    assert report["kinds"]["route"]["count"] == 1
+    # slowest first, capped at top, attributed and coverage-checked
+    assert [r["rid"] for r in report["slowest"]] == ["r2", "s1"]
+    assert report["slowest"][0]["dominant_kind"] == "dispatch"
+    assert report["slowest"][0]["parts_over_wall"] == \
+        pytest.approx(1.0)
+    assert report["slowest"][1]["stitched"] is True
+    # the renderer accepts its own report (no KeyErrors / formats)
+    text = trace_summary.render(report)
+    assert "dispatch" in text and "r2" in text
+
+
+def test_summarize_skips_empty_and_unfinished_trees():
+    report = trace_summary.summarize(
+        [None, {}, {"spans": [], "wall_ms": 1.0},
+         _serving_tree("ok")])
+    assert report["traces"] == 1
+    assert report["slowest"][0]["rid"] == "ok"

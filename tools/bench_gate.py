@@ -104,7 +104,17 @@ GATED_INVERSE = ("serving_loadgen_p99_ms",
                  # at 1.0 so an honest ~zero never reads as the
                  # crash-guard zero) — a plane that got expensive
                  # fails the round like a latency regression
-                 "serving_observability_overhead_pct")
+                 "serving_observability_overhead_pct",
+                 # the FLEET path's armed-tracing cost (ISSUE 16):
+                 # 2-replica router+replicas with cross-process
+                 # tracing armed vs disabled, same floored-at-1.0
+                 # honest-zero rule as the single-replica plane, plus
+                 # the router's per-request hop overhead (router wall
+                 # minus the replica-reported X-Serving-Ms, floored
+                 # at 0.01 so a real ~zero never reads as the
+                 # crash-guard zero)
+                 "serving_fleet_observability_overhead_pct",
+                 "serving_router_hop_overhead_ms")
 
 
 def _payload(doc):
@@ -306,12 +316,36 @@ def selftest(threshold=0.10):
         dict(obs_old, serving_observability_overhead_pct=2.0 *
              (1.0 + threshold)),
         obs_old, threshold)
+    # the FLEET observability gates (ISSUE 16), same inverted shape:
+    # armed-tracing overhead on the 2-replica path and the router's
+    # per-hop overhead both fail on a rise or a crash-guard zero
+    fo_old = {"serving_fleet_observability_overhead_pct": 3.0,
+              "serving_router_hop_overhead_ms": 0.8}
+    fo_rise, _ = compare(
+        dict(fo_old, serving_fleet_observability_overhead_pct=3.0 *
+             (1.0 + 2 * threshold) * 2.0),
+        fo_old, threshold)
+    fo_zero, _ = compare(
+        dict(fo_old, serving_fleet_observability_overhead_pct=0.0),
+        fo_old, threshold)
+    hop_rise, _ = compare(
+        dict(fo_old, serving_router_hop_overhead_ms=0.8 *
+             (1.0 + 2 * threshold) * 2.0),
+        fo_old, threshold)
+    hop_zero, _ = compare(
+        dict(fo_old, serving_router_hop_overhead_ms=0.0),
+        fo_old, threshold)
+    fo_wobble, _ = compare(
+        {k: v * (1.0 + threshold) for k, v in fo_old.items()},
+        fo_old, threshold)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
             or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
             or tl_drop or tl_p99_up or tl_gone or not tl_wobble \
             or fl_drop or fl_zero or fl_gone or not fl_wobble \
-            or ob_rise or ob_zero or not ob_wobble:
+            or ob_rise or ob_zero or not ob_wobble \
+            or fo_rise or fo_zero or hop_rise or hop_zero \
+            or not fo_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
@@ -323,14 +357,18 @@ def selftest(threshold=0.10):
               "tail_wobble_passed=%s fleet_drop_rejected=%s "
               "fleet_zero_rejected=%s fleet_vanished_rejected=%s "
               "fleet_wobble_passed=%s obs_rise_rejected=%s "
-              "obs_zero_rejected=%s obs_wobble_passed=%s"
+              "obs_zero_rejected=%s obs_wobble_passed=%s "
+              "fleet_obs_rise_rejected=%s fleet_obs_zero_rejected=%s "
+              "hop_rise_rejected=%s hop_zero_rejected=%s "
+              "fleet_obs_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
                  not dt_gone, dt_wobble, not tl_drop, not tl_p99_up,
                  not tl_gone, tl_wobble, not fl_drop, not fl_zero,
                  not fl_gone, fl_wobble, not ob_rise, not ob_zero,
-                 ob_wobble))
+                 ob_wobble, not fo_rise, not fo_zero, not hop_rise,
+                 not hop_zero, fo_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
@@ -342,6 +380,8 @@ def selftest(threshold=0.10):
           "fleet scaling-efficiency drop, zero stamp and vanished "
           "priority-goodput key rejected, fleet wobble passes; "
           "SLO-plane overhead rise and zero-stamp rejected, "
+          "overhead wobble passes; fleet-tracing overhead and "
+          "router hop-overhead rise/zero-stamp rejected, fleet "
           "overhead wobble passes (threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))
     return 0

@@ -449,10 +449,16 @@ def discover_models(base_url, timeout=10.0):
     return specs
 
 
-def http_submit(base_url, pool, binary=False):
+def http_submit(base_url, pool, binary=False, rid_prefix=None):
     """A ``submit(model, x, timeout_ms) -> Future`` over HTTP: each
     request runs on the pool (open-loop up to the pool width; a full
     pool shows up as scheduled-latency, never as a lost arrival).
+
+    ``rid_prefix`` stamps every request with a deterministic
+    ``X-Request-Id`` (``<prefix>-<seq>``) so a caller can look
+    sampled requests up afterwards at ``GET /debug/trace/<rid>`` —
+    the fleet-tracing smoke drives loadgen traffic and then reads
+    the stitched trees back by these ids.
 
     ``binary=True`` posts raw ``.npy`` bodies instead of JSON (the
     server's ``application/octet-stream`` path) over per-worker
@@ -467,6 +473,7 @@ def http_submit(base_url, pool, binary=False):
     connection retries once on a fresh one.)"""
     import http.client
     import io
+    import itertools
     import urllib.error
     import urllib.parse
     import urllib.request
@@ -474,6 +481,7 @@ def http_submit(base_url, pool, binary=False):
     npy_cache = {}
     parsed = urllib.parse.urlsplit(base_url)
     local = threading.local()
+    rid_seq = itertools.count()  # count() is atomic under the GIL
 
     def _body(model, x, timeout_ms):
         if not binary:
@@ -518,6 +526,9 @@ def http_submit(base_url, pool, binary=False):
         path = "/predict" if model is None else "/predict/" + model
         body, ctype = _body(model, x, timeout_ms)
         headers = {"Content-Type": ctype}
+        if rid_prefix:
+            headers["X-Request-Id"] = "%s-%06d" % (rid_prefix,
+                                                   next(rid_seq))
         if priority is not None:
             headers["X-Priority"] = priority
         wait = (timeout_ms / 1e3 + 65.0) if timeout_ms else 120.0

@@ -1,4 +1,4 @@
-"""CI smoke: the serving tier end to end, in six acts.
+"""CI smoke: the serving tier end to end, in seven acts.
 
 **Act 1 — single engine (the PR 2 contract):** train a tiny wine
 model, snapshot it, bring up the HTTP front end, fire 64 CONCURRENT
@@ -65,6 +65,28 @@ front-end router, under a seeded priority-mixed open-loop burst at
   per-replica sums,
 * one replica is SIGKILLed mid-burst and the fleet keeps answering
   (the corpse is ejected from rotation; the survivor serves).
+
+**Act 7 — fleet-wide distributed tracing (ISSUE 16):** a fresh
+2-replica fleet with the whole observability plane armed END TO END
+(router head-sampling every admission, ``X-Trace-Sampled``
+propagation to the replicas, SLO tracking, the time-series sampler
+on a fast cadence), under a seeded open-loop loadgen run with
+deterministic request ids:
+
+* ``GET /debug/trace/<rid>`` at the ROUTER returns one STITCHED
+  cross-process tree — router span kinds (route, conn_acquire,
+  relay_send, replica_wait, relay_reply) AND the replica's serving
+  kinds (admission..reply) in the same payload, Chrome-trace events
+  with a track per process,
+* the ``/slo`` ``router_overhead_ms`` summary is live and sane: a
+  positive per-request hop overhead strictly under the
+  loadgen-measured client latency,
+* the router's ``GET /debug/timeseries`` is the MERGED fleet view —
+  a replica counter's merged last point equals the sum of the
+  per-source last values it carries,
+* the ``tools/trace_summary.py`` analyzer summarizes the live
+  router's trace ring (per-kind breakdown + dominant-kind
+  attribution over stitched trees).
 
 **Act 4 — the batch-1 latency fast path (ISSUE 12):** the SAME wine
 snapshot served strict (f32) and fast (f32-fast) behind one registry:
@@ -208,6 +230,7 @@ def main():
     latency_smoke(snapshot)
     slo_smoke(snapshot)
     fleet_smoke(tmp)
+    fleet_obs_smoke(tmp)
 
 
 def _second_model_package(tmp):
@@ -791,6 +814,131 @@ def fleet_smoke(tmp):
                  pp["low"]["shed_429"], after["ok"]))
     finally:
         router.stop()
+
+
+def fleet_obs_smoke(tmp):
+    """Act 7: fleet-wide distributed tracing over a live 2-replica
+    fleet (ISSUE 16)."""
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import loadgen
+    import trace_summary
+    from znicz_tpu.core import timeseries
+    from znicz_tpu.serving import reqtrace
+    from znicz_tpu.serving.router import FleetRouter
+    from znicz_tpu.testing import build_fc_package_zip
+
+    telemetry.reset()
+    timeseries.reset()
+    reqtrace.reset()
+    # the FleetRouter runs IN THIS process: the router half of the
+    # plane arms through root.common here, the replica half through
+    # the forwarded --config flags (one knob name, two processes)
+    cfg = root.common.serving
+    saved = (cfg.get("trace_sample_n", 0),
+             cfg.get("slo_enabled", False),
+             root.common.telemetry.timeseries.get("enabled", False))
+    cfg.trace_sample_n = 1
+    cfg.slo_enabled = True
+    root.common.telemetry.timeseries.enabled = True
+    zip_path = build_fc_package_zip(
+        os.path.join(tmp, "obs_model.zip"), [20, 64, 4], seed=43)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    router = FleetRouter(
+        ["m=" + zip_path, "--max-batch", str(MAX_BATCH),
+         "--timeout-ms", "0", "--queue-limit", "96",
+         "--config", "common.serving.trace_sample_n=1",
+         "--config", "common.serving.slo_enabled=True",
+         "--config", "common.telemetry.timeseries.enabled=True",
+         "--config", "common.telemetry.timeseries.interval_ms=100.0"],
+        replicas=2,
+        compile_cache_dir=os.path.join(tmp, "obs_cache"),
+        env=env).start()
+    url = "http://127.0.0.1:%d" % router.port
+
+    def fetch_json(path):
+        with urllib.request.urlopen(url + path, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        models = loadgen.discover_models(url)
+        pool = loadgen.DaemonPool(32)
+        # deterministic rids: every request traceable by name
+        submit = loadgen.http_submit(url, pool, binary=True,
+                                     rid_prefix="smokeobs")
+        report = loadgen.run(
+            loadgen.make_plan(60.0, 2.0, 5, models),
+            models, submit, 2000.0, 2.0, 5)
+        assert report["ok"] > 0, report
+        client_p99 = (report.get("latency_ms") or {}).get("p99")
+        # one stitched cross-process tree, fetched BY RID at the
+        # router: router hop kinds + the replica's serving kinds
+        index = fetch_json("/debug/trace")
+        assert index["enabled"] and index["fleet"], index
+        rids = index["rids"]
+        assert rids, "router sampled no traces under sample_n=1"
+        assert all(r["enabled"] for r in
+                   index["replicas"].values()), index["replicas"]
+        tree = None
+        for rid in rids[:8]:  # rids() lists newest first
+            t = fetch_json("/debug/trace/" + rid)
+            if t.get("stitched"):
+                tree = t
+                break
+        assert tree is not None, \
+            "no stitched tree among the last %d rids" % min(
+                8, len(rids))
+        kinds = set(tree["span_kinds"])
+        assert set(reqtrace.ROUTER_REQUIRED_KINDS) <= kinds, kinds
+        assert {"admission", "dispatch", "reply"} <= kinds, kinds
+        procs = {e.get("pid") for e in tree["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert len(procs) == 2, \
+            "stitched Chrome trace must span two process tracks"
+        # the hop-overhead summary: live, positive, and bounded by
+        # what the CLIENT saw (the hop is inside the request)
+        overhead = fetch_json("/slo")["router_overhead_ms"]
+        assert overhead["count"] > 0, overhead
+        assert 0.0 < overhead["mean_ms"] < (client_p99 or 1e9), \
+            (overhead, client_p99)
+        # the merged fleet timeseries: a replica counter's merged
+        # last point equals the sum of its per-source last values
+        timeseries.sample_once()   # the router's own rings sweep too
+        time.sleep(0.3)            # >= one 100 ms replica sweep
+        ts = fetch_json("/debug/timeseries")
+        assert ts["merged"] and ts["series"], ts.get("sources")
+        assert "router" in ts["sources"] and len(ts["sources"]) == 3
+        batches = ts["series"].get("serving.batches")
+        assert batches and batches["points"], \
+            "replica serving.batches never reached the merged view"
+        parts = [v for v in batches["sources"].values()
+                 if v is not None]
+        merged_last = batches["points"][-1][1]
+        assert merged_last == sum(parts) > 0, (merged_last, parts)
+        # the analyzer over the live ring: stitched trees summarize
+        summary = trace_summary.summarize(
+            trace_summary.fetch_trees(url, limit=8))
+        assert summary["traces"] > 0, summary
+        assert any(row["stitched"] for row in summary["slowest"]), \
+            summary["slowest"]
+        print("fleet obs smoke OK: %d traced requests, stitched "
+              "tree for %s (%d kinds, 2 process tracks, wall %.1f "
+              "ms), hop overhead mean %.2f ms (< client p99 %.1f "
+              "ms, n=%d), merged timeseries %s: serving.batches "
+              "last %.0f == replica sum, trace_summary over %d "
+              "tree(s)"
+              % (report["ok"], tree["rid"], len(kinds),
+                 tree["wall_ms"], overhead["mean_ms"],
+                 client_p99 or -1.0, overhead["count"],
+                 ts["sources"], merged_last, summary["traces"]))
+    finally:
+        router.stop()
+        (cfg.trace_sample_n, cfg.slo_enabled,
+         root.common.telemetry.timeseries.enabled) = saved
+        timeseries.reset()
+        reqtrace.reset()
 
 
 if __name__ == "__main__":

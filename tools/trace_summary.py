@@ -1,0 +1,211 @@
+"""Critical-path analysis over sampled request trace trees.
+
+Usage::
+
+    python tools/trace_summary.py http://127.0.0.1:8899 [--top N]
+    python tools/trace_summary.py tree.json [more.json ...] [--json]
+
+Input is either a LIVE server base URL — the tool walks
+``GET /debug/trace`` for the sampled rids and fetches every tree
+(against a fleet router that means STITCHED cross-process trees,
+serving/router.py PR 16) — or saved ``/debug/trace/<rid>`` JSON
+payloads (a file may hold one tree or a list of trees).
+
+The report answers the two questions an operator asks a trace ring:
+
+* **where does time go, fleet-wide?** — per-span-kind count / p50 /
+  p99 / total milliseconds, top-level kinds only (nested kinds like
+  ``device`` / ``replica`` ride inside their parents and would double
+  count), sorted by total;
+* **which requests hurt, and why?** — the top-N slowest requests by
+  wall time, each attributed to its DOMINANT span kind (the
+  top-level kind with the largest summed duration — the critical
+  path's biggest slice), with the parts-sum coverage ratio so an
+  unexplained gap is visible.
+
+``--json`` prints one machine-readable JSON line instead of the
+tables (CI and notebooks).
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from znicz_tpu.serving import reqtrace  # noqa: E402
+
+#: kinds that nest inside another span — excluded from per-kind
+#: totals and dominance (their time is already inside the parent)
+NESTED_KINDS = frozenset(("device", "replica"))
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = int(q * (len(sorted_vals) - 1))
+    return sorted_vals[idx]
+
+
+def top_level_kinds(tree):
+    """The kinds that partition THIS tree's wall time: the union of
+    the serving and router top-level vocabularies for a stitched
+    tree, the origin's own set otherwise."""
+    if tree.get("stitched"):
+        return (frozenset(reqtrace.ROUTER_TOP_LEVEL_KINDS)
+                | frozenset(reqtrace.TOP_LEVEL_KINDS))
+    if tree.get("origin") == "router":
+        return frozenset(reqtrace.ROUTER_TOP_LEVEL_KINDS)
+    return frozenset(reqtrace.TOP_LEVEL_KINDS)
+
+
+def dominant_kind(tree):
+    """(kind, summed_ms) of the tree's largest top-level slice — the
+    critical path's dominant component.  For a STITCHED tree the
+    replica's own kinds compete with the router's hop kinds, except
+    ``replica_wait`` (the replica subtree re-tells that window in
+    finer kinds, so keeping both would double-attribute it)."""
+    kinds = top_level_kinds(tree)
+    if tree.get("stitched"):
+        kinds = kinds - {"replica_wait"}
+    sums = {}
+    for span in tree.get("spans") or ():
+        if span["kind"] in kinds:
+            sums[span["kind"]] = (sums.get(span["kind"], 0.0)
+                                  + span["duration_ms"])
+    if not sums:
+        return None, 0.0
+    kind = max(sums, key=lambda k: sums[k])
+    return kind, round(sums[kind], 3)
+
+
+def summarize(trees, top=5):
+    """The analysis dict over an iterable of /debug/trace payloads."""
+    per_kind = {}
+    rows = []
+    for tree in trees:
+        if not tree or not tree.get("spans"):
+            continue
+        kinds = top_level_kinds(tree)
+        for span in tree["spans"]:
+            kind = span["kind"]
+            if kind in NESTED_KINDS or kind not in kinds:
+                continue
+            per_kind.setdefault(kind, []).append(span["duration_ms"])
+        wall = tree.get("wall_ms")
+        if wall is None:
+            continue
+        kind, kind_ms = dominant_kind(tree)
+        rows.append({
+            "rid": tree.get("rid"),
+            "model": tree.get("model"),
+            "wall_ms": wall,
+            "dominant_kind": kind,
+            "dominant_ms": kind_ms,
+            "parts_over_wall": (round(tree["parts_ms"] / wall, 3)
+                                if tree.get("parts_ms") is not None
+                                and wall else None),
+            "stitched": bool(tree.get("stitched")),
+        })
+    kinds_out = {}
+    for kind, vals in per_kind.items():
+        vals.sort()
+        kinds_out[kind] = {
+            "count": len(vals),
+            "p50_ms": round(_percentile(vals, 0.50), 3),
+            "p99_ms": round(_percentile(vals, 0.99), 3),
+            "total_ms": round(sum(vals), 3),
+        }
+    rows.sort(key=lambda r: -r["wall_ms"])
+    return {
+        "traces": len(rows),
+        "kinds": kinds_out,
+        "slowest": rows[:int(top)],
+    }
+
+
+def fetch_trees(base_url, limit=None):
+    """Every sampled tree behind ``GET /debug/trace`` on a live
+    server (router or replica)."""
+    base = base_url.rstrip("/")
+    with urllib.request.urlopen(base + "/debug/trace",
+                                timeout=10) as resp:
+        index = json.loads(resp.read())
+    trees = []
+    for rid in (index.get("rids") or [])[:limit]:
+        try:
+            with urllib.request.urlopen(
+                    base + "/debug/trace/" + rid, timeout=10) as resp:
+                trees.append(json.loads(resp.read()))
+        except (OSError, ValueError):
+            continue
+    return trees
+
+
+def load_trees(paths):
+    trees = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        trees.extend(doc if isinstance(doc, list) else [doc])
+    return trees
+
+
+def render(report):
+    lines = ["trace_summary: %d sampled trace(s)" % report["traces"],
+             "",
+             "| span kind | count | p50 ms | p99 ms | total ms |",
+             "|---|---|---|---|---|"]
+    kinds = sorted(report["kinds"].items(),
+                   key=lambda kv: -kv[1]["total_ms"])
+    for kind, st in kinds:
+        lines.append("| %s | %d | %.3f | %.3f | %.3f |"
+                     % (kind, st["count"], st["p50_ms"],
+                        st["p99_ms"], st["total_ms"]))
+    lines += ["", "slowest requests (dominant span kind):", ""]
+    lines += ["| rid | model | wall ms | dominant | its ms | "
+              "parts/wall | stitched |",
+              "|---|---|---|---|---|---|---|"]
+    for row in report["slowest"]:
+        lines.append(
+            "| %s | %s | %.3f | %s | %.3f | %s | %s |"
+            % (row["rid"], row["model"] or "-", row["wall_ms"],
+               row["dominant_kind"] or "-", row["dominant_ms"],
+               row["parts_over_wall"], "yes" if row["stitched"]
+               else "no"))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python tools/trace_summary.py",
+        description="Per-kind latency breakdown + top-N slowest "
+                    "requests over sampled request traces (a live "
+                    "server URL or saved /debug/trace payloads).")
+    parser.add_argument("source", nargs="+",
+                        help="server base URL (http://...) or saved "
+                             "trace JSON file(s)")
+    parser.add_argument("--top", type=int, default=5,
+                        help="slowest requests to list (default 5)")
+    parser.add_argument("--limit", type=int, default=None,
+                        help="max rids fetched from a live server")
+    parser.add_argument("--json", action="store_true",
+                        help="print one JSON line instead of tables")
+    args = parser.parse_args(argv)
+    if args.source[0].startswith("http"):
+        trees = fetch_trees(args.source[0], limit=args.limit)
+    else:
+        trees = load_trees(args.source)
+    report = summarize(trees, top=args.top)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,7 +67,9 @@ SERIES_PREFIXES = frozenset((
     # the multi-replica serving fleet (ISSUE 15): replica-count
     # gauges + autoscaler decision counters (serving/router.py,
     # serving/autoscaler.py) and the front-end router's proxy/retry
-    # counters
+    # counters; ISSUE 16 adds the fleet.hop_seconds.<kind> histogram
+    # family — per-model router hop-phase timings fed from sampled
+    # trace spans (kind is bounded by reqtrace.ROUTER_SPAN_KINDS)
     "fleet",
     "health", "jax", "launcher", "loader",
     "memory", "profiler", "registry",
@@ -87,6 +89,11 @@ LABEL_KEYS = frozenset((
     # the priority lanes (ISSUE 15): bounded by the PRIORITIES
     # vocabulary in serving/continuous.py (high/normal/low)
     "priority",
+    # the fleet tracing plane (ISSUE 16): replica ids ("r0", "r1",
+    # ...) on the router's stitched-trace counters — bounded by fleet
+    # membership (autoscaler churn is cooldown-limited), never by
+    # request data
+    "replica",
     "scenario", "site",
 ))
 

@@ -401,6 +401,9 @@ declare("common", {
                                        # before an ejection
             "route_retries": 2,        # peer retries per request when
                                        # a resend is provably safe
+            "overhead_window": 512,    # proxied 200s retained for the
+                                       # router_overhead_ms summary
+                                       # (/slo + /statusz; PR 16)
             # the autoscaler (serving/autoscaler.py):
             "min_replicas": 1,
             "max_replicas": 4,

@@ -866,7 +866,10 @@ def validate_trace(doc, require_names=(), require_nested=()):
     wrote, already json-loaded) and return its event list.
 
     * every event must carry the ``traceEvents`` schema fields
-      (name/ph/ts, dur for complete events);
+      (name/ph/ts, dur for complete events); ``ph: "M"`` metadata
+      events (process_name tracks in a stitched cross-process trace,
+      reqtrace.stitch) are tolerated and excluded from the span
+      checks;
     * ``require_names`` — span names that must be present;
     * ``require_nested`` — (child, parent) name pairs: every child
       span must lie within some parent span on the timeline (the
@@ -879,6 +882,11 @@ def validate_trace(doc, require_names=(), require_nested=()):
         raise ValueError("missing or empty traceEvents")
     names = set()
     for ev in events:
+        if ev.get("ph") == "M":
+            if "name" not in ev:
+                raise ValueError("malformed metadata event: %r"
+                                 % (ev,))
+            continue
         if ev.get("ph") not in ("X", "i"):
             raise ValueError("unexpected event phase: %r" % (ev,))
         if not isinstance(ev.get("ts"), (int, float)) or "name" not in ev:

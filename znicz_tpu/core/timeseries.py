@@ -20,7 +20,12 @@ opened.  This module keeps that history in process:
   consumes;
 * ``GET /debug/timeseries`` on every ``HandlerBase`` server (status
   dashboard AND serving front end) serves :func:`snapshot`;
-  ``tools/profile_summary.py --timeseries`` renders a saved payload.
+  ``tools/profile_summary.py --timeseries`` renders a saved payload;
+* :func:`merge_snapshots` — the fleet view: the router fans the
+  endpoint out to its replicas and timestamp-merges the rings
+  (step-function SUM for counters/gauges, MAX for quantiles) with
+  per-source attribution, so ``rate()`` works at the front door
+  (serving/router.py, PR 16).
 
 Disabled-by-default discipline (the health.py contract): everything
 gates on ``root.common.telemetry.timeseries.enabled``.  When off,
@@ -234,6 +239,21 @@ def rate(name, window_s=None, now=None):
     return (pts[-1][1] - pts[0][1]) / dt
 
 
+def _trailing_rate(pts, window_s):
+    """Per-second increase over the trailing window of one counter
+    ring (None when underdetermined) — shared by :func:`snapshot` and
+    :func:`merge_snapshots` so the router's merged view rates exactly
+    like a replica's local one."""
+    if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+        return None
+    win = [p for p in pts
+           if window_s is None or p[0] >= pts[-1][0] - window_s]
+    if len(win) < 2 or win[-1][0] <= win[0][0]:
+        return None
+    return round((win[-1][1] - win[0][1])
+                 / (win[-1][0] - win[0][0]), 6)
+
+
 def snapshot(window_s=None):
     """The JSON payload ``GET /debug/timeseries`` serves: every ring's
     points plus per-counter trailing rates (over ``window_s``, whole
@@ -248,14 +268,88 @@ def snapshot(window_s=None):
     for name, kind, pts in sorted(items):
         out["series"][name] = {
             "kind": kind, "points": [[round(t, 3), v] for t, v in pts]}
-        if kind == "counter" and len(pts) >= 2:
-            dt = pts[-1][0] - pts[0][0]
-            if dt > 0:
-                win = [p for p in pts
-                       if window_s is None
-                       or p[0] >= pts[-1][0] - window_s]
-                if len(win) >= 2 and win[-1][0] > win[0][0]:
-                    out["rates"][name] = round(
-                        (win[-1][1] - win[0][1])
-                        / (win[-1][0] - win[0][0]), 6)
+        if kind == "counter":
+            rate_v = _trailing_rate(pts, window_s)
+            if rate_v is not None:
+                out["rates"][name] = rate_v
+    return out
+
+
+def _step_merge(sources, use_max=False):
+    """Timestamp-merge several (t, value) rings into one: at every
+    instant ANY source sampled, the merged value is the sum (max for
+    quantile series) of each source's most recent value at-or-before
+    that instant — the step-function semantics PromQL uses when
+    summing counters across instances.  A source contributes nothing
+    before its first point (a replica that joined the fleet late must
+    not read as a counter reset)."""
+    times = sorted({t for ring in sources.values() for t, _ in ring})
+    idx = dict.fromkeys(sources, 0)
+    last = dict.fromkeys(sources)
+    merged = []
+    for t in times:
+        for label, ring in sources.items():
+            i = idx[label]
+            while i < len(ring) and ring[i][0] <= t:
+                last[label] = ring[i][1]
+                i += 1
+            idx[label] = i
+        vals = [v for v in last.values() if v is not None]
+        if vals:
+            merged.append((t, max(vals) if use_max else sum(vals)))
+    return merged
+
+
+def merge_snapshots(payloads, window_s=None):
+    """Merge several :func:`snapshot` payloads into one fleet view —
+    the router's ``GET /debug/timeseries`` fan-out
+    (serving/router.py).  ``payloads`` maps a source label (replica
+    id, or ``"router"`` for the front end's own rings) to its
+    snapshot dict.
+
+    Counters and gauges merge by :func:`_step_merge` SUM (fleet
+    request rate = the sum of replica rates; fleet queue depth = the
+    sum of replica depths); quantile series merge as the step-wise
+    MAX — the conservative tail view, matching the /slo burn-rate
+    aggregation.  Each merged series carries a ``sources`` block
+    (per-source LAST value) for per-replica attribution, and
+    ``rates`` is recomputed over the merged rings so ``rate()``-style
+    queries work at the front door."""
+    names = {}
+    enabled_any = False
+    sweeps = 0
+    interval = None
+    for label in sorted(payloads):
+        snap = payloads[label] or {}
+        enabled_any = enabled_any or bool(snap.get("enabled"))
+        sweeps += int(snap.get("sweeps") or 0)
+        if interval is None and snap.get("interval_ms") is not None:
+            interval = float(snap["interval_ms"])
+        for name, block in (snap.get("series") or {}).items():
+            entry = names.setdefault(
+                name, {"kind": block.get("kind"), "sources": {}})
+            entry["sources"][label] = [
+                (float(t), float(v))
+                for t, v in (block.get("points") or ())]
+    cap = int(_cfg.get("capacity", 512))
+    out = {"enabled": enabled_any, "merged": True,
+           "sources": sorted(payloads),
+           "sweeps": sweeps,
+           "interval_ms": interval if interval is not None else 0.0,
+           "series": {}, "rates": {}}
+    for name in sorted(names):
+        entry = names[name]
+        pts = _step_merge(entry["sources"],
+                          use_max=entry["kind"] == "quantile")[-cap:]
+        out["series"][name] = {
+            "kind": entry["kind"],
+            "points": [[round(t, 3), v] for t, v in pts],
+            "sources": {
+                label: (ring[-1][1] if ring else None)
+                for label, ring in sorted(entry["sources"].items())},
+        }
+        if entry["kind"] == "counter":
+            rate_v = _trailing_rate(pts, window_s)
+            if rate_v is not None:
+                out["rates"][name] = rate_v
     return out

@@ -28,6 +28,35 @@ both servers (the payload carries the span list AND a
 at ui.perfetto.dev).  Slow-request journal events carry their rid as
 the exemplar to look up here; ``slo.burn`` events do the same.
 
+**Fleet tracing** (PR 16, the Dapper stitch): the fleet router
+(serving/router.py) records its OWN tree per sampled rid, built from
+the router-side kinds —
+
+* ``route`` — HTTP receipt → replica pick (parse, body read, header
+  assembly);
+* ``conn_acquire`` — a parked keep-alive connection checked out, or
+  a fresh TCP connect;
+* ``relay_send`` — request bytes serialized + on the replica socket;
+* ``replica_wait`` — request sent → first reply byte (the replica's
+  serving time lives here);
+* ``relay_reply`` — reply read off the replica socket → bytes on the
+  client socket;
+* ``retry`` — one FAILED attempt, collapsed (attrs carry the peer
+  and the reason) so retried requests keep the partition exact;
+* ``replica`` — the stitched peer tree's alignment anchor, nested in
+  ``replica_wait`` the way ``device`` nests in ``dispatch``.
+
+The router head-samples under the same ``trace_sample_n`` knob and
+propagates its decision to the replica via ``X-Trace-Sampled`` (the
+replica's :func:`begin` honors the header with ``force=True``), so
+both processes trace the SAME rid.  :func:`stitch` merges the two
+trees: the replica's monotonic-clock origin is aligned into the
+router's ``replica_wait`` window (NTP-style midpoint of the
+unexplained slack), and the Chrome export gives each process its own
+track (router pid 0, replica pid 1).  The six
+``ROUTER_TOP_LEVEL_KINDS`` partition ROUTER wall time — the fleet
+functional test pins parts-sum ≈ wall across the hop too.
+
 Gate discipline: every hook guards with :func:`enabled` — ONE config
 predicate — and an unsampled rid costs one dict lookup.  When off,
 nothing allocates (monkeypatch-boom pinned).
@@ -48,6 +77,33 @@ SPAN_KINDS = ("admission", "queue_wait", "assembly", "dispatch",
 #: the non-overlapping kinds whose durations partition the wall time
 TOP_LEVEL_KINDS = ("admission", "queue_wait", "assembly", "dispatch",
                    "reply")
+
+#: the seven router-side kinds (serving/router.py — see the module
+#: docstring); ``replica`` nests in ``replica_wait``
+ROUTER_SPAN_KINDS = ("route", "conn_acquire", "relay_send",
+                     "replica_wait", "relay_reply", "retry",
+                     "replica")
+
+#: the non-overlapping router kinds whose durations partition the
+#: ROUTER's wall time (``retry`` collapses a whole failed attempt,
+#: so it never overlaps the final attempt's phase spans)
+ROUTER_TOP_LEVEL_KINDS = ("route", "conn_acquire", "relay_send",
+                          "replica_wait", "relay_reply", "retry")
+
+#: kinds a COMPLETE router tree must carry — ``retry`` rides only on
+#: retried requests and ``replica`` only on stitched payloads
+ROUTER_REQUIRED_KINDS = ("route", "conn_acquire", "relay_send",
+                         "replica_wait", "relay_reply")
+
+#: the full vocabulary — :func:`add_span` stays LOUD on anything else
+_ALL_KINDS = frozenset(SPAN_KINDS) | frozenset(ROUTER_SPAN_KINDS)
+
+#: per-origin (required-for-complete, partition) kind sets
+_ORIGINS = {
+    "serving": (frozenset(SPAN_KINDS), frozenset(TOP_LEVEL_KINDS)),
+    "router": (frozenset(ROUTER_REQUIRED_KINDS),
+               frozenset(ROUTER_TOP_LEVEL_KINDS)),
+}
 
 _lock = locksmith.lock("serving.reqtrace")
 #: rid -> _Trace, insertion-ordered (the bounded ring)
@@ -73,20 +129,29 @@ def disable():
 
 
 class _Trace(object):
-    __slots__ = ("rid", "model", "t0", "t_end", "spans")
+    __slots__ = ("rid", "model", "t0", "t_end", "spans", "origin")
 
-    def __init__(self, rid, t0):
+    def __init__(self, rid, t0, origin="serving"):
         self.rid = rid
         self.model = None
         self.t0 = t0
         self.t_end = None
         self.spans = []
+        self.origin = origin
 
 
-def begin(rid, now=None):
+def begin(rid, now=None, force=False, origin="serving"):
     """Head-sample one admission: every ``trace_sample_n``-th call
     creates a tree for ``rid``.  Returns True when this rid was
     sampled (the caller then owns closing it via :func:`finish`).
+
+    ``force=True`` skips the sampling cursor entirely — the replica
+    honoring a router's ``X-Trace-Sampled: 1`` header must trace the
+    SAME rid the router picked, and the propagated decision must not
+    advance the replica's own cursor (its direct-traffic sampling
+    cadence stays untouched).  The :func:`enabled` gate still applies.
+    ``origin`` ("serving" | "router") picks the completeness and
+    partition vocabulary :func:`get` judges the tree by.
 
     Request ids come from clients, so reuse is normal (a retry
     resends its ``X-Request-Id``): a FINISHED tree under the same rid
@@ -96,20 +161,21 @@ def begin(rid, now=None):
     if not enabled():
         return False
     n = int(_cfg.get("trace_sample_n", 0) or 0)
-    if n <= 0 or not rid:
+    if (n <= 0 and not force) or not rid:
         return False
     cap = int(_cfg.get("trace_capacity", 256) or 256)
     t0 = float(now if now is not None else time.monotonic())
     global _admissions
     with _lock:
-        _admissions += 1
-        if (_admissions - 1) % n:
-            return False
+        if not force:
+            _admissions += 1
+            if (_admissions - 1) % n:
+                return False
         live = _traces.get(rid)
         if live is not None and live.t_end is None:
             return False
         _traces.pop(rid, None)  # replace a finished tree IN ORDER
-        _traces[rid] = _Trace(rid, t0)
+        _traces[rid] = _Trace(rid, t0, origin=origin)
         while len(_traces) > cap:
             _traces.popitem(last=False)
     return True
@@ -134,9 +200,9 @@ def add_span(rid, kind, t0, t1, **attrs):
     :func:`sampled`).  ``t0``/``t1`` are ``time.monotonic()`` stamps
     — the same clock every component uses, so spans stitch across
     threads."""
-    if kind not in SPAN_KINDS:
+    if kind not in _ALL_KINDS:
         raise ValueError("unknown span kind %r (known: %s)"
-                         % (kind, ", ".join(SPAN_KINDS)))
+                         % (kind, ", ".join(sorted(_ALL_KINDS))))
     with _lock:
         tr = _traces.get(rid)
         if tr is None or tr.t_end is not None:
@@ -175,13 +241,18 @@ def rids():
 def get(rid):
     """The span tree for ``rid`` (None when unsampled/evicted):
     relative-millisecond spans, completeness verdict, and a
-    ``traceEvents`` block in the telemetry Chrome-trace schema."""
+    ``traceEvents`` block in the telemetry Chrome-trace schema.
+    Completeness and the parts-sum partition are judged against the
+    tree's ORIGIN vocabulary (a router tree is complete with its five
+    hop phases; a serving tree with its six)."""
     with _lock:
         tr = _traces.get(rid)
         if tr is None:
             return None
         spans = list(tr.spans)
         t0, t_end, model = tr.t0, tr.t_end, tr.model
+        origin = tr.origin
+    required, top_level = _ORIGINS.get(origin, _ORIGINS["serving"])
     out_spans = []
     events = []
     kinds = set()
@@ -203,15 +274,102 @@ def get(rid):
     wall_ms = (round((t_end - t0) * 1e3, 3)
                if t_end is not None else None)
     parts_ms = round(sum(s["duration_ms"] for s in out_spans
-                         if s["kind"] in TOP_LEVEL_KINDS), 3)
+                         if s["kind"] in top_level), 3)
     return {
         "rid": rid,
         "model": model,
-        "complete": kinds >= set(SPAN_KINDS) and t_end is not None,
+        "origin": origin,
+        "complete": kinds >= required and t_end is not None,
         "span_kinds": sorted(kinds),
         "wall_ms": wall_ms,
         "parts_ms": parts_ms,
         "spans": out_spans,
+        "traceEvents": events,
+    }
+
+
+def stitch(router_tree, replica_tree, replica=None):
+    """Merge a replica's :func:`get` payload into the router's — ONE
+    cross-process tree for the rid (the Dapper stitch).
+
+    Clock-alignment rule: both processes time spans in relative
+    milliseconds from their own ``time.monotonic()`` origin, and the
+    two origins are incomparable.  The router DOES know the window the
+    replica worked inside: its ``replica_wait`` span (request fully
+    sent → first reply byte).  The replica's origin is therefore
+    placed at ``wait.start + max(0, (wait.duration - replica_wall)/2)``
+    — the NTP-style midpoint that splits the unexplained slack (the
+    two one-way network/scheduling delays) evenly around the replica's
+    reported wall time, clamped so a jitter-inflated replica wall
+    still starts inside the window.  A synthetic ``replica`` span
+    marks the aligned window (nested in ``replica_wait`` exactly the
+    way ``device`` nests in ``dispatch``) and carries the alignment
+    facts as attrs.
+
+    The merged payload keeps the ROUTER partition: ``parts_ms`` sums
+    only router top-level kinds, so parts-sum ≈ router wall survives
+    the stitch.  ``traceEvents`` exports ONE Chrome trace with a track
+    per process (router pid 0, replica pid 1, named via ``ph: "M"``
+    process_name metadata)."""
+    waits = [s for s in router_tree.get("spans", ())
+             if s["kind"] == "replica_wait"]
+    wait = waits[-1] if waits else None
+    r_wall = float(replica_tree.get("wall_ms")
+                   or replica_tree.get("parts_ms") or 0.0)
+    if wait is not None:
+        slack = wait["duration_ms"] - r_wall
+        offset = wait["start_ms"] + max(0.0, slack / 2.0)
+    else:
+        offset = 0.0
+    spans = [dict(s, process="router")
+             for s in router_tree.get("spans", ())]
+    spans.append({
+        "kind": "replica",
+        "start_ms": round(offset, 3),
+        "duration_ms": round(r_wall, 3),
+        "process": "router",
+        "attrs": {"replica": replica,
+                  "clock_offset_ms": round(offset, 3),
+                  "replica_wall_ms": r_wall},
+    })
+    for s in replica_tree.get("spans", ()):
+        spans.append(dict(s, start_ms=round(s["start_ms"] + offset, 3),
+                          process="replica"))
+    spans.sort(key=lambda s: s["start_ms"])
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "router"}},
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "replica %s" % (replica or "?")}},
+    ]
+    for s in spans:
+        ev = {"name": s["kind"], "ph": "X", "cat": "znicz.request",
+              "ts": round(s["start_ms"] * 1e3, 3),
+              "dur": round(s["duration_ms"] * 1e3, 3),
+              "pid": 0 if s["process"] == "router" else 1,
+              "tid": 0}
+        if s.get("attrs"):
+            ev["args"] = s["attrs"]
+        events.append(ev)
+    parts_ms = round(sum(s["duration_ms"] for s in spans
+                         if s["process"] == "router"
+                         and s["kind"] in ROUTER_TOP_LEVEL_KINDS), 3)
+    return {
+        "rid": router_tree.get("rid"),
+        "model": router_tree.get("model")
+        or replica_tree.get("model"),
+        "origin": "router",
+        "stitched": True,
+        "replica": replica,
+        "complete": bool(router_tree.get("complete")
+                         and replica_tree.get("complete")),
+        "span_kinds": sorted({s["kind"] for s in spans}),
+        "wall_ms": router_tree.get("wall_ms"),
+        "parts_ms": parts_ms,
+        "router_wall_ms": router_tree.get("wall_ms"),
+        "replica_wall_ms": r_wall,
+        "clock_offset_ms": round(offset, 3),
+        "spans": spans,
         "traceEvents": events,
     }
 

@@ -43,6 +43,27 @@ Veles master/slave launcher heritage, PAPER.md §0):
     replica's payload — the fleet is homogeneous — plus a ``fleet``
     block), and ``GET /statusz`` (router + per-replica stats).
 
+* **fleet tracing** (PR 16 — the Dapper-style cross-process stitch):
+  the router head-samples admissions under the same
+  ``root.common.serving.trace_sample_n`` knob the replicas use,
+  records its own span tree per sampled rid (``route`` /
+  ``conn_acquire`` / ``relay_send`` / ``replica_wait`` /
+  ``relay_reply``, failed attempts collapsed into attr-carrying
+  ``retry`` spans), and propagates the decision via an
+  ``X-Trace-Sampled`` header so the serving replica traces the SAME
+  rid.  ``GET /debug/trace/<rid>`` fetches the replica's tree over
+  the keep-alive pool and answers ONE stitched tree
+  (:func:`znicz_tpu.serving.reqtrace.stitch` — the replica's clock
+  aligned into the ``replica_wait`` window, a Chrome-trace track per
+  process).  ``GET /debug/trace`` and ``GET /debug/timeseries`` fan
+  out to the replicas and merge with per-replica attribution
+  (``core/timeseries.py`` timestamp-merge, so ``rate()`` works at
+  the front door).  Hop cost is first-class:
+  ``fleet.hop_seconds.<kind>`` histograms per model (sampled
+  requests), and ``router_overhead_ms`` — router wall minus the
+  replica-reported ``X-Serving-Ms`` — summarized in ``/slo`` and
+  ``/statusz`` for every proxied 200.
+
 * scale operations for the autoscaler (serving/autoscaler.py):
   :meth:`FleetRouter.scale_up` spawns + waits ready + enters
   rotation; :meth:`FleetRouter.retire` ejects a replica from rotation
@@ -77,6 +98,8 @@ from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.status_server import (BodyTooLargeError,
                                           HandlerBase, HttpServerBase)
 from znicz_tpu.core import telemetry
+from znicz_tpu.core import timeseries
+from znicz_tpu.serving import reqtrace
 from znicz_tpu.analysis import locksmith
 
 _cfg = root.common.serving
@@ -139,14 +162,22 @@ class _RawConn(object):
         self.sock = sock
         self.rfile = sock.makefile("rb")
 
-    def round_trip(self, request_bytes):
+    def round_trip(self, request_bytes, timing=None):
         """Send one request; return ``(status, headers, body,
         close)`` where ``headers`` carries only Content-Type /
-        Retry-After.  Raises ``OSError``/``ValueError`` on any
-        transport or framing failure (the caller maps it to the
-        retry-safety machinery)."""
+        Retry-After / X-Serving-Ms.  Raises ``OSError``/``ValueError``
+        on any transport or framing failure (the caller maps it to
+        the retry-safety machinery).  When ``timing`` is a dict it
+        receives the ``sent`` (request fully on the socket) and
+        ``first_byte`` (status line arrived) monotonic stamps — the
+        boundaries of the router's ``relay_send`` / ``replica_wait``
+        trace spans."""
         self.sock.sendall(request_bytes)
+        if timing is not None:
+            timing["sent"] = time.monotonic()
         line = self.rfile.readline(65537)
+        if timing is not None:
+            timing["first_byte"] = time.monotonic()
         if not line:
             raise OSError("connection closed before a status line")
         parts = line.split(None, 2)
@@ -167,6 +198,9 @@ class _RawConn(object):
                     value.strip().decode("latin-1")
             elif key == b"retry-after":
                 headers["Retry-After"] = \
+                    value.strip().decode("latin-1")
+            elif key == b"x-serving-ms":
+                headers["X-Serving-Ms"] = \
                     value.strip().decode("latin-1")
             elif key == b"connection" and \
                     value.strip().lower() == b"close":
@@ -341,6 +375,10 @@ class FleetRouter(HttpServerBase):
         self._replicas = []
         self._next_id = 0
         self._rr = 0               # least-outstanding tie-break cursor
+        #: router wall minus replica-reported X-Serving-Ms per proxied
+        #: 200 — the hop tax /slo and /statusz summarize
+        self._overhead = collections.deque(
+            maxlen=int(_fleet.get("overhead_window", 512)))
         self._draining = False
         self._monitor = None
         self._monitor_stop = threading.Event()
@@ -594,13 +632,20 @@ class FleetRouter(HttpServerBase):
                     replica.kill()
 
     # -- the proxy ----------------------------------------------------------
-    def _send_to(self, replica, method, path, body, headers):
+    def _send_to(self, replica, method, path, body, headers,
+                 trace=None):
         """One forwarded request over a (reused) keep-alive
         connection.  Raises :class:`_NeverSentError` when the connect
         failed (resend safe) and :class:`_SentUnknownError` when the
         connection broke after bytes went out — including a stale
         parked connection the replica had closed; the admitted-rid
-        oracle then clears (or forbids) the resend either way."""
+        oracle then clears (or forbids) the resend either way.
+
+        When ``trace`` is a dict, the hop's phase spans are BUFFERED
+        into it (``spans``: (kind, t0, t1, attrs) tuples, plus the
+        ``first_byte`` stamp) — the caller commits them only for the
+        attempt that actually answered, so a failed attempt collapses
+        into one ``retry`` span and the partition stays exact."""
         head = ["%s %s HTTP/1.1" % (method, path),
                 "Host: %s:%d" % (replica.host, replica.port),
                 "Content-Length: %d" % len(body or b"")]
@@ -608,10 +653,13 @@ class FleetRouter(HttpServerBase):
             head.append("%s: %s" % (key, value))
         request_bytes = ("\r\n".join(head) + "\r\n\r\n").encode(
             "latin-1") + (body or b"")
+        t_acq = time.monotonic() if trace is not None else 0.0
         conn, reused = replica.get_conn()
+        t_send = time.monotonic() if trace is not None else 0.0
+        timing = {} if trace is not None else None
         try:
             status, resp_headers, data, close = conn.round_trip(
-                request_bytes)
+                request_bytes, timing=timing)
         except socket.timeout as e:
             conn.close()
             raise _SentUnknownError("proxy timeout: " + repr(e),
@@ -624,6 +672,14 @@ class FleetRouter(HttpServerBase):
             conn.close()
         else:
             replica.put_conn(conn)
+        if trace is not None:
+            trace["spans"] = [
+                ("conn_acquire", t_acq, t_send, {"reused": reused}),
+                ("relay_send", t_send, timing["sent"], None),
+                ("replica_wait", timing["sent"], timing["first_byte"],
+                 {"replica": replica.rid}),
+            ]
+            trace["first_byte"] = timing["first_byte"]
         return status, resp_headers, data
 
     def _rid_admitted(self, replica, rid, sent_at):
@@ -676,10 +732,27 @@ class FleetRouter(HttpServerBase):
         return None
 
     def _proxy_predict(self, handler, path):
+        """One routed /predict: head-samples the admission under the
+        shared ``trace_sample_n`` knob (origin="router"), then hands
+        the relay to :meth:`_relay_predict`.  The wrapper owns
+        closing the tree so every early-return error path still
+        stamps its wall time."""
+        t_recv = time.monotonic()
         if telemetry.enabled():
             telemetry.counter("router.requests").inc()
         rid = (handler.headers.get("X-Request-Id") or "").strip()
         rid = rid[:64] if rid else uuid.uuid4().hex[:12]
+        traced = reqtrace.enabled() and reqtrace.begin(
+            rid, now=t_recv, origin="router")
+        if not traced:
+            self._relay_predict(handler, path, rid, t_recv, False)
+            return
+        try:
+            self._relay_predict(handler, path, rid, t_recv, True)
+        finally:
+            reqtrace.finish(rid)
+
+    def _relay_predict(self, handler, path, rid, t_recv, traced):
         echo = {"X-Request-Id": rid}
         if self._draining:
             handler._drain_body()
@@ -702,6 +775,19 @@ class FleetRouter(HttpServerBase):
             value = handler.headers.get(name)
             if value:
                 fwd_headers[name] = value
+        if reqtrace.enabled():
+            # propagate the sampling decision: the replica traces the
+            # SAME rid the router picked — and ONLY that rid, keeping
+            # the two rings aligned (serving/server.py honors it)
+            fwd_headers["X-Trace-Sampled"] = "1" if traced else "0"
+        model = None
+        if path.startswith("/predict/"):
+            model = path[len("/predict/"):] or None
+        hops = []   # committed (kind, t0, t1) spans — the histograms
+        if traced:
+            t_route = time.monotonic()
+            reqtrace.add_span(rid, "route", t_recv, t_route)
+            hops.append(("route", t_recv, t_route))
         retries = int(_fleet.get("route_retries", 2))
         tried = set()
         for attempt in range(retries + 1):
@@ -714,13 +800,19 @@ class FleetRouter(HttpServerBase):
                 return
             tried.add(replica.rid)
             sent_at = time.time()
+            attempt_t0 = time.monotonic() if traced else 0.0
+            hop = {} if traced else None
             try:
                 status, resp_headers, data = self._send_to(
-                    replica, "POST", path, body, fwd_headers)
+                    replica, "POST", path, body, fwd_headers,
+                    trace=hop)
             except _NeverSentError:
                 # nothing went out: resend is safe by construction
                 self._release(replica)
                 self._note_retry(replica, rid, "connect_failed")
+                self._note_failed_attempt(rid, traced, hops,
+                                          attempt_t0, replica,
+                                          "connect_failed")
                 continue
             except _SentUnknownError as e:
                 self._release(replica)
@@ -738,12 +830,18 @@ class FleetRouter(HttpServerBase):
                     # the replica is alive and its batcher never saw
                     # this rid — the socket broke pre-admission
                     self._note_retry(replica, rid, "not_admitted")
+                    self._note_failed_attempt(rid, traced, hops,
+                                              attempt_t0, replica,
+                                              "not_admitted")
                     continue
                 # admitted (may have dispatched) or unknowable (the
                 # replica died with the answer): an honest 503, never
                 # a duplicate dispatch
                 if telemetry.enabled():
                     telemetry.counter("router.unsafe_503s").inc()
+                self._note_failed_attempt(rid, traced, hops,
+                                          attempt_t0, replica,
+                                          "unsafe_503")
                 handler._send_json(
                     503, {"error": "replica connection lost "
                                    "mid-request; retry unsafe "
@@ -767,6 +865,9 @@ class FleetRouter(HttpServerBase):
                     self._eject(replica, DRAINING, "draining")
                 self._note_retry(replica, rid,
                                  "refused_" + refusal)
+                self._note_failed_attempt(rid, traced, hops,
+                                          attempt_t0, replica,
+                                          "refused_" + refusal)
                 continue
             ctype = resp_headers.get("Content-Type") or \
                 "application/json"
@@ -777,12 +878,61 @@ class FleetRouter(HttpServerBase):
             if telemetry.enabled():
                 telemetry.counter("router.proxied").inc()
             _relay_reply(handler, status, ctype, data, out_headers)
+            t_done = time.monotonic()
+            if traced:
+                # commit the winning attempt's buffered phase spans,
+                # then close the relay: first reply byte -> reply on
+                # the client socket
+                for kind, s0, s1, attrs in hop.get("spans", ()):
+                    reqtrace.add_span(rid, kind, s0, s1,
+                                      **(attrs or {}))
+                    hops.append((kind, s0, s1))
+                first = hop.get("first_byte", t_done)
+                reqtrace.add_span(rid, "relay_reply", first, t_done)
+                hops.append(("relay_reply", first, t_done))
+                reqtrace.set_model(rid, model)
+                self._note_hops(model, hops)
+            serving_ms = resp_headers.get("X-Serving-Ms")
+            if status == 200 and serving_ms:
+                try:
+                    overhead = ((t_done - t_recv) * 1e3
+                                - float(serving_ms))
+                except ValueError:
+                    overhead = None
+                if overhead is not None:
+                    with self._lock:
+                        self._overhead.append(overhead)
             return
         handler._send_json(
             503, {"error": "no replica accepted the request after "
                            "%d attempts" % (retries + 1),
                   "request_id": rid},
             headers=dict(echo, **{"Retry-After": "1"}))
+
+    def _note_failed_attempt(self, rid, traced, hops, t0, replica,
+                             reason):
+        """Collapse one failed attempt into a single ``retry`` span
+        (attrs carry the peer + reason) — its inner phases are
+        DISCARDED so retried requests keep the wall-time partition
+        exact (retry never overlaps the winning attempt's spans)."""
+        if not traced:
+            return
+        t1 = time.monotonic()
+        reqtrace.add_span(rid, "retry", t0, t1, peer=replica.rid,
+                          reason=reason)
+        hops.append(("retry", t0, t1))
+
+    def _note_hops(self, model, hops):
+        """``fleet.hop_seconds.<kind>`` histograms per model — the
+        hop tax as an aggregate, fed from the sampled requests' span
+        timings (no extra clock reads)."""
+        if not telemetry.enabled():
+            return
+        model = model or "default"
+        for kind, s0, s1 in hops:
+            telemetry.histogram(telemetry.labeled(
+                "fleet.hop_seconds.%s" % kind,
+                model=model)).observe(s1 - s0)
 
     def _note_retry(self, replica, rid, why):
         if telemetry.enabled():
@@ -903,6 +1053,7 @@ class FleetRouter(HttpServerBase):
                     "burn_threshold"):
             if meta is not None and key in meta:
                 out[key] = meta[key]
+        out["router_overhead_ms"] = self.router_overhead()
         return out
 
     def queued_rows_total(self):
@@ -912,6 +1063,102 @@ class FleetRouter(HttpServerBase):
         for doc in self._up_payloads("/statusz").values():
             total += int(doc.get("queued_rows") or 0)
         return total
+
+    def router_overhead(self):
+        """The ``router_overhead_ms`` block of ``/slo`` and
+        ``/statusz``: router wall minus the replica-reported
+        ``X-Serving-Ms``, summarized over the trailing
+        ``fleet.overhead_window`` proxied 200s — connection
+        management, relay framing, reply serialization and both
+        socket hops, i.e. exactly the Python tax ROADMAP item 3
+        wants torn out of the data plane."""
+        with self._lock:
+            vals = sorted(self._overhead)
+        n = len(vals)
+        if not n:
+            return {"count": 0, "mean_ms": None, "p50_ms": None,
+                    "p99_ms": None, "max_ms": None}
+        return {
+            "count": n,
+            "mean_ms": round(sum(vals) / n, 3),
+            "p50_ms": round(vals[int(0.50 * (n - 1))], 3),
+            "p99_ms": round(vals[int(0.99 * (n - 1))], 3),
+            "max_ms": round(vals[-1], 3),
+        }
+
+    # -- fleet debug surfaces (trace stitch + merged timeseries) ------------
+    def trace_index(self):
+        """``GET /debug/trace`` at the router: the router's own
+        sampled rids plus a per-replica fan-out — each replica
+        attributed by id (PR 16 satellite: the index used to
+        dead-end at the router process)."""
+        payloads = self._up_payloads("/debug/trace")
+        return {
+            "enabled": reqtrace.enabled(),
+            "fleet": True,
+            "rids": reqtrace.rids(),
+            "replicas": {
+                rid: {"enabled": bool(doc.get("enabled")),
+                      "rids": doc.get("rids") or []}
+                for rid, doc in sorted(payloads.items())},
+        }
+
+    def stitched_trace(self, rid):
+        """``GET /debug/trace/<rid>`` at the router: ``(status,
+        payload)`` — the router's own tree with the serving replica's
+        tree fetched over the keep-alive pool and stitched inside the
+        ``replica_wait`` span (reqtrace.stitch).  An unsampled rid
+        404s exactly like a replica's endpoint; a fetch failure
+        degrades to the router-only tree (``stitched: false``) — a
+        dead replica must not take the router's half of the story
+        with it."""
+        tree = reqtrace.get(rid)
+        if tree is None:
+            return 404, {
+                "error": "no sampled trace for rid %r at the router "
+                         "(sampling %s; see root.common.serving."
+                         "trace_sample_n)"
+                         % (rid, "on" if reqtrace.enabled()
+                            else "off")}
+        peer = None
+        for span in reversed(tree.get("spans") or []):
+            if span["kind"] == "replica_wait":
+                peer = (span.get("attrs") or {}).get("replica")
+                break
+        replica = None
+        if peer is not None:
+            with self._lock:
+                for r in self._replicas:
+                    if r.rid == peer:
+                        replica = r
+                        break
+        if replica is None or replica.state != UP or \
+                replica.url is None:
+            tree["stitched"] = False
+            return 200, tree
+        try:
+            status, _, data = self._send_to(
+                replica, "GET", "/debug/trace/" + rid, b"", {})
+            peer_tree = json.loads(data) if status == 200 else None
+        except (_NeverSentError, _SentUnknownError, ValueError):
+            peer_tree = None
+        if not peer_tree:
+            tree["stitched"] = False
+            return 200, tree
+        if telemetry.enabled():
+            telemetry.counter(telemetry.labeled(
+                "router.traces_stitched", replica=peer)).inc()
+        return 200, reqtrace.stitch(tree, peer_tree, replica=peer)
+
+    def merged_timeseries(self):
+        """``GET /debug/timeseries`` at the router: every replica's
+        rings fanned out and TIMESTAMP-MERGED with the router's own
+        (core/timeseries.py merge_snapshots) — counters/gauges sum
+        step-wise, so ``rate()`` works at the front door, and each
+        series carries its per-source last values for attribution."""
+        payloads = self._up_payloads("/debug/timeseries")
+        payloads["router"] = timeseries.snapshot()
+        return timeseries.merge_snapshots(payloads)
 
     def healthz(self):
         with self._lock:
@@ -941,6 +1188,7 @@ class FleetRouter(HttpServerBase):
                 "replica_argv": self._replica_argv,
             },
             "queued_rows_total": self.queued_rows_total(),
+            "router_overhead_ms": self.router_overhead(),
         }
         if self.autoscaler is not None:
             payload["autoscaler"] = self.autoscaler.status()
@@ -978,6 +1226,16 @@ class FleetRouter(HttpServerBase):
                     self._send_json(200, router.models())
                 elif path in ("/", "/statusz"):
                     self._send_json(200, router.statusz())
+                elif path == "/debug/timeseries":
+                    # fleet fan-out + merge — NOT the router-local
+                    # rings _handle_debug would serve
+                    self._send_json(200, router.merged_timeseries())
+                elif path == "/debug/trace":
+                    self._send_json(200, router.trace_index())
+                elif path.startswith("/debug/trace/"):
+                    code, payload = router.stitched_trace(
+                        path[len("/debug/trace/"):])
+                    self._send_json(code, payload)
                 elif self._handle_debug():
                     pass
                 else:

@@ -296,8 +296,23 @@ class ServingServer(HttpServerBase):
         client faults do not)."""
         rid = self._request_id(handler)
         t_admit = time.monotonic()
-        traced = reqtrace.enabled() and reqtrace.begin(rid,
-                                                       now=t_admit)
+        sampled_hdr = (handler.headers.get("X-Trace-Sampled")
+                       or "").strip()
+        if sampled_hdr == "1":
+            # a fleet router upstream sampled this rid — trace it
+            # regardless of our own cursor (force=True leaves the
+            # cursor untouched, so direct-traffic sampling cadence
+            # is unaffected); both processes then hold the same rid
+            # and GET /debug/trace/<rid> on the router can stitch
+            traced = reqtrace.enabled() and reqtrace.begin(
+                rid, now=t_admit, force=True)
+        elif sampled_hdr == "0":
+            # the router decided NOT to sample — honoring it keeps
+            # the two rings aligned rid-for-rid
+            traced = False
+        else:
+            traced = reqtrace.enabled() and reqtrace.begin(
+                rid, now=t_admit)
         code, slo_model = self._predict_inner(handler, rid, model,
                                               t_admit, traced)
         if traced:
@@ -422,11 +437,18 @@ class ServingServer(HttpServerBase):
                                      "request_id": rid}, headers=echo)
             return 500, slo_model
         t_reply = time.monotonic()
+        # replica-reported serving time: admission -> reply start, in
+        # the X-Serving-Ms header.  A fleet router subtracts it from
+        # its own wall clock per proxied 200 — the router_overhead_ms
+        # surface in the fleet /slo and /statusz (what remains is the
+        # hop: relay framing, sockets, and this reply's serialization)
+        ok_headers = dict(echo, **{
+            "X-Serving-Ms": "%.3f" % ((t_reply - t_admit) * 1e3)})
         if raw:
             buf = io.BytesIO()
             numpy.save(buf, numpy.ascontiguousarray(y))
             handler._send(200, "application/octet-stream",
-                          buf.getvalue(), headers=echo)
+                          buf.getvalue(), headers=ok_headers)
         else:
             payload = {"outputs": y.tolist(),
                        "model_version": engine.version,
@@ -435,7 +457,7 @@ class ServingServer(HttpServerBase):
                 payload["model"] = model
             if y.ndim == 2:
                 payload["argmax"] = [int(i) for i in y.argmax(axis=1)]
-            handler._send_json(200, payload, headers=echo)
+            handler._send_json(200, payload, headers=ok_headers)
         if traced:
             # reply span: future resolved -> response bytes written
             reqtrace.add_span(rid, "reply", t_reply, time.monotonic())
