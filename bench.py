@@ -537,6 +537,10 @@ def main(profile_dir=None):
     # tracing vs disabled on the real router, plus the router's
     # per-request hop overhead — both gated inverted
     _stamp_serving_fleet_observability(out)
+    # shadow-mirroring tax (ISSUE 17): a release held in shadow at
+    # 100% sampling vs the same armed fleet without one — gated
+    # inverted so progressive delivery stays affordable
+    _stamp_serving_release_shadow(out)
     prec = out.get("serving_precision", {}).get("dtypes")
     if prec and isinstance(out.get("roofline"), dict):
         # the roofline block grows the per-dtype serving axis: where
@@ -1292,6 +1296,175 @@ def _stamp_serving_fleet_observability(out):
         block.get("router_hop_overhead_ms") or 0.0)
 
 
+def _serving_release_shadow_block(seed=13, max_batch=32,
+                                  measure_s=3.0):
+    """The shadow-mirroring tax measurement (ISSUE 17): the same
+    seeded open-loop mix against two sequential ``serve --fleet 1``
+    fleets sharing ONE persistent compile cache — both with the SLO
+    plane armed (a release requires it), the second additionally
+    HOLDING a release in shadow at 100% sampling (policy
+    ``{"hold": true}``), so every admitted request is mirrored to a
+    bit-identical candidate and compared under f32 bit identity.
+    The throughput delta is what shadow mirroring costs the live
+    path; the candidate shares the compile cache, so no compile
+    asymmetry pollutes the delta.
+
+    Proves the shadow lap really mirrored (``shadow.compares`` > 0
+    with zero mismatches — same params — before the release is
+    aborted) and stamps under the ISSUE 14 honest-zero rule:
+    ``overhead_pct`` floored at 1.0, the unfloored value riding
+    along as ``*_raw``."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading
+    import urllib.request
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import loadgen
+    from znicz_tpu.core.config import root
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench_release_")
+    slo_ms = float(root.common.serving.get("slo_ms", 100.0))
+    try:
+        zip_path = _fleet_model_zip(tmp)
+        cache_dir = os.path.join(tmp, "xla_cache")
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+
+        def lap(shadow):
+            proc = subprocess.Popen(
+                [_sys.executable, "-u", "-m", "znicz_tpu", "serve",
+                 "fleet_model=" + zip_path, "--fleet", "1",
+                 "--port", "0", "--max-batch", str(max_batch),
+                 "--queue-limit", "4096", "--timeout-ms", "0",
+                 "--compile-cache", cache_dir,
+                 "--config", "common.serving.slo_enabled=True"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo)
+            try:
+                url = None
+                deadline = time.monotonic() + 300.0
+                while time.monotonic() < deadline:
+                    line = proc.stdout.readline()
+                    if not line:
+                        break
+                    m = _FLEET_URL_RE.search(line)
+                    if m:
+                        url = m.group(1)
+                        break
+                if url is None:
+                    raise RuntimeError(
+                        "serve --fleet never printed its URL")
+                threading.Thread(target=proc.stdout.read,
+                                 daemon=True).start()
+
+                def call(path, doc=None, method=None):
+                    req = urllib.request.Request(
+                        url + path,
+                        json.dumps(doc).encode()
+                        if doc is not None else None,
+                        {"Content-Type": "application/json"},
+                        method=method)
+                    with urllib.request.urlopen(
+                            req, timeout=60) as resp:
+                        return json.loads(resp.read())
+
+                models = loadgen.discover_models(url)
+                pool = loadgen.DaemonPool(128)
+                submit = loadgen.http_submit(url, pool, binary=True)
+                probe = loadgen.run(
+                    loadgen.make_plan(2500.0, 1.0, seed, models),
+                    models, submit, slo_ms, 1.0, seed)
+                capacity = max(probe.get("wall_rps") or 0.0, 20.0)
+                extras = {}
+                if shadow:
+                    # a held release: the bit-identical candidate
+                    # (same package) shadows 100% of admissions and
+                    # never leaves the shadow stage.  The error /
+                    # mismatch ceilings are lifted out of the way:
+                    # under the 3x overload mix mirrored predictions
+                    # legitimately 429, and a release that FAILS
+                    # mid-window stops paying the tax being measured
+                    # (the block asserts zero mismatches itself)
+                    call("/release/fleet_model",
+                         {"path": zip_path,
+                          "policy": {"hold": True,
+                                     "shadow_sample_pct": 100.0,
+                                     "shadow_error_max": 10 ** 9,
+                                     "shadow_mismatch_max": 10 ** 9}})
+                measured = loadgen.run(
+                    loadgen.make_plan(capacity * 3.0, measure_s,
+                                      seed + 1, models),
+                    models, submit, slo_ms, measure_s, seed + 1)
+                if shadow:
+                    st = call("/release/fleet_model")
+                    extras["shadow"] = st.get("shadow") or {}
+                    extras["state"] = st.get("state")
+                    if st.get("state") == "shadow":
+                        call("/release/fleet_model",
+                             method="DELETE")
+                return (measured.get("wall_rps") or 0.0), extras
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+        rps_off, _ = lap(shadow=False)
+        rps_on, extras = lap(shadow=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    sh = extras.get("shadow", {})
+    if extras.get("state") != "shadow":
+        raise RuntimeError(
+            "release left the shadow stage mid-window (state=%r): "
+            "part of the measured lap paid no mirroring tax"
+            % extras.get("state"))
+    if not sh.get("compares"):
+        raise RuntimeError(
+            "shadow lap never compared a mirrored request "
+            "(state=%r): the overhead number would be fiction"
+            % extras.get("state"))
+    raw = (1.0 - rps_on / max(rps_off, 1e-9)) * 100.0
+    return {
+        "measure_s": measure_s,
+        "live_requests_per_sec": round(rps_off, 1),
+        "shadowed_requests_per_sec": round(rps_on, 1),
+        "overhead_pct_raw": round(raw, 2),
+        "overhead_pct": round(max(raw, 1.0), 2),
+        # proof the lap mirrored (and how much backpressure dropped):
+        # a release that silently failed to shadow would stamp a
+        # flattering zero.  mismatches ride along as DATA, not a
+        # failure: under co-batching the same row can land in
+        # different buckets live vs mirrored, and XLA picks a
+        # different f32 GEMM tiling per bucket — reassociation, not
+        # a broken candidate (the release plane's bit-identity gate
+        # is for like-for-like deployments, which quiet traffic is
+        # and a 3x-overload mirror is not)
+        "shadow_compares": sh.get("compares", 0),
+        "shadow_mismatches": sh.get("mismatches", 0),
+        "shadow_dropped": sh.get("dropped", 0),
+        "shadow_state": extras.get("state"),
+    }
+
+
+def _stamp_serving_release_shadow(out):
+    """Stamp the shadow-mirroring overhead block + the flat gated key
+    (crash-guarded ZERO stamp gated INVERTED by tools/bench_gate.py)
+    — shared by main(), main_serving() and the ``--serving-fleet``
+    CI entry."""
+    try:
+        out["serving_release_shadow"] = (
+            _serving_release_shadow_block())
+    except Exception as e:  # noqa: BLE001 - never kill the primary
+        out["serving_release_shadow"] = {"error": repr(e)}
+    out["serving_release_shadow_overhead_pct"] = (
+        out["serving_release_shadow"].get("overhead_pct") or 0.0)
+
+
 #: the serving precision axis the bench sweeps (ISSUE 10; ISSUE 12
 #: adds the f32-fast batch-1 latency mode to the same roofline)
 PRECISION_DTYPES = ("f32", "f32_fast", "bf16", "int8")
@@ -1846,20 +2019,25 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
     # ISSUE 16: the fleet-path tracing overhead block — same stamps
     # as the main bench
     _stamp_serving_fleet_observability(out)
+    # ISSUE 17: the shadow-mirroring tax block — same stamps as the
+    # main bench
+    _stamp_serving_release_shadow(out)
     print(json.dumps(out))
 
 
 def main_serving_fleet():
     """``--serving-fleet``: ONLY the fleet block + the fleet-tracing
-    overhead block (ISSUE 16) + their flat gated keys, as one JSON
-    line — the CPU-feasible CI entry (tools/ci.sh pipes it through
-    ``bench_gate --assert-stamped`` so a fleet tier whose crash guard
-    stamped zeros fails the gate, not the bench)."""
+    overhead block (ISSUE 16) + the shadow-mirroring tax block
+    (ISSUE 17) + their flat gated keys, as one JSON line — the
+    CPU-feasible CI entry (tools/ci.sh pipes it through ``bench_gate
+    --assert-stamped`` so a fleet tier whose crash guard stamped
+    zeros fails the gate, not the bench)."""
     from znicz_tpu.core import telemetry
     telemetry.reset()
     out = {"metric": "serving_fleet"}
     _stamp_serving_fleet(out)
     _stamp_serving_fleet_observability(out)
+    _stamp_serving_release_shadow(out)
     print(json.dumps(out))
 
 

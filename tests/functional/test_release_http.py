@@ -1,0 +1,332 @@
+"""The release plane over REAL HTTP (ISSUE 17): the zero-touch
+``POST /release/<model>`` loop on a registry server (shadow ->
+canary -> promote, 409s on racing mutations, candidate-vanished
+fallback), then the same loop across a REAL 2-replica fleet — with
+an operator abort landing DURING a canary traffic storm, every
+request answered and the per-replica admitted-rid oracles proving
+no duplicate dispatch."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import telemetry
+from znicz_tpu.serving import ModelRegistry, ServingServer
+from znicz_tpu.serving.release import (
+    ABORTED, CANARY, FAILED, PROMOTED, SHADOW, split_point)
+from znicz_tpu.serving.router import FleetRouter
+from znicz_tpu.testing import build_fc_package_zip
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENV = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+N_IN, N_OUT = 6, 3
+
+#: an instant ladder for the in-process server tests: green windows
+#: collapse to zero so a manual tick() advances deterministically
+FAST = {"green_window_s": 0.0, "min_requests": 1,
+        "shadow_min_compares": 2, "canary_steps": [100.0]}
+
+
+def _zip(directory, name, seed):
+    return build_fc_package_zip(os.path.join(str(directory), name),
+                                [N_IN, 8, N_OUT], seed=seed)
+
+
+def _request(url, doc=None, method=None):
+    data = json.dumps(doc).encode() if doc is not None else None
+    req = urllib.request.Request(
+        url, data, {"Content-Type": "application/json"},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _predict(url, x, rid=None, model="m", timeout=60):
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        url + "/predict/" + model,
+        json.dumps({"inputs": numpy.asarray(x).tolist()}).encode(),
+        headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(
+            resp.headers)
+
+
+def _x(seed, rows=2):
+    return numpy.random.RandomState(seed).uniform(
+        -1.0, 1.0, (rows, N_IN))
+
+
+# -- in-process registry server ----------------------------------------------
+
+@pytest.fixture
+def served(tmp_path):
+    saved = root.common.serving.slo_enabled
+    root.common.serving.slo_enabled = True
+    telemetry.enable()
+    telemetry.reset()
+    registry = ModelRegistry(max_batch=8)
+    registry.add("m", _zip(tmp_path, "live.zip", seed=42))
+    server = ServingServer(registry=registry).start()
+    try:
+        yield (server, registry,
+               "http://%s:%d" % (server.host, server.port), tmp_path)
+    finally:
+        server.stop()
+        root.common.serving.slo_enabled = saved
+
+
+def test_zero_touch_release_over_http(served):
+    server, registry, url, tmp = served
+    ctl = server.release
+    cand_zip = _zip(tmp, "cand.zip", seed=42)
+    code, doc, _ = _request(url + "/release/m",
+                            {"path": cand_zip, "policy": FAST})
+    assert code == 200 and doc["state"] == SHADOW
+    cand = doc["candidate"]
+    # live traffic carries the LIVE generation header while the
+    # candidate only sees mirrored copies
+    gens = set()
+    for i in range(4):
+        code, _, headers = _predict(url, _x(i), rid="shadow-%d" % i)
+        assert code == 200
+        gens.add(headers["X-Serving-Generation"])
+    assert gens == {"gen_1"}
+    assert ctl.drain_shadow()
+    ctl.tick()                      # shadow green -> canary@100%
+    assert ctl.status("m")["state"] == CANARY
+    code, _, headers = _predict(url, _x(9), rid="canary-1")
+    assert code == 200
+    assert headers["X-Serving-Generation"] == \
+        "gen_%d" % doc["generation"]
+    ctl.tick()                      # canary green -> promoted
+    code, doc, _ = _request(url + "/release/m")
+    assert (code, doc["state"]) == (200, PROMOTED)
+    assert registry.peek("m").version == 2
+    assert cand not in registry
+    # the whole surface: nothing active, the terminal record kept
+    code, doc, _ = _request(url + "/release")
+    assert doc["active"] == {} and doc["recent"]["m"]["state"] == \
+        PROMOTED
+
+
+def test_mutations_409_while_release_is_active(served):
+    server, registry, url, tmp = served
+    cand_zip = _zip(tmp, "cand.zip", seed=42)
+    other = _zip(tmp, "other.zip", seed=5)
+    assert _request(url + "/release/m", {"path": cand_zip})[0] == 200
+    # /reload, admin add + remove on the released pair: all 409
+    code, doc, _ = _request(url + "/reload", {"path": cand_zip,
+                                              "model": "m"})
+    assert code == 409 and "release" in doc["error"]
+    assert _request(url + "/models/m.gen2", {"path": other})[0] == 409
+    assert _request(url + "/models/m.gen2", method="DELETE")[0] == 409
+    # a second release of the same model conflicts too
+    assert _request(url + "/release/m", {"path": other})[0] == 409
+    # an unrelated model hot-adds freely
+    assert _request(url + "/models/x", {"path": other})[0] == 200
+    # operator abort clears the guard
+    code, doc, _ = _request(url + "/release/m", method="DELETE")
+    assert (code, doc["state"]) == (200, ABORTED)
+    assert _request(url + "/reload", {"path": cand_zip,
+                                      "model": "m"})[0] == 200
+
+
+def test_candidate_vanishing_mid_canary_never_drops_a_client(served):
+    """The rollback-during-ramp race, pinned in-process: a request
+    split to a candidate that was JUST removed falls back to the live
+    generation — answered 200, live generation header, and the next
+    tick retires the release as failed."""
+    server, registry, url, tmp = served
+    ctl = server.release
+    code, doc, _ = _request(
+        url + "/release/m",
+        {"path": _zip(tmp, "cand.zip", seed=42),
+         "policy": dict(FAST, hold=True)})
+    assert code == 200
+    cand = doc["candidate"]
+    for i in range(3):
+        assert _predict(url, _x(i), rid="w-%d" % i)[0] == 200
+    assert ctl.drain_shadow()
+    ctl.tick()
+    # hold=True froze it in shadow; flip the policy to enter canary
+    rel = ctl._active["m"]
+    rel.policy["hold"] = False
+    ctl.tick()
+    assert rel.state == CANARY and rel.canary_pct == 100.0
+    # yank the candidate out from under the router (the rollback
+    # race), then route a rid that WOULD have split to it
+    with ctl._as_controller():
+        registry.remove(cand)
+    code, doc, headers = _predict(url, _x(50), rid="race-1")
+    assert code == 200
+    assert headers["X-Serving-Generation"] == "gen_1"
+    assert doc["model_version"] == 1
+    ctl.tick()
+    assert ctl.status("m")["state"] == FAILED
+
+
+def test_release_http_error_surface(served):
+    server, registry, url, tmp = served
+    cand_zip = _zip(tmp, "cand.zip", seed=42)
+    # unknown model -> 404; bad body -> 400; absent record -> 404
+    assert _request(url + "/release/ghost",
+                    {"path": cand_zip})[0] == 404
+    assert _request(url + "/release/m", {"nope": 1})[0] == 400
+    assert _request(url + "/release/m")[0] == 404
+    assert _request(url + "/release/m", method="DELETE")[0] == 404
+    # the SLO judge is mandatory
+    root.common.serving.slo_enabled = False
+    code, doc, _ = _request(url + "/release/m", {"path": cand_zip})
+    assert code == 400 and "slo" in doc["error"].lower()
+
+
+# -- the real fleet ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("release_fleet")
+    live = _zip(tmp, "live.zip", seed=42)
+    router = FleetRouter(
+        ["m=" + live, "--max-batch", "8",
+         "--config", "common.serving.slo_enabled=True"],
+        replicas=2, compile_cache_dir=str(tmp / "cache"),
+        env=ENV).start()
+    saved = root.common.serving.slo_enabled
+    root.common.serving.slo_enabled = True
+    url = "http://127.0.0.1:%d" % router.port
+    yield router, url, tmp
+    router.stop()
+    root.common.serving.slo_enabled = saved
+
+
+def _get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _drive_until(url, state_set, max_s=60, rid_prefix="drv"):
+    """Pump real traffic through the fleet until the release reaches
+    one of ``state_set``; returns (final_status, reply_generations)."""
+    gens = []
+    deadline = time.monotonic() + max_s
+    i = 0
+    while time.monotonic() < deadline:
+        code, _, headers = _predict(url, _x(i),
+                                    rid="%s-%d" % (rid_prefix, i))
+        assert code == 200
+        gens.append(headers.get("X-Serving-Generation"))
+        i += 1
+        if i % 4 == 0:
+            code, doc, _ = _request(url + "/release/m")
+            if code == 200 and doc["state"] in state_set:
+                return doc, gens
+        time.sleep(0.05)
+    raise AssertionError("release never reached %s" % (state_set,))
+
+
+def test_fleet_zero_touch_promote(fleet):
+    router, url, tmp = fleet
+    cand_zip = _zip(tmp, "cand.zip", seed=42)
+    code, doc, _ = _request(
+        url + "/release/m",
+        {"path": cand_zip,
+         "policy": {"green_window_s": 0.4, "min_requests": 2,
+                    "shadow_min_compares": 3,
+                    "canary_steps": [50.0]}})
+    assert code == 200 and doc["state"] == SHADOW
+    assert doc["candidate"] == "m.gen2"
+    final, gens = _drive_until(url, {PROMOTED, FAILED, "rolled_back"},
+                               rid_prefix="promote")
+    assert final["state"] == PROMOTED, final
+    # during the canary leg some replies attributed to the candidate
+    # generation, and every reply names SOME generation
+    assert set(gens) <= {"gen_1", "gen_2"}
+    assert "gen_2" in gens
+    # the fleet converged on the promoted generation
+    models = _get(url, "/models")["models"]
+    assert models["m"]["model_version"] == 2
+    assert "m.gen2" not in models
+
+
+def test_fleet_abort_during_ramp_storm_no_duplicates(fleet):
+    """Operator rollback DURING a canary storm: every in-flight
+    request is answered 200 (candidate-gone requests fall back to the
+    live generation) and each rid was admitted by exactly ONE
+    replica — the retry oracle proves the fallback resend never
+    double-dispatched."""
+    router, url, tmp = fleet
+    code, doc, _ = _request(
+        url + "/release/m",
+        {"path": _zip(tmp, "cand2.zip", seed=42),
+         "policy": {"green_window_s": 0.2, "min_requests": 1,
+                    "shadow_min_compares": 2,
+                    # one long ladder: stays IN canary for the storm
+                    "canary_steps": [60.0, 60.0, 60.0, 60.0, 60.0,
+                                     60.0, 60.0, 60.0]}})
+    assert code == 200
+    cand = doc["candidate"]
+    assert cand == "m.gen3"
+    # reach the canary leg first
+    deadline = time.monotonic() + 60
+    i = 0
+    while _request(url + "/release/m")[1]["state"] == SHADOW:
+        assert time.monotonic() < deadline, "stuck in shadow"
+        assert _predict(url, _x(i), rid="warm-%d" % i)[0] == 200
+        i += 1
+        time.sleep(0.05)
+    # the storm: concurrent canary-heavy traffic, abort mid-flight
+    rids = ["storm-%03d" % n for n in range(48)]
+    assert any(split_point(r) < 60.0 for r in rids)
+    results, errors = {}, []
+
+    def fire(rid, seed):
+        try:
+            code, _, headers = _predict(url, _x(seed), rid=rid)
+            results[rid] = (code,
+                            headers.get("X-Serving-Generation"))
+        except Exception as e:  # noqa: BLE001 - the assertion below
+            errors.append((rid, repr(e)))
+
+    threads = [threading.Thread(target=fire, args=(rid, 100 + n))
+               for n, rid in enumerate(rids)]
+    for t in threads[:24]:
+        t.start()
+    code, doc, _ = _request(url + "/release/m", method="DELETE")
+    assert (code, doc["state"]) == (200, ABORTED)
+    for t in threads[24:]:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert sorted(results) == sorted(rids)
+    assert all(code == 200 for code, _ in results.values()), results
+    # the oracle: every rid admitted on exactly one replica — the
+    # candidate-gone fallback resend is pre-admission by construction
+    replicas = [r for r in router.replicas() if r.state == "up"]
+    assert len(replicas) == 2
+    for rid in rids:
+        admitted = [_get(r.url, "/admitted/" + rid)["admitted"]
+                    for r in replicas]
+        assert sorted(admitted) == [False, True], (rid, admitted)
+    # the fleet is clean: candidate undeployed everywhere, live
+    # generation still serving bit-identically on both replicas
+    models = _get(url, "/models")["models"]
+    assert cand not in models
+    x = _x(999)
+    replies = [_predict(url, x)[1]["outputs"] for _ in range(4)]
+    assert all(r == replies[0] for r in replies)

@@ -291,8 +291,10 @@ def test_continuous_batcher_lane_key_carries_dtype(trained):
     batcher.submit(x, model="a")
     batcher.submit(x, model="b")
     keys = sorted(batcher._queues)
-    assert keys == [("a", (13,), "f32", "normal"),
-                    ("b", (13,), "int8", "normal")]
+    # trailing leg: the engine generation (serving/release.py keeps
+    # lanes generation-pure across a promote)
+    assert keys == [("a", (13,), "f32", "normal", 1),
+                    ("b", (13,), "int8", "normal", 1)]
     batcher._running = False
     for q in batcher._queues.values():
         while q.reqs:

@@ -81,7 +81,9 @@ def test_lane_key_carries_priority_and_stays_pure():
     b.submit(_rows(1))
     keys = sorted(k[3] for k in b._queues)
     assert keys == ["high", "low", "normal"]
-    assert all(len(k) == 4 for k in b._queues)
+    # (model, shape, dtype, priority, generation) — the trailing leg
+    # keeps lanes generation-pure across a release promote
+    assert all(len(k) == 5 for k in b._queues)
     b._running = False
     for q in b._queues.values():
         while q.reqs:

@@ -1,0 +1,418 @@
+"""Progressive-delivery state machine (znicz_tpu/serving/release.py,
+ISSUE 17): deterministic rid splits, shadow compare judgments, the
+green-window ladder, the mutation guard, and every terminal edge —
+all driven by an injectable clock and the public ``tick()``, with the
+real ModelRegistry + SloTracker underneath and ZERO synthetic sleeps
+(``drain_shadow`` is a bounded sync on the async mirror, not a
+sleep-and-hope)."""
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import telemetry
+from znicz_tpu.serving.registry import ModelRegistry
+from znicz_tpu.serving.release import (
+    ABORTED, CANARY, FAILED, PROMOTED, ROLLED_BACK, SHADOW,
+    LocalTarget, ReleaseConflictError, ReleaseController,
+    candidate_name, generation_label, generation_of, split_point)
+from znicz_tpu.serving.slo import SloTracker
+from znicz_tpu.testing import build_fc_package_zip
+
+N_IN, N_OUT = 6, 3
+#: a fast, fully deterministic ladder for the unit timeline
+POLICY = {"canary_steps": [10.0, 50.0], "green_window_s": 5.0,
+          "min_requests": 4, "shadow_min_compares": 3}
+
+
+class FakeClock(object):
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def slo_on():
+    saved = root.common.serving.slo_enabled
+    root.common.serving.slo_enabled = True
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    root.common.serving.slo_enabled = saved
+
+
+def _zip(tmp_path, name, seed, scale=None):
+    return build_fc_package_zip(str(tmp_path / name),
+                                [N_IN, 8, N_OUT], seed=seed,
+                                scale=scale)
+
+
+@pytest.fixture
+def plane(tmp_path, slo_on):
+    """Registry with one live model + a controller over it (threads
+    armed so the async mirror really runs on its worker)."""
+    live = _zip(tmp_path, "live.zip", seed=42)
+    registry = ModelRegistry(max_batch=8, warmup=False)
+    registry.add("m", live)
+    clock = FakeClock()
+    tracker = SloTracker(clock=clock)
+    ctl = ReleaseController(LocalTarget(registry, tracker),
+                            clock=clock).start()
+    try:
+        yield ctl, registry, tracker, clock, tmp_path
+    finally:
+        ctl.stop()
+
+
+def _x(seed, rows=4):
+    return numpy.random.RandomState(seed).uniform(
+        -1.0, 1.0, (rows, N_IN)).astype(numpy.float32)
+
+
+def _mirror_live(ctl, registry, n, seed0=0):
+    """Mirror n real (request, live-reply) pairs and wait for the
+    shadow worker to judge them all."""
+    engine = registry.engine("m")
+    for i in range(n):
+        x = _x(seed0 + i)
+        assert ctl.mirror("m", "rid-%d" % i, x, engine.predict(x))
+    assert ctl.drain_shadow()
+
+
+def _drive_canary_step(ctl, tracker, clock, rel, n=6):
+    """Feed n good candidate requests, then hold green past the
+    window: one ladder step."""
+    for i in range(n):
+        tracker.record(rel.cand_name, 200, 1.0,
+                       rid="c-%d-%d" % (rel.step_idx, i))
+    ctl.tick()
+    clock.advance(6.0)
+    ctl.tick()
+
+
+# -- pure helpers ------------------------------------------------------------
+
+def test_name_and_label_helpers():
+    assert candidate_name("wine", 2) == "wine.gen3"
+    assert generation_of("wine.gen3") == 3
+    assert generation_of("wine") is None
+    # a candidate labels its ENCODED generation even when its own
+    # engine version differs; a live model labels its version
+    assert generation_label("wine.gen7", 1) == "gen_7"
+    assert generation_label("wine", 4) == "gen_4"
+
+
+def test_split_is_deterministic_sticky_and_roughly_uniform():
+    rids = ["req-%d" % i for i in range(2000)]
+    points = [split_point(r) for r in rids]
+    # sticky: the same rid always lands at the same coordinate
+    assert points == [split_point(r) for r in rids]
+    assert all(0.0 <= p < 100.0 for p in points)
+    # a 10% split captures roughly 10% of distinct rids
+    frac = sum(p < 10.0 for p in points) / len(points)
+    assert 0.06 < frac < 0.14, frac
+
+
+# -- lifecycle happy path ----------------------------------------------------
+
+def test_healthy_release_walks_the_ladder_to_promoted(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    v_live = registry.peek("m").version
+    st = ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                           policy=POLICY)
+    assert st["state"] == SHADOW
+    assert st["candidate"] == "m.gen%d" % (v_live + 1)
+    rel = ctl._active["m"]
+    # identical params -> bit-identical shadow replies, zero
+    # mismatches
+    _mirror_live(ctl, registry, 4)
+    assert rel.shadow_compares == 4
+    assert rel.shadow_mismatches == 0
+    # green must HOLD for the window: the first tick only starts it
+    ctl.tick()
+    assert rel.state == SHADOW
+    clock.advance(6.0)
+    ctl.tick()
+    assert rel.state == CANARY
+    assert rel.canary_pct == 10.0
+    _drive_canary_step(ctl, tracker, clock, rel)
+    assert (rel.state, rel.canary_pct) == (CANARY, 50.0)
+    _drive_canary_step(ctl, tracker, clock, rel)
+    assert rel.state == PROMOTED
+    # promote swapped the LIVE engine and removed the candidate
+    assert registry.peek("m").version == v_live + 1
+    assert rel.cand_name not in registry
+    assert ctl.status("m")["state"] == PROMOTED
+    assert not ctl.active()
+    events = [e["kind"] for e in telemetry.journal_events()
+              if e["kind"].startswith("release.")]
+    assert events[0] == "release.start"
+    assert events.count("release.advance") == 2
+    assert events[-1] == "release.promote"
+
+
+def test_green_window_resets_on_red(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=POLICY)
+    rel = ctl._active["m"]
+    ctl.tick()                       # 0 compares: red
+    clock.advance(100.0)
+    ctl.tick()                       # still red -> no advancement
+    assert rel.state == SHADOW
+    _mirror_live(ctl, registry, 4)
+    ctl.tick()                       # green starts NOW, not earlier
+    clock.advance(4.0)
+    ctl.tick()
+    assert rel.state == SHADOW       # 4s < 5s window
+    clock.advance(2.0)
+    ctl.tick()
+    assert rel.state == CANARY
+
+
+def test_hold_policy_pins_the_release_in_shadow(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=dict(POLICY, hold=True))
+    rel = ctl._active["m"]
+    _mirror_live(ctl, registry, 6)
+    ctl.tick()
+    clock.advance(60.0)
+    ctl.tick()
+    # held: judged green but never advances
+    assert rel.state == SHADOW
+    assert ctl.abort("m")["state"] == ABORTED
+
+
+# -- the mutation guard ------------------------------------------------------
+
+def test_mutations_racing_a_release_conflict_loudly(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    live = _zip(tmp, "l2.zip", seed=42)
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42))
+    for fn in (lambda: registry.reload("m", live),
+               lambda: registry.reload(None, live),
+               lambda: registry.add("m", live),
+               lambda: registry.add("m.gen2", live),
+               lambda: registry.remove("m.gen2")):
+        with pytest.raises(ReleaseConflictError):
+            fn()
+    # a second release of the same model is the same conflict
+    with pytest.raises(ReleaseConflictError):
+        ctl.start_release("m", live)
+    # an UNRELATED model mutates freely while the release is active
+    registry.add("other", _zip(tmp, "other.zip", seed=7))
+    registry.remove("other")
+    ctl.abort("m")
+    # the guard stands down with the release
+    registry.reload("m", live)
+
+
+def test_release_requires_the_slo_judge(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    root.common.serving.slo_enabled = False
+    with pytest.raises(ValueError):
+        ctl.start_release("m", _zip(tmp, "cand.zip", seed=42))
+
+
+# -- terminal edges ----------------------------------------------------------
+
+def test_candidate_dies_mid_shadow_is_failed_not_rollback(plane):
+    """A candidate death while only MIRRORED traffic touched it must
+    read ``failed`` — there is nothing to roll back, and the live
+    generation keeps answering bit-identically."""
+    ctl, registry, tracker, clock, tmp = plane
+    x = _x(123)
+    y_before = registry.engine("m").predict(x)
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=POLICY)
+    rel = ctl._active["m"]
+    with ctl._as_controller():       # simulate the crash
+        registry.remove(rel.cand_name)
+    ctl.tick()
+    assert rel.state == FAILED
+    assert "died during shadow" in rel.reason
+    assert numpy.array_equal(registry.engine("m").predict(x),
+                             y_before)
+    kinds = [e["kind"] for e in telemetry.journal_events()]
+    assert "release.failed" in kinds
+    assert "release.rollback" not in kinds
+
+
+def test_shadow_mismatch_breach_rolls_back_with_exemplar(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    # different seed -> different params -> f32 bit-identity breach
+    ctl.start_release("m", _zip(tmp, "bad.zip", seed=7),
+                      policy=POLICY)
+    rel = ctl._active["m"]
+    _mirror_live(ctl, registry, 3)
+    assert rel.shadow_mismatches > 0
+    ctl.tick()
+    assert rel.state == ROLLED_BACK
+    assert "mismatch breach" in rel.reason
+    assert rel.cand_name not in registry
+    # the rollback journal names the exemplar rid and the compare
+    # journal carries per-bucket deltas
+    ev = {e["kind"]: e for e in telemetry.journal_events()}
+    assert ev["release.rollback"]["exemplar_rid"].startswith("rid-")
+    mm = ev["release.shadow_mismatch"]
+    assert mm["bucket"] == "4" and mm["max_delta"] > 0
+
+
+def test_shadow_errors_fail_the_release(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=dict(POLICY, shadow_error_max=1))
+    rel = ctl._active["m"]
+    engine = registry.engine("m")
+    x = _x(0)
+    y = engine.predict(x)
+    # rows with the WRONG width: the candidate predict raises
+    for i in range(3):
+        bad = numpy.zeros((4, N_IN + 1), dtype=numpy.float32)
+        assert ctl.mirror("m", "bad-%d" % i, bad, y)
+    assert ctl.drain_shadow()
+    assert rel.shadow_errors == 3
+    ctl.tick()
+    assert rel.state == FAILED
+
+
+def test_burn_breach_during_canary_rolls_back(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=POLICY)
+    rel = ctl._active["m"]
+    _mirror_live(ctl, registry, 4)
+    ctl.tick()
+    clock.advance(6.0)
+    ctl.tick()
+    assert rel.state == CANARY
+    # the candidate's OWN SLO key burns on both windows
+    for i in range(20):
+        tracker.record(rel.cand_name, 500, 1.0, rid="burn-%d" % i)
+    assert tracker.status()["models"][rel.cand_name]["burning"]
+    ctl.tick()
+    assert rel.state == ROLLED_BACK
+    assert "burn breach" in rel.reason
+    assert rel.last_signals["burn_fast"] > 0
+    assert rel.cand_name not in registry
+
+
+def test_candidate_dies_mid_canary_is_failed(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=POLICY)
+    rel = ctl._active["m"]
+    _mirror_live(ctl, registry, 4)
+    ctl.tick()
+    clock.advance(6.0)
+    ctl.tick()
+    assert rel.state == CANARY
+    with ctl._as_controller():
+        registry.remove(rel.cand_name)
+    # routing immediately stops offering the dead candidate's name
+    # once the judge retires the release
+    ctl.tick()
+    assert rel.state == FAILED
+    assert all(ctl.route("m", "r-%d" % i) is None for i in range(50))
+
+
+# -- the data-plane hooks ----------------------------------------------------
+
+def test_route_splits_deterministically_and_only_in_canary(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=POLICY)
+    rel = ctl._active["m"]
+    rids = ["r-%d" % i for i in range(400)]
+    # shadow: nothing routes to the candidate
+    assert all(ctl.route("m", r) is None for r in rids[:20])
+    _mirror_live(ctl, registry, 4)
+    ctl.tick()
+    clock.advance(6.0)
+    ctl.tick()
+    assert (rel.state, rel.canary_pct) == (CANARY, 10.0)
+    routed = {r: ctl.route("m", r) for r in rids}
+    # sticky: a retry of the same rid lands on the SAME generation
+    assert routed == {r: ctl.route("m", r) for r in rids}
+    hits = [r for r in rids if routed[r] == rel.cand_name]
+    assert all(split_point(r) < 10.0 for r in hits)
+    assert 0.04 < len(hits) / len(rids) < 0.18
+    # an unreleased model never splits
+    assert ctl.route("other", rids[0]) is None
+
+
+def test_mirror_samples_and_drops_instead_of_blocking(slo_on,
+                                                      tmp_path):
+    """Backpressure: with no shadow worker draining, the queue caps
+    at 128 and every further mirror DROPS (counted) — the live path
+    never blocks on the shadow plane."""
+    live = _zip(tmp_path, "live.zip", seed=42)
+    registry = ModelRegistry(max_batch=8, warmup=False)
+    registry.add("m", live)
+    clock = FakeClock()
+    ctl = ReleaseController(
+        LocalTarget(registry, SloTracker(clock=clock)), clock=clock)
+    ctl.start_release("m", _zip(tmp_path, "cand.zip", seed=42))
+    rel = ctl._active["m"]
+    x, y = _x(0), numpy.zeros((4, N_OUT))
+    for i in range(140):
+        ctl.mirror("m", "q-%d" % i, x, y)
+    assert len(ctl._queue) == 128
+    assert rel.shadow_dropped == 12
+    # sampling: at 0% nothing enqueues at all
+    rel.policy["shadow_sample_pct"] = 0.0
+    assert not ctl.mirror("m", "sampled-out", x, y)
+    assert len(ctl._queue) == 128
+
+
+def test_status_surface_and_unknown_model(plane):
+    ctl, registry, tracker, clock, tmp = plane
+    with pytest.raises(KeyError):
+        ctl.status("ghost")
+    with pytest.raises(KeyError):
+        ctl.abort("m")
+    ctl.start_release("m", _zip(tmp, "cand.zip", seed=42),
+                      policy=POLICY)
+    st = ctl.status()
+    assert set(st) == {"active", "recent"}
+    assert st["active"]["m"]["shadow"]["tolerance"] == \
+        {"max_delta": 0.0, "flip_rate": 0.0}
+    ctl.abort("m")
+    assert ctl.status("m")["state"] == ABORTED
+    assert ctl.status()["recent"]["m"]["reason"] == "operator abort"
+
+
+def test_per_model_fault_site_hits_only_the_named_engine(
+        slo_on, tmp_path, monkeypatch):
+    """The sabotage hook the release plane leans on: a fault installed
+    at ``serving.forward.<name>`` breaks exactly that engine — its
+    live peer in the same registry keeps serving untouched.  (This is
+    how a CI act can corrupt ONE candidate generation in-process.)"""
+    from znicz_tpu.core import faults
+
+    registry = ModelRegistry(max_batch=8, warmup=False)
+    registry.add("m", _zip(tmp_path, "live.zip", seed=42))
+    registry.add("m.gen2", _zip(tmp_path, "cand.zip", seed=42))
+    monkeypatch.setattr(root.common.retry, "attempts", 0)
+    faults.install("serving.forward.m.gen2", kind="xla", every=1)
+    monkeypatch.setattr(root.common.faults, "enabled", True)
+    try:
+        x = _x(3)
+        # the live model is oblivious to its sibling's fault rule
+        assert registry.engine("m").predict(x).shape == (4, N_OUT)
+        with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+            registry.engine("m.gen2").predict(x)
+        assert faults.status()["sites"][
+            "serving.forward.m.gen2"]["injected"] >= 1
+        # clearing the rule heals the candidate in place
+        faults.clear("serving.forward.m.gen2")
+        assert registry.engine("m.gen2").predict(x).shape == \
+            (4, N_OUT)
+    finally:
+        faults.clear()

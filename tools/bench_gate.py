@@ -114,7 +114,13 @@ GATED_INVERSE = ("serving_loadgen_p99_ms",
                  # at 0.01 so a real ~zero never reads as the
                  # crash-guard zero)
                  "serving_fleet_observability_overhead_pct",
-                 "serving_router_hop_overhead_ms")
+                 "serving_router_hop_overhead_ms",
+                 # the shadow-mirroring tax (ISSUE 17): a release
+                 # held in shadow at 100% sampling vs the same armed
+                 # fleet without one, same floored-at-1.0 honest-zero
+                 # rule — progressive delivery getting expensive
+                 # fails the round like a latency regression
+                 "serving_release_shadow_overhead_pct")
 
 
 def _payload(doc):
@@ -338,6 +344,21 @@ def selftest(threshold=0.10):
     fo_wobble, _ = compare(
         {k: v * (1.0 + threshold) for k, v in fo_old.items()},
         fo_old, threshold)
+    # the shadow-mirroring gate (ISSUE 17), same inverted shape: the
+    # release plane's live-path tax fails on a rise or a crash-guard
+    # zero, wobbles inside the band pass
+    rs_old = {"serving_release_shadow_overhead_pct": 4.0}
+    rs_rise, _ = compare(
+        dict(rs_old, serving_release_shadow_overhead_pct=4.0 *
+             (1.0 + 2 * threshold) * 2.0),
+        rs_old, threshold)
+    rs_zero, _ = compare(
+        dict(rs_old, serving_release_shadow_overhead_pct=0.0),
+        rs_old, threshold)
+    rs_wobble, _ = compare(
+        dict(rs_old, serving_release_shadow_overhead_pct=4.0 *
+             (1.0 + threshold)),
+        rs_old, threshold)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
             or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
@@ -345,7 +366,8 @@ def selftest(threshold=0.10):
             or fl_drop or fl_zero or fl_gone or not fl_wobble \
             or ob_rise or ob_zero or not ob_wobble \
             or fo_rise or fo_zero or hop_rise or hop_zero \
-            or not fo_wobble:
+            or not fo_wobble \
+            or rs_rise or rs_zero or not rs_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
@@ -360,7 +382,10 @@ def selftest(threshold=0.10):
               "obs_zero_rejected=%s obs_wobble_passed=%s "
               "fleet_obs_rise_rejected=%s fleet_obs_zero_rejected=%s "
               "hop_rise_rejected=%s hop_zero_rejected=%s "
-              "fleet_obs_wobble_passed=%s"
+              "fleet_obs_wobble_passed=%s "
+              "release_shadow_rise_rejected=%s "
+              "release_shadow_zero_rejected=%s "
+              "release_shadow_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
@@ -368,7 +393,8 @@ def selftest(threshold=0.10):
                  not tl_gone, tl_wobble, not fl_drop, not fl_zero,
                  not fl_gone, fl_wobble, not ob_rise, not ob_zero,
                  ob_wobble, not fo_rise, not fo_zero, not hop_rise,
-                 not hop_zero, fo_wobble))
+                 not hop_zero, fo_wobble, not rs_rise, not rs_zero,
+                 rs_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
@@ -382,7 +408,9 @@ def selftest(threshold=0.10):
           "SLO-plane overhead rise and zero-stamp rejected, "
           "overhead wobble passes; fleet-tracing overhead and "
           "router hop-overhead rise/zero-stamp rejected, fleet "
-          "overhead wobble passes (threshold %.0f%%)"
+          "overhead wobble passes; release shadow-mirroring "
+          "overhead rise/zero-stamp rejected, its wobble passes "
+          "(threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))
     return 0
 

@@ -32,6 +32,16 @@ honestly.
   goodput/latency/shed blocks — ``--assert-goodput-pct high:90``
   gates one lane's goodput specifically (the overload contract:
   low sheds first, high holds).
+* **Per-generation attribution**: every HTTP reply's
+  ``X-Serving-Generation`` header is retained per request, and the
+  report grows a ``per_generation`` block (requests, share of
+  traffic, goodput, latency tail per ``gen_<N>`` label) — during a
+  canary release the ``share_pct`` IS the observed split, so a
+  release run asserts the ladder percentage client-side.
+* **Relative overload gate** (``--assert-goodput-gap high:low:10``):
+  gates the high-vs-low goodput GAP instead of an absolute number —
+  on a slow machine every absolute goodput sags together while the
+  priority contract (low sheds first) still holds.
 * **Binary bodies** (``--npy``): raw ``.npy`` payloads over
   keep-alive connections for capacity/fleet-scaling measurements —
   microseconds of codec per request instead of the JSON
@@ -251,7 +261,7 @@ def run(plan, models, submit, slo_ms, duration_s, seed,
     would experience it."""
     inputs = make_inputs(models, seed)
     lock = threading.Lock()
-    # (model_index, rows, latency_s, status, priority)
+    # (model_index, rows, latency_s, status, priority, generation)
     records = []
     outstanding = threading.Semaphore(0)
     n_async = 0
@@ -260,9 +270,15 @@ def run(plan, models, submit, slo_ms, duration_s, seed,
         done = time.monotonic()
         exc = future.exception()
         status = 200 if exc is None else _classify(exc)
+        # HTTP submits resolve to the reply's X-Serving-Generation
+        # label (which generation answered — the canary-split
+        # evidence); in-process submits resolve to the output array,
+        # which carries no attribution
+        res = future.result() if exc is None else None
+        gen = res if isinstance(res, str) else None
         with lock:
             records.append(rec_base + (done - scheduled_wall, status,
-                                       prio))
+                                       prio, gen))
         outstanding.release()
 
     t0 = time.monotonic()
@@ -281,7 +297,7 @@ def run(plan, models, submit, slo_ms, duration_s, seed,
             with lock:
                 records.append(
                     (mi, rows, time.monotonic() - scheduled_wall,
-                     _classify(e), prio))
+                     _classify(e), prio, None))
             continue
         n_async += 1
         future.add_done_callback(
@@ -365,6 +381,27 @@ def report(records, scheduled, duration_s, slo_ms, seed, models,
                             if mine else None),
             "latency_ms": _pct_block(p_ok),
         }
+    # per-generation breakdown (the release plane's client-side
+    # evidence): each HTTP reply names the generation that answered
+    # it in X-Serving-Generation — during a canary the share_pct here
+    # IS the observed split, so a release run can assert the ladder
+    # percentage from outside the fleet
+    per_generation = {}
+    gens = sorted({r[5] for r in records if len(r) > 5 and r[5]})
+    for gen in gens:
+        mine = [r for r in records if len(r) > 5 and r[5] == gen]
+        g_ok = [r[2] for r in mine if r[3] == 200]
+        g_good = sum(1 for r in mine
+                     if r[3] == 200 and r[2] <= slo_s)
+        per_generation[gen] = {
+            "requests": len(mine),
+            "ok": len(g_ok),
+            "share_pct": (round(100.0 * len(mine) / len(records), 2)
+                          if records else None),
+            "goodput_pct": (round(100.0 * g_good / len(mine), 2)
+                            if mine else None),
+            "latency_ms": _pct_block(g_ok),
+        }
     out = {
         "seed": int(seed),
         "duration_s": round(float(duration_s), 3),
@@ -387,6 +424,7 @@ def report(records, scheduled, duration_s, slo_ms, seed, models,
             dispatch_behind_max_s * 1e3, 3),
         "per_model": per_model,
         "per_priority": per_priority,
+        "per_generation": per_generation,
     }
     return out
 
@@ -520,7 +558,7 @@ def http_submit(base_url, pool, binary=False, rid_prefix=None):
                 local.conn = None
             if resp.status >= 400:
                 raise _HttpStatusError(resp.status)
-            return True
+            return resp.getheader("X-Serving-Generation") or True
 
     def _do(model, x, timeout_ms, priority):
         path = "/predict" if model is None else "/predict/" + model
@@ -539,10 +577,11 @@ def http_submit(base_url, pool, binary=False, rid_prefix=None):
         try:
             with urllib.request.urlopen(req, timeout=wait) as resp:
                 json.loads(resp.read())
+                gen = resp.headers.get("X-Serving-Generation")
         except urllib.error.HTTPError as e:
             e.read()
             raise _HttpStatusError(e.code)
-        return True
+        return gen or True
 
     def submit(model, x, timeout_ms, priority=None):
         return pool.submit(_do, model, x, timeout_ms, priority)
@@ -603,6 +642,14 @@ def main(argv=None):
                              "goodput (e.g. 'high:90' holds the "
                              "high lane under overload); comma-"
                              "separate to gate several")
+    parser.add_argument("--assert-goodput-gap", default=None,
+                        metavar="PRIO:PRIO:PTS[,...]",
+                        help="exit 1 when lane A's goodput%% does not "
+                             "exceed lane B's by at least PTS points "
+                             "(e.g. 'high:low:10').  Gates the "
+                             "RELATIVE overload contract — robust on "
+                             "slow machines where every absolute "
+                             "goodput number sags together")
     args = parser.parse_args(argv)
 
     from znicz_tpu.core.config import root
@@ -647,6 +694,38 @@ def main(argv=None):
             if got < want:
                 failed.append("%s %.2f%% below the %.2f%% SLO "
                               "assertion" % (label, got, want))
+        if failed:
+            for line in failed:
+                print("loadgen: " + line, file=sys.stderr)
+            return 1
+    if args.assert_goodput_gap is not None:
+        failed = []
+        for entry in str(args.assert_goodput_gap).split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            try:
+                hi, lo, pts = entry.split(":")
+                pts = float(pts)
+            except ValueError:
+                parser.error("--assert-goodput-gap wants "
+                             "PRIO:PRIO:PTS, got %r" % entry)
+            blocks = out["per_priority"]
+            missing = [p for p in (hi, lo) if p not in blocks]
+            if missing:
+                failed.append(
+                    "%s: no %s traffic in the report (run with "
+                    "--priority-mix including it)"
+                    % (entry, "/".join(missing)))
+                continue
+            got_hi = blocks[hi]["goodput_pct"] or 0.0
+            got_lo = blocks[lo]["goodput_pct"] or 0.0
+            if got_hi - got_lo < pts:
+                failed.append(
+                    "%s-vs-%s goodput gap %.2f points below the "
+                    "%.2f-point assertion (%s=%.2f%%, %s=%.2f%%)"
+                    % (hi, lo, got_hi - got_lo, pts, hi, got_hi,
+                       lo, got_lo))
         if failed:
             for line in failed:
                 print("loadgen: " + line, file=sys.stderr)

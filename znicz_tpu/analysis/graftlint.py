@@ -73,6 +73,11 @@ SERIES_PREFIXES = frozenset((
     "fleet",
     "health", "jax", "launcher", "loader",
     "memory", "profiler", "registry",
+    # the release plane (ISSUE 17): shadow-compare / canary-state
+    # series per (model, generation) — release.shadow_compares,
+    # release.shadow_mismatches, release.shadow_dropped,
+    # release.state, release.canary_pct (serving/release.py)
+    "release",
     "router",
     "serving",
     # the serving SLO plane (ISSUE 14): per-model good/total,
@@ -85,7 +90,12 @@ SERIES_PREFIXES = frozenset((
 #: legal ``labeled()`` label keys — a bounded set by design (every
 #: (key, value) pair mints a new series)
 LABEL_KEYS = frozenset((
-    "bucket", "breaker", "device", "dtype", "model",
+    "bucket", "breaker", "device", "dtype",
+    # the release plane (ISSUE 17): generation ordinals ("1", "2",
+    # ...) on the release.* series — bounded by promote cadence (one
+    # value per deployed generation), never by request data
+    "gen",
+    "model",
     # the priority lanes (ISSUE 15): bounded by the PRIORITIES
     # vocabulary in serving/continuous.py (high/normal/low)
     "priority",

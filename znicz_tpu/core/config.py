@@ -419,6 +419,28 @@ declare("common", {
                                      # before a scale-down (hysteresis)
             "cooldown_s": 30.0,      # min seconds between actions
         },
+        # progressive delivery (serving/release.py) — the SLO-judged
+        # shadow -> canary -> promote pipeline; see docs/deployment.md
+        # "Continuous delivery" for every knob's meaning.  A POST
+        # /release body's "policy" object overrides any knob for that
+        # one release.
+        "release": {
+            "shadow_sample_pct": 100.0,  # % of live traffic mirrored
+                                         # to the candidate in shadow
+            "shadow_min_compares": 8,    # compared replies required
+                                         # before shadow can go green
+            "shadow_mismatch_max": 0,    # tolerated out-of-tolerance
+                                         # shadow replies (> -> red)
+            "shadow_error_max": 3,       # candidate errors during
+                                         # shadow before -> failed
+            "canary_steps": [5.0, 25.0, 50.0],  # ramp ladder (% of
+                                                # real traffic)
+            "green_window_s": 5.0,   # BOTH burn windows must stay
+                                     # green this long per step
+            "min_requests": 12,      # candidate requests per step
+                                     # before advancement counts
+            "tick_interval_s": 0.25,  # controller evaluation cadence
+        },
     },
     # persistent XLA compilation cache (core/compile_cache.py) — the
     # serving cold-start story: executables compile once per cluster,

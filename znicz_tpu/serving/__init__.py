@@ -39,7 +39,12 @@ Three pillars (docs/serving.md):
   retries and fleet-aggregated operator endpoints, scaled by the
   SLO-burn-driven autoscaler (``serve --fleet N [--autoscale]``);
   priority lanes in the continuous batcher shed low-priority traffic
-  first under overload.
+  first under overload;
+* :class:`znicz_tpu.serving.release.ReleaseController` — the
+  progressive-delivery plane (docs/deployment.md "Continuous
+  delivery"): shadow mirroring with per-dtype accuracy compares,
+  rid-hash canary splits judged by the live burn rates, and
+  zero-touch promote/rollback at ``POST /release/<model>``.
 """
 
 from znicz_tpu.serving.engine import (  # noqa: F401 - re-export
@@ -58,6 +63,8 @@ from znicz_tpu.serving.autoscaler import Autoscaler  # noqa: F401
 from znicz_tpu.serving.registry import (  # noqa: F401 - re-export
     ModelRegistry, UnknownModelError)
 from znicz_tpu.serving.slo import SloTracker  # noqa: F401
+from znicz_tpu.serving.release import (  # noqa: F401 - re-export
+    ReleaseConflictError, ReleaseController)
 from znicz_tpu.serving.server import ServingServer  # noqa: F401
 
 __all__ = ["InferenceEngine", "MicroBatcher", "ContinuousBatcher",
@@ -66,4 +73,5 @@ __all__ = ["InferenceEngine", "MicroBatcher", "ContinuousBatcher",
            "RequestTimeoutError", "default_buckets",
            "CircuitBreaker", "CircuitOpenError", "SloTracker",
            "SERVING_DTYPES", "normalize_dtype", "FleetRouter",
-           "Autoscaler", "PRIORITIES", "normalize_priority"]
+           "Autoscaler", "PRIORITIES", "normalize_priority",
+           "ReleaseController", "ReleaseConflictError"]

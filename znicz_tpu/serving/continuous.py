@@ -259,8 +259,15 @@ class ContinuousBatcher(Logger):
         # serve_dtype; their lane key gains a stable None).  The
         # priority leg keeps dispatches priority-pure and lets
         # _next_key prefer the high lanes.
+        # ... and a generation leg for the same reason: a release
+        # promote hot-swaps the engine under an unchanged model name,
+        # and requests admitted against different generations must
+        # never coalesce into one batch — each lane stays
+        # generation-pure, so per-generation latency attribution
+        # (serving/release.py) is batch-exact
         key = (model, x.shape[1:],
-               getattr(engine, "serve_dtype", None), priority)
+               getattr(engine, "serve_dtype", None), priority,
+               getattr(engine, "version", None))
         # priority-aware admission ceiling: this priority's share of
         # queue_limit (live config read — an operator can retune the
         # shed curve at runtime); "high" rides the full queue

@@ -901,6 +901,11 @@ class InferenceEngine(Logger):
         def _dispatch():
             if faults.enabled():
                 faults.check("serving.forward")
+                if self.name:
+                    # per-model site: the release smoke sabotages ONE
+                    # candidate generation without touching its live
+                    # peer (serving/release.py)
+                    faults.check("serving.forward.%s" % self.name)
             return fn(params, x)
 
         def _forward():

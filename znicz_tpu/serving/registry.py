@@ -99,11 +99,31 @@ class ModelRegistry(Logger):
         self._budget_override = memory_budget_bytes
         self._engine_defaults = dict(engine_defaults)
         self._evictions = 0
+        #: mutation guard (serving/release.py): consulted before a
+        #: hot reload / remove / hot-add-over-existing so an active
+        #: release can veto operator mutations on its model (409)
+        self._reload_guard = None
         if models:
             for name in sorted(models):
                 self.add(name, models[name])
 
     # -- membership ---------------------------------------------------------
+    def set_reload_guard(self, fn):
+        """Install (or clear, with None) a mutation guard
+        ``fn(name, action)`` consulted before every hot reload,
+        remove, or hot-add-over-existing — it raises to veto (the
+        release controller raises
+        :class:`~znicz_tpu.serving.release.ReleaseConflictError`,
+        which the HTTP front end maps to 409)."""
+        with self._lock:
+            self._reload_guard = fn
+
+    def _check_guard(self, name, action):
+        with self._lock:
+            guard = self._reload_guard
+        if guard is not None:
+            guard(name, action)
+
     def add(self, name, source, **engine_kwargs):
         """Load (or hot-reload) model ``name`` from ``source``; returns
         the engine's new version.
@@ -122,6 +142,7 @@ class ModelRegistry(Logger):
                 "digits, '.', '_', '-'; max 64 chars)" % name)
         with self._lock:
             entry = self._entries.get(name)
+        self._check_guard(name, "add")
         if entry is not None:
             # hot reload supports only what engine.load() takes; a
             # constructor-only knob (max_batch, warmup, ...) must fail
@@ -166,6 +187,8 @@ class ModelRegistry(Logger):
         """Hot-reload ``name`` (default model when None) from
         ``source``; ``source=None`` re-reads the engine's recorded
         source path.  Rollback is scoped to this model."""
+        self._check_guard(name if name is not None else self._default,
+                          "reload")
         entry = self._entry(name)
         src = source
         if src is None:
@@ -183,6 +206,7 @@ class ModelRegistry(Logger):
         """Drop model ``name``; its device buffers free with the last
         in-flight reference.  The default model re-points to the
         oldest remaining entry."""
+        self._check_guard(name, "remove")
         with self._lock:
             entry = self._entries.pop(name, None)
             if entry is None:

@@ -1,0 +1,774 @@
+"""Progressive delivery — shadow -> canary -> promote, judged live.
+
+A model push used to be a blind all-or-nothing ``POST /reload``.  This
+module composes the parts the serving tier already ships — registry
+hot reload with scoped rollback (serving/registry.py), per-model
+multi-window burn rates with exemplar rids (serving/slo.py), the
+accuracy-delta pins (serving/accuracy.py), and the fleet router's
+idempotent-safe relaying (serving/router.py) — into the classic
+SRE-style progressive-delivery controller:
+
+* **Shadow.**  The candidate generation deploys under the derived name
+  ``<model>.gen<N>`` (N = the live engine's next version) and a
+  sampled fraction of the model's REAL traffic is mirrored to it —
+  asynchronously, off the client's critical path, through a bounded
+  queue that DROPS under pressure rather than block.  Each mirrored
+  reply is compared against the live reply under the per-dtype
+  accuracy tolerances (:data:`znicz_tpu.serving.accuracy.TOLERANCES`;
+  an f32 candidate is held to bit identity).  Mismatches are
+  journaled (``release.shadow_mismatch``) with the exemplar rid and
+  counted per shape bucket.  Clients provably never see a shadow
+  reply: the mirror hook runs after the live reply was already
+  written, and nothing on the shadow path holds a handler.
+* **Canary.**  Real traffic splits by a deterministic rid hash
+  (``crc32(rid) % 10000`` against the step's percentage — sticky per
+  rid by construction, so a client retry of the same rid lands on the
+  SAME generation), rewriting the routed model name to the candidate.
+  Because the candidate is a first-class registry model, its burn
+  rates, latency quantiles and mismatch counters all attribute to the
+  ``<model>.gen<N>`` SLO key and the ``gen_<N>`` reply header with
+  zero new accounting machinery.  The state machine (shadow ->
+  canary@N% -> ramp ladder -> promoted, ``hold`` freezing
+  advancement) advances a step only after BOTH burn windows stayed
+  green for ``green_window_s`` with at least ``min_requests``
+  candidate requests at the step, and rolls back automatically on a
+  burn breach (the tracker's both-windows ``burning`` verdict) or a
+  shadow-mismatch breach — journaling ``release.promote`` /
+  ``release.rollback`` with the justifying signals and exemplar rid.
+* **Zero-touch loop.**  ``POST /release/<model>`` (body: ``{"path":
+  ..., "policy": {...}}``) starts a release; ``GET /release[/<model>]``
+  reports it; ``DELETE /release/<model>`` aborts it.  While a release
+  is active, every OTHER mutation path (``/reload``, ``POST/DELETE
+  /models/<name>``) on the released model or its candidate answers a
+  loud 409 through the registry's mutation guard — promote and
+  rollback stay the controller's alone.  A candidate that dies
+  mid-shadow fails the release (state ``failed``) without ever
+  touching live traffic; a candidate that disappears mid-canary falls
+  back to the live generation at routing time, so clients are always
+  answered.
+
+Knobs: ``root.common.serving.release.*`` (live reads; a release's
+``policy`` dict overrides any knob for that one release — see
+docs/deployment.md "Continuous delivery").  Telemetry:
+``release.state`` / ``release.canary_pct`` gauges and
+``release.shadow_compares`` / ``release.shadow_mismatches`` /
+``release.shadow_dropped`` counters, labeled with the model and
+generation.  The clock is injectable and :meth:`ReleaseController.tick`
+is public, so the whole state machine is unit-testable with zero
+sleeps.
+"""
+
+import collections
+import threading
+import time
+import re
+import zlib
+
+import numpy
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.core import telemetry
+from znicz_tpu.analysis import locksmith
+from znicz_tpu.serving import slo
+from znicz_tpu.serving.accuracy import TOLERANCES, _delta_stats
+
+_rel = root.common.serving.release
+
+telemetry.register_help(
+    "release", "progressive delivery (serving/release.py): shadow "
+               "compare/mismatch counters and canary state per "
+               "model generation")
+
+#: release states
+SHADOW, CANARY = "shadow", "canary"
+PROMOTED, ROLLED_BACK = "promoted", "rolled_back"
+FAILED, ABORTED = "failed", "aborted"
+#: terminal states (the release left the active set)
+TERMINAL = frozenset((PROMOTED, ROLLED_BACK, FAILED, ABORTED))
+
+#: ``release.state`` gauge coding (journal carries the string)
+_STATE_CODE = {SHADOW: 1, CANARY: 2, PROMOTED: 3,
+               ABORTED: 0, ROLLED_BACK: -1, FAILED: -2}
+
+#: candidate names derive from the live model: ``<model>.gen<N>``
+_GEN_RE = re.compile(r"\.gen(\d+)$")
+
+#: an f32 candidate must match the live f32 generation bit for bit —
+#: same params, same executable shape, same backend
+_BIT_IDENTITY = {"max_delta": 0.0, "flip_rate": 0.0}
+
+
+class ReleaseConflictError(RuntimeError):
+    """A model mutation raced an active release (HTTP 409): while a
+    release is in flight, ``/reload`` and ``/models/<name>`` on the
+    released model or its candidate are the controller's alone."""
+
+
+def generation_of(name):
+    """The generation number encoded in a candidate name
+    (``wine.gen7`` -> 7), or None for a live model name."""
+    m = _GEN_RE.search(name or "")
+    return int(m.group(1)) if m else None
+
+
+def generation_label(name, version):
+    """The ``X-Serving-Generation`` / SLO label for a reply served by
+    ``name`` at engine ``version``: a candidate name pins the label to
+    its encoded generation (stable across the candidate's own engine
+    versions), a live name labels its current version."""
+    gen = generation_of(name)
+    return "gen_%d" % (gen if gen is not None else int(version or 0))
+
+
+def candidate_name(model, live_version):
+    """The derived registry name a candidate deploys under."""
+    return "%s.gen%d" % (model, int(live_version) + 1)
+
+
+def split_point(rid):
+    """Deterministic [0, 100) split coordinate for one rid — sticky
+    per rid, so retries stay on one generation."""
+    return (zlib.crc32(rid.encode("utf-8", "replace")) % 10000) / 100.0
+
+
+def _shadow_sampled(rid, pct):
+    """Shadow sampling uses a SALTED hash so the mirrored fraction is
+    independent of the canary split coordinate."""
+    if pct >= 100.0:
+        return True
+    point = (zlib.crc32(b"shadow/" + rid.encode("utf-8", "replace"))
+             % 10000) / 100.0
+    return point < pct
+
+
+def _tolerance(dtype):
+    """The per-dtype shadow compare pin: the PR 10 accuracy tolerance
+    for a low-precision candidate, bit identity for f32."""
+    tol = TOLERANCES.get(str(dtype or "f32").replace("-", "_"))
+    if tol is None:
+        return dict(_BIT_IDENTITY)
+    return {"max_delta": float(tol["max_delta"]),
+            "flip_rate": float(tol["flip_rate"])}
+
+
+class LocalTarget(object):
+    """Deployment surface of a single-process registry server: the
+    candidate is a registry model, shadow predicts run the candidate
+    engine directly, SLO reads come from the in-process tracker."""
+
+    def __init__(self, registry, slo_tracker):
+        self.registry = registry
+        self.slo = slo_tracker
+
+    def resolve_default(self):
+        return self.registry.default
+
+    def live_version(self, model):
+        return self.registry.peek(model).version
+
+    def serve_dtype(self, name):
+        return self.registry.peek(name).serve_dtype
+
+    def deploy(self, name, source):
+        self.registry.add(name, source)
+
+    def undeploy(self, name):
+        try:
+            self.registry.remove(name)
+        except KeyError:
+            pass  # already gone (the failure being cleaned up)
+
+    def promote(self, model, source):
+        self.registry.reload(model, source)
+
+    def alive(self, name):
+        try:
+            return self.registry.peek(name).ready
+        except KeyError:
+            return False
+
+    def shadow_predict(self, name, payload):
+        return self.registry.engine(name).predict(payload)
+
+    @staticmethod
+    def decode_reply(reply):
+        return reply  # the live ndarray, as served
+
+    def slo_models(self):
+        return self.slo.status().get("models") or {}
+
+    def set_guard(self, fn):
+        self.registry.set_reload_guard(fn)
+
+
+class Release(object):
+    """One in-flight release: the state-machine record the controller
+    evaluates every tick.  All mutation happens under the controller
+    lock."""
+
+    def __init__(self, model, source, cand_name, policy, dtype, now):
+        self.model = model
+        self.source = source
+        self.cand_name = cand_name
+        self.generation = generation_of(cand_name)
+        self.policy = dict(policy or {})
+        self.dtype = dtype
+        self.tolerance = _tolerance(dtype)
+        self.state = SHADOW
+        self.started = now
+        self.updated = now
+        self.step_idx = -1          # -1 = still shadowing
+        self.step_base_total = 0
+        self.green_since = None
+        self.shadow_compares = 0
+        self.shadow_mismatches = 0
+        self.shadow_errors = 0
+        self.shadow_dropped = 0
+        self.mismatch_buckets = {}
+        self.last_mismatch_rid = None
+        self.last_signals = {}
+        self.reason = None
+        self.history = []
+
+    # -- policy knobs (release policy wins over live config) ---------------
+    def knob(self, key, default):
+        if key in self.policy:
+            return self.policy[key]
+        return _rel.get(key, default)
+
+    @property
+    def steps(self):
+        return [float(s) for s in
+                self.knob("canary_steps", [5.0, 25.0, 50.0])]
+
+    @property
+    def canary_pct(self):
+        if self.state != CANARY or self.step_idx < 0:
+            return 0.0
+        steps = self.steps
+        return steps[min(self.step_idx, len(steps) - 1)] \
+            if steps else 100.0
+
+    @property
+    def held(self):
+        """``policy: {"hold": true}`` freezes advancement (and
+        promotion) while every red-path judgment stays armed — the
+        bench uses it to pin a release in shadow."""
+        return bool(self.knob("hold", False))
+
+    def note(self, event, **attrs):
+        self.history.append(dict({"event": event}, **attrs))
+
+    def status(self):
+        return {
+            "model": self.model,
+            "candidate": self.cand_name,
+            "generation": self.generation,
+            "source": str(self.source),
+            "state": self.state,
+            "reason": self.reason,
+            "canary_pct": self.canary_pct,
+            "step": self.step_idx,
+            "steps": self.steps,
+            "held": self.held,
+            "shadow": {
+                "compares": self.shadow_compares,
+                "mismatches": self.shadow_mismatches,
+                "errors": self.shadow_errors,
+                "dropped": self.shadow_dropped,
+                "mismatch_buckets": dict(self.mismatch_buckets),
+                "exemplar_rid": self.last_mismatch_rid,
+                "dtype": self.dtype,
+                "tolerance": self.tolerance,
+            },
+            "signals": self.last_signals,
+            "history": list(self.history),
+        }
+
+
+class ReleaseController(Logger):
+    """At most one active release per model, judged by the live SLO
+    plane (see module docstring).  ``target`` is the deployment
+    surface (:class:`LocalTarget` for the in-process registry server,
+    the fleet router's target for a fleet); ``clock`` is injectable
+    for sleep-free tests.  :meth:`tick` is the public evaluation step;
+    :meth:`start` arms a background loop that calls it every
+    ``tick_interval_s``."""
+
+    def __init__(self, target, clock=time.monotonic):
+        super(ReleaseController, self).__init__(
+            logger_name="ReleaseController")
+        self._target = target
+        self._clock = clock
+        self._lock = locksmith.lock("serving.release")
+        self._active = {}           # model -> Release
+        self._done = {}             # model -> last terminal Release
+        self._queue = collections.deque()
+        self._queue_cond = threading.Condition()
+        self._bypass = threading.local()
+        self._stop = threading.Event()
+        self._lifecycle = threading.Lock()
+        self._tick_thread = None
+        self._shadow_thread = None
+        target.set_guard(self._guard)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        """Arm the background tick loop + shadow worker (idempotent —
+        the HTTP front end calls it on every POST /release)."""
+        with self._lifecycle:
+            if self._tick_thread is not None:
+                return self
+            self._stop.clear()
+            self._tick_thread = threading.Thread(
+                target=self._tick_loop, name="release-tick",
+                daemon=True)
+            self._shadow_thread = threading.Thread(
+                target=self._shadow_loop, name="release-shadow",
+                daemon=True)
+            self._tick_thread.start()
+            self._shadow_thread.start()
+        return self
+
+    def stop(self):
+        with self._lifecycle:
+            self._stop.set()
+            with self._queue_cond:
+                self._queue_cond.notify_all()
+            for t in (self._tick_thread, self._shadow_thread):
+                if t is not None:
+                    t.join(timeout=10)
+            self._tick_thread = self._shadow_thread = None
+
+    def _tick_loop(self):
+        while not self._stop.wait(
+                float(_rel.get("tick_interval_s", 0.25))):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 - keep judging
+                self.warning("release tick failed: %r", e)
+
+    # -- the mutation guard --------------------------------------------------
+    def _guard(self, name, action):
+        """Installed on the registry (or the router's fanout): vetoes
+        reload/add/remove of a released model or its candidate by
+        anyone but the controller itself.  ``name=None`` (a
+        default-model reload) is judged conservatively: any active
+        release vetoes it."""
+        if getattr(self._bypass, "on", False):
+            return
+        with self._lock:
+            if not self._active:
+                return
+            if name is None:
+                rel = next(iter(self._active.values()))
+            else:
+                rel = self._active.get(name)
+                if rel is None:
+                    for r in self._active.values():
+                        if r.cand_name == name:
+                            rel = r
+                            break
+            if rel is None:
+                return
+        raise ReleaseConflictError(
+            "cannot %s model %r: release of %r to %s is active "
+            "(state %s) — abort it first (DELETE /release/%s)"
+            % (action, name, rel.model, rel.cand_name, rel.state,
+               rel.model))
+
+    class _Bypass(object):
+        def __init__(self, local):
+            self._local = local
+
+        def __enter__(self):
+            self._local.on = True
+
+        def __exit__(self, *exc):
+            self._local.on = False
+
+    def _as_controller(self):
+        """Mutations the controller itself performs (deploy, promote,
+        rollback cleanup) pass the guard."""
+        return self._Bypass(self._bypass)
+
+    # -- the operator surface ------------------------------------------------
+    def start_release(self, model, source, policy=None):
+        """Deploy ``source`` as the candidate generation of ``model``
+        and enter shadow.  Raises :class:`ReleaseConflictError` when a
+        release for the model is already active, ``ValueError`` when
+        the SLO plane (the judge) is disabled or the model is
+        unknown."""
+        if not slo.enabled():
+            raise ValueError(
+                "a release is judged by the SLO plane — enable "
+                "root.common.serving.slo_enabled first")
+        with self._lock:
+            if model in self._active:
+                raise ReleaseConflictError(
+                    "a release of %r is already active (candidate "
+                    "%s, state %s)"
+                    % (model, self._active[model].cand_name,
+                       self._active[model].state))
+        live_version = self._target.live_version(model)  # may raise
+        cand = candidate_name(model, live_version)
+        with self._as_controller():
+            self._target.deploy(cand, source)
+        try:
+            dtype = self._target.serve_dtype(cand)
+        except Exception:  # noqa: BLE001 - label only
+            dtype = None
+        now = float(self._clock())
+        rel = Release(model, source, cand, policy, dtype, now)
+        rel.note("start", state=SHADOW)
+        with self._lock:
+            self._active[model] = rel
+        telemetry.record_event(
+            "release.start", model=model, candidate=cand,
+            generation=rel.generation, source=str(source),
+            dtype=dtype, steps=rel.steps)
+        self._note_state(rel)
+        self.info("release of %r started: candidate %s (dtype %s) "
+                  "shadowing", model, cand, dtype)
+        return rel.status()
+
+    def abort(self, model):
+        """Operator abort (``DELETE /release/<model>``): undeploy the
+        candidate, never touch the live generation."""
+        with self._lock:
+            rel = self._active.get(model)
+        if rel is None:
+            raise KeyError("no active release for model %r" % model)
+        self._finish(rel, ABORTED, "operator abort")
+        return rel.status()
+
+    def status(self, model=None):
+        """``GET /release[/<model>]``: active releases plus the last
+        terminal record per model."""
+        with self._lock:
+            active = {m: r.status() for m, r in self._active.items()}
+            done = {m: r.status() for m, r in self._done.items()}
+        if model is not None:
+            rel = active.get(model) or done.get(model)
+            if rel is None:
+                raise KeyError("no release record for model %r"
+                               % model)
+            return rel
+        return {"active": active, "recent": done}
+
+    def active(self):
+        with self._lock:
+            return bool(self._active)
+
+    # -- the data-plane hooks ------------------------------------------------
+    def route(self, model, rid):
+        """The canary split: the candidate name to serve this request
+        from, or None for the live generation.  Deterministic and
+        sticky per rid.  Cheap when no release is active (one dict
+        check, no lock)."""
+        if not self._active:
+            return None
+        with self._lock:
+            rel = self._resolve(model)
+            if rel is None or rel.state != CANARY:
+                return None
+            pct = rel.canary_pct
+        if pct <= 0.0:
+            return None
+        return rel.cand_name if split_point(rid) < pct else None
+
+    def mirror(self, model, rid, payload, reply):
+        """The shadow mirror: enqueue one live (request, reply) pair
+        for async compare against the candidate.  Never blocks — a
+        full queue DROPS (counted), keeping the client path flat."""
+        if not self._active:
+            return False
+        with self._lock:
+            rel = self._resolve(model)
+            if rel is None or rel.state != SHADOW:
+                return False
+            pct = float(rel.knob("shadow_sample_pct", 100.0))
+        if not _shadow_sampled(rid, pct):
+            return False
+        with self._queue_cond:
+            if len(self._queue) >= 128:
+                with self._lock:
+                    rel.shadow_dropped += 1
+                if telemetry.enabled():
+                    telemetry.counter(telemetry.labeled(
+                        "release.shadow_dropped", model=rel.model,
+                        gen=str(rel.generation))).inc()
+                return False
+            self._queue.append((rel, rid, payload, reply))
+            self._queue_cond.notify()
+        return True
+
+    def _resolve(self, model):
+        """The active release for a routed model name (None resolves
+        through the target's default model).  Caller holds the
+        lock."""
+        if model is None:
+            model = self._target.resolve_default()
+        return self._active.get(model)
+
+    # -- the shadow worker ---------------------------------------------------
+    def _shadow_loop(self):
+        while True:
+            with self._queue_cond:
+                while not self._queue and not self._stop.is_set():
+                    self._queue_cond.wait(0.5)
+                if self._stop.is_set() and not self._queue:
+                    return
+                rel, rid, payload, reply = self._queue.popleft()
+            try:
+                self._compare(rel, rid, payload, reply)
+            except Exception as e:  # noqa: BLE001 - judged, not fatal
+                with self._lock:
+                    rel.shadow_errors += 1
+                self.warning("shadow compare %s failed: %r", rid, e)
+
+    def drain_shadow(self, timeout_s=5.0):
+        """Block until the shadow queue is empty (tests + smoke acts
+        synchronize on the async mirror without sleeps)."""
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._queue_cond:
+                if not self._queue:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def _compare(self, rel, rid, payload, reply):
+        if rel.state != SHADOW:
+            return
+        try:
+            y_live = numpy.asarray(self._target.decode_reply(reply))
+            y_cand = numpy.asarray(
+                self._target.shadow_predict(rel.cand_name, payload))
+        except Exception as e:  # noqa: BLE001 - candidate fault
+            with self._lock:
+                rel.shadow_errors += 1
+            self.warning("candidate %s shadow predict %s failed: %r",
+                         rel.cand_name, rid, e)
+            return
+        stats = _delta_stats(y_live, y_cand)
+        tol = rel.tolerance
+        mismatch = stats["max_delta"] > tol["max_delta"] or \
+            (stats["flip_rate"] or 0.0) > tol["flip_rate"]
+        bucket = str(int(getattr(y_live, "shape", (0,))[0] or 0))
+        with self._lock:
+            rel.shadow_compares += 1
+            if mismatch:
+                rel.shadow_mismatches += 1
+                rel.mismatch_buckets[bucket] = \
+                    rel.mismatch_buckets.get(bucket, 0) + 1
+                rel.last_mismatch_rid = rid
+        if telemetry.enabled():
+            gen = str(rel.generation)
+            telemetry.counter(telemetry.labeled(
+                "release.shadow_compares", model=rel.model,
+                gen=gen)).inc()
+            if mismatch:
+                telemetry.counter(telemetry.labeled(
+                    "release.shadow_mismatches", model=rel.model,
+                    gen=gen)).inc()
+        if mismatch:
+            telemetry.record_event(
+                "release.shadow_mismatch", model=rel.model,
+                candidate=rel.cand_name, exemplar_rid=rid,
+                bucket=bucket,
+                max_delta=round(stats["max_delta"], 6),
+                flip_rate=stats["flip_rate"],
+                tolerance=tol)
+
+    # -- the judge -----------------------------------------------------------
+    def tick(self):
+        """One evaluation pass over every active release — advance on
+        sustained green, roll back on red.  Public + injectable-clock
+        so tests drive synthetic timelines."""
+        with self._lock:
+            rels = list(self._active.values())
+        for rel in rels:
+            try:
+                self._evaluate(rel)
+            except Exception as e:  # noqa: BLE001 - judge next tick
+                self.warning("evaluating release of %r failed: %r",
+                             rel.model, e)
+
+    def _evaluate(self, rel):
+        now = float(self._clock())
+        if rel.state == SHADOW:
+            self._evaluate_shadow(rel, now)
+        elif rel.state == CANARY:
+            self._evaluate_canary(rel, now)
+
+    def _evaluate_shadow(self, rel, now):
+        mismatch_max = int(rel.knob("shadow_mismatch_max", 0))
+        error_max = int(rel.knob("shadow_error_max", 3))
+        if not self._target.alive(rel.cand_name):
+            # the candidate died while only MIRRORED traffic touched
+            # it: live traffic was never at risk — this is a failed
+            # release, not a rollback of anything
+            self._finish(rel, FAILED,
+                         "candidate died during shadow")
+            return
+        with self._lock:
+            compares = rel.shadow_compares
+            mismatches = rel.shadow_mismatches
+            errors = rel.shadow_errors
+            exemplar = rel.last_mismatch_rid
+        if errors > error_max:
+            self._finish(rel, FAILED,
+                         "candidate errored %d times in shadow "
+                         "(max %d)" % (errors, error_max))
+            return
+        if mismatches > mismatch_max:
+            self._finish(
+                rel, ROLLED_BACK,
+                "shadow mismatch breach: %d mismatches (max %d)"
+                % (mismatches, mismatch_max),
+                signals={"shadow_mismatches": mismatches,
+                         "shadow_compares": compares,
+                         "exemplar_rid": exemplar})
+            return
+        green = compares >= int(rel.knob("shadow_min_compares", 8))
+        self._advance_on_green(rel, now, green, {
+            "shadow_compares": compares,
+            "shadow_mismatches": mismatches})
+
+    def _evaluate_canary(self, rel, now):
+        models = self._target.slo_models()
+        block = models.get(rel.cand_name) or {}
+        burn = block.get("burn_rate") or {}
+        signals = {
+            "canary_pct": rel.canary_pct,
+            "burn_fast": burn.get("fast"),
+            "burn_slow": burn.get("slow"),
+            "total": block.get("total") or 0,
+            "good_pct": block.get("good_pct"),
+            "exemplar_rid": block.get("exemplar_rid"),
+        }
+        with self._lock:
+            rel.last_signals = signals
+            mismatches = rel.shadow_mismatches
+        if mismatches > int(rel.knob("shadow_mismatch_max", 0)):
+            self._finish(rel, ROLLED_BACK,
+                         "shadow mismatch breach during canary",
+                         signals=signals)
+            return
+        if block.get("burning"):
+            # the tracker's both-windows verdict — same rule as the
+            # slo.burn page
+            self._finish(rel, ROLLED_BACK,
+                         "SLO burn breach on both windows at "
+                         "canary %.4g%%" % rel.canary_pct,
+                         signals=signals)
+            return
+        if not self._target.alive(rel.cand_name):
+            # routing already falls back to the live generation, so
+            # clients are answered — but the release is over
+            self._finish(rel, FAILED,
+                         "candidate died during canary",
+                         signals=signals)
+            return
+        step_total = (block.get("total") or 0) - rel.step_base_total
+        green = step_total >= int(rel.knob("min_requests", 12))
+        self._advance_on_green(rel, now, green, signals)
+
+    def _advance_on_green(self, rel, now, green, signals):
+        """Shared green-window bookkeeping: ``green`` must hold
+        CONTINUOUSLY for ``green_window_s`` before the release takes
+        its next step (red resets the clock)."""
+        window_s = float(rel.knob("green_window_s", 5.0))
+        with self._lock:
+            if not green:
+                rel.green_since = None
+                return
+            if rel.green_since is None:
+                rel.green_since = now
+            if now - rel.green_since < window_s:
+                return
+            if rel.held:
+                return  # pinned (bench/operator hold); judged still
+            rel.green_since = None
+            rel.step_idx += 1
+            steps = rel.steps
+            promote = rel.step_idx >= len(steps)
+            if not promote:
+                rel.state = CANARY
+                rel.step_base_total = int(
+                    (signals or {}).get("total") or 0)
+                rel.updated = now
+        if promote:
+            self._promote(rel, signals)
+            return
+        rel.note("advance", step=rel.step_idx,
+                 canary_pct=rel.canary_pct)
+        telemetry.record_event(
+            "release.advance", model=rel.model,
+            candidate=rel.cand_name, step=rel.step_idx,
+            canary_pct=rel.canary_pct, signals=signals)
+        self._note_state(rel)
+        self.info("release of %r advanced to canary step %d "
+                  "(%.4g%% of traffic)", rel.model, rel.step_idx,
+                  rel.canary_pct)
+
+    # -- terminal transitions ------------------------------------------------
+    def _promote(self, rel, signals):
+        try:
+            with self._as_controller():
+                self._target.promote(rel.model, rel.source)
+        except Exception as e:  # noqa: BLE001 - promote must not kill
+            # engine.load's contract already rolled the live model
+            # back to its previous generation — report honestly
+            self._finish(rel, ROLLED_BACK,
+                         "promote failed (%r); live generation "
+                         "untouched" % e, signals=signals)
+            return
+        self._finish(rel, PROMOTED, "all canary steps green",
+                     signals=signals)
+
+    def _finish(self, rel, state, reason, signals=None):
+        with self._lock:
+            if rel.state in TERMINAL:
+                return
+            rel.state = state
+            rel.reason = reason
+            rel.updated = float(self._clock())
+            self._active.pop(rel.model, None)
+            self._done[rel.model] = rel
+        # the candidate leaves the registry in EVERY terminal state:
+        # promoted (the live model now serves its params), rolled
+        # back, failed, aborted
+        with self._as_controller():
+            try:
+                self._target.undeploy(rel.cand_name)
+            except Exception as e:  # noqa: BLE001 - best effort
+                self.warning("undeploy of %s failed: %r",
+                             rel.cand_name, e)
+        event = {PROMOTED: "release.promote",
+                 ROLLED_BACK: "release.rollback",
+                 FAILED: "release.failed",
+                 ABORTED: "release.abort"}[state]
+        rel.note(state, reason=reason, signals=signals or {})
+        telemetry.record_event(
+            event, model=rel.model, candidate=rel.cand_name,
+            generation=rel.generation, reason=reason,
+            signals=signals or {},
+            exemplar_rid=(signals or {}).get("exemplar_rid")
+            or rel.last_mismatch_rid)
+        self._note_state(rel)
+        log = self.info if state == PROMOTED else self.warning
+        log("release of %r -> %s: %s", rel.model, state, reason)
+
+    def _note_state(self, rel):
+        if not telemetry.enabled():
+            return
+        gen = str(rel.generation)
+        telemetry.gauge(telemetry.labeled(
+            "release.state", model=rel.model, gen=gen)).set(
+                _STATE_CODE.get(rel.state, 0))
+        telemetry.gauge(telemetry.labeled(
+            "release.canary_pct", model=rel.model,
+            gen=gen)).set(rel.canary_pct)

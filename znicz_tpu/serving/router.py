@@ -82,6 +82,7 @@ serve ... --fleet N [--autoscale]`` (serving/server.py).
 
 import collections
 import http.client
+import io
 import json
 import re
 import socket
@@ -93,6 +94,8 @@ import urllib.error
 import urllib.request
 import uuid
 
+import numpy
+
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.status_server import (BodyTooLargeError,
@@ -100,6 +103,8 @@ from znicz_tpu.core.status_server import (BodyTooLargeError,
 from znicz_tpu.core import telemetry
 from znicz_tpu.core import timeseries
 from znicz_tpu.serving import reqtrace
+from znicz_tpu.serving.release import (ReleaseConflictError,
+                                       ReleaseController)
 from znicz_tpu.analysis import locksmith
 
 _cfg = root.common.serving
@@ -165,7 +170,8 @@ class _RawConn(object):
     def round_trip(self, request_bytes, timing=None):
         """Send one request; return ``(status, headers, body,
         close)`` where ``headers`` carries only Content-Type /
-        Retry-After / X-Serving-Ms.  Raises ``OSError``/``ValueError``
+        Retry-After / X-Serving-Ms / X-Serving-Generation.  Raises
+        ``OSError``/``ValueError``
         on any transport or framing failure (the caller maps it to
         the retry-safety machinery).  When ``timing`` is a dict it
         receives the ``sent`` (request fully on the socket) and
@@ -201,6 +207,9 @@ class _RawConn(object):
                     value.strip().decode("latin-1")
             elif key == b"x-serving-ms":
                 headers["X-Serving-Ms"] = \
+                    value.strip().decode("latin-1")
+            elif key == b"x-serving-generation":
+                headers["X-Serving-Generation"] = \
                     value.strip().decode("latin-1")
             elif key == b"connection" and \
                     value.strip().lower() == b"close":
@@ -345,6 +354,134 @@ class Replica(Logger):
         }
 
 
+def _decode_predict_body(data, ctype):
+    """A /predict reply body -> output ndarray (the shadow compare's
+    view): raw ``.npy`` for octet-stream replies, the ``outputs``
+    field for JSON ones."""
+    if (ctype or "").startswith("application/octet-stream") or \
+            data[:6] == b"\x93NUMPY":
+        return numpy.load(io.BytesIO(data))
+    doc = json.loads(data.decode())
+    return numpy.asarray(doc["outputs"], dtype=numpy.float64)
+
+
+class _FleetTarget(object):
+    """The release controller's deployment surface over a replica
+    fleet (serving/release.py duck type): candidates deploy by admin
+    fan-out (every UP replica, the fleet stays homogeneous), shadow
+    predicts run against one UP replica over the keep-alive pool
+    under a fresh ``shadow-`` rid (the live rid must stay unique in
+    every admitted-rid ring), and SLO reads come from the fleet
+    aggregation — burn = fleet MAX, the conservative judging view."""
+
+    def __init__(self, router):
+        self._router = router
+        self._default = None
+
+    def set_guard(self, fn):
+        self._router._release_guard = fn
+
+    def resolve_default(self):
+        # the fleet is homogeneous and its default model stable for
+        # the life of a release — cache the one /models fetch
+        if self._default is None:
+            self._default = self._router.models().get("default")
+        return self._default
+
+    def _block(self, name):
+        doc = self._router.models()
+        return (doc.get("models") or {}).get(name)
+
+    def live_version(self, model):
+        block = self._block(model)
+        if block is None:
+            raise KeyError("model %r is not served by the fleet"
+                           % model)
+        return int(block.get("model_version") or 0)
+
+    def serve_dtype(self, name):
+        return (self._block(name) or {}).get("serve_dtype")
+
+    def alive(self, name):
+        block = self._block(name)
+        return bool(block) and bool(block.get("ready"))
+
+    def _fanout(self, method, path, body):
+        results, ok = {}, True
+        for replica in self._router.replicas():
+            if replica.state != UP:
+                continue
+            try:
+                status, _, data = self._router._send_to(
+                    replica, method, path, body,
+                    {"Content-Type": "application/json"})
+                results[replica.rid] = status
+                ok = ok and status < 400
+            except (_NeverSentError, _SentUnknownError) as e:
+                results[replica.rid] = repr(e)
+                ok = False
+        return ok, results
+
+    def deploy(self, name, source):
+        ok, results = self._fanout(
+            "POST", "/models/" + name,
+            json.dumps({"path": str(source)}).encode())
+        if not ok:
+            # no half-deployed candidates: a fleet where only some
+            # replicas know the candidate would skew every signal
+            self.undeploy(name)
+            raise RuntimeError(
+                "candidate %s failed to deploy on the fleet: %s"
+                % (name, results))
+
+    def undeploy(self, name):
+        self._fanout("DELETE", "/models/" + name, b"")
+
+    def promote(self, model, source):
+        ok, results = self._fanout(
+            "POST", "/reload",
+            json.dumps({"path": str(source),
+                        "model": model}).encode())
+        if not ok:
+            # each failed replica already rolled back to its previous
+            # generation (engine.load's contract)
+            raise RuntimeError(
+                "promote reload of %r failed on the fleet: %s"
+                % (model, results))
+
+    def shadow_predict(self, name, payload):
+        body, ctype = payload
+        replica = self._router._pick()
+        if replica is None:
+            raise RuntimeError("no UP replica for shadow traffic")
+        status = None
+        try:
+            status, headers, data = self._router._send_to(
+                replica, "POST", "/predict/" + name, body,
+                {"Content-Type": ctype or "application/json",
+                 "X-Request-Id":
+                     "shadow-" + uuid.uuid4().hex[:10]})
+        finally:
+            self._router._release(
+                replica, served=(status is not None
+                                 and status < 500))
+        if status != 200:
+            raise RuntimeError(
+                "candidate %s answered %s: %s"
+                % (name, status, data[:200].decode("utf-8",
+                                                   "replace")))
+        return _decode_predict_body(data,
+                                    headers.get("Content-Type"))
+
+    @staticmethod
+    def decode_reply(reply):
+        data, ctype = reply
+        return _decode_predict_body(data, ctype)
+
+    def slo_models(self):
+        return self._router.aggregate_slo().get("models") or {}
+
+
 class FleetRouter(HttpServerBase):
     """The fleet front end (see module docstring).
 
@@ -383,6 +520,10 @@ class FleetRouter(HttpServerBase):
         self._monitor = None
         self._monitor_stop = threading.Event()
         self.autoscaler = None     # attached by serve --autoscale
+        #: progressive delivery over the fleet (serving/release.py):
+        #: created lazily on the first POST /release/<model>
+        self.release = None
+        self._release_guard = None
 
     # -- fleet membership ---------------------------------------------------
     def _spawn(self):
@@ -503,6 +644,8 @@ class FleetRouter(HttpServerBase):
             self._monitor = None
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        if self.release is not None:
+            self.release.stop()
         super(FleetRouter, self).stop()
         self.shutdown_fleet()
 
@@ -783,6 +926,17 @@ class FleetRouter(HttpServerBase):
         model = None
         if path.startswith("/predict/"):
             model = path[len("/predict/"):] or None
+        # canary split (serving/release.py): an active release may
+        # rewrite this request's path to its candidate generation —
+        # deterministic per rid, so a peer retry of the same rid
+        # lands on the same generation
+        live_model, cand = model, None
+        ctl = self.release
+        if ctl is not None and ctl.active():
+            cand = ctl.route(model, rid)
+            if cand is not None:
+                path = "/predict/" + cand
+                model = cand
         hops = []   # committed (kind, t0, t1) spans — the histograms
         if traced:
             t_route = time.monotonic()
@@ -869,15 +1023,44 @@ class FleetRouter(HttpServerBase):
                                           attempt_t0, replica,
                                           "refused_" + refusal)
                 continue
+            if cand is not None and status == 404:
+                # the candidate vanished between split and relay (a
+                # rollback removed it mid-flight).  An unknown-model
+                # 404 is pre-admission — the rid never entered a
+                # batcher — so resending on the LIVE generation is
+                # safe, and the same replica may serve it (discard it
+                # from the tried set): clients are always answered,
+                # never handed a release-plane artifact
+                path = ("/predict/" + live_model if live_model
+                        else "/predict")
+                model, cand = live_model, None
+                tried.discard(replica.rid)
+                self._note_retry(replica, rid, "candidate_gone")
+                self._note_failed_attempt(rid, traced, hops,
+                                          attempt_t0, replica,
+                                          "candidate_gone")
+                continue
             ctype = resp_headers.get("Content-Type") or \
                 "application/json"
             out_headers = dict(echo)
             if resp_headers.get("Retry-After"):
                 out_headers["Retry-After"] = \
                     resp_headers["Retry-After"]
+            if resp_headers.get("X-Serving-Generation"):
+                # per-generation reply attribution rides to the
+                # client — loadgen asserts canary splits from it
+                out_headers["X-Serving-Generation"] = \
+                    resp_headers["X-Serving-Generation"]
             if telemetry.enabled():
                 telemetry.counter("router.proxied").inc()
             _relay_reply(handler, status, ctype, data, out_headers)
+            if ctl is not None and cand is None and status == 200 \
+                    and ctl.active():
+                # shadow mirror: the client's reply is already on the
+                # wire; the compare runs on the controller's worker
+                ctl.mirror(live_model, rid,
+                           (body, fwd_headers.get("Content-Type")),
+                           (data, ctype))
             t_done = time.monotonic()
             if traced:
                 # commit the winning attempt's buffered phase spans,
@@ -949,6 +1132,24 @@ class FleetRouter(HttpServerBase):
         except ValueError as e:
             handler._send_json(400, {"error": str(e)})
             return
+        guard = self._release_guard
+        if guard is not None:
+            if path.startswith("/models/"):
+                name = path[len("/models/"):]
+            else:
+                try:
+                    name = json.loads(body.decode() or "{}") \
+                        .get("model")
+                except ValueError:
+                    name = None
+            try:
+                guard(name, method.lower() + " " + path)
+            except ReleaseConflictError as e:
+                # the model is mid-release: promote/rollback belong
+                # to the controller alone — a loud 409 beats a
+                # half-applied fleet mutation racing a canary
+                handler._send_json(409, {"error": str(e)})
+                return
         results, ok = {}, True
         for replica in self.replicas():
             if replica.state != UP:
@@ -970,6 +1171,70 @@ class FleetRouter(HttpServerBase):
                 ok = False
         handler._send_json(200 if ok else 502,
                            {"ok": ok, "replicas": results})
+
+    # -- progressive delivery (serving/release.py) --------------------------
+    def _release_controller(self):
+        """The fleet's release controller, created on first use (one
+        per router; the target fans deployments out to every UP
+        replica)."""
+        with self._lock:
+            if self.release is None:
+                self.release = ReleaseController(_FleetTarget(self))
+            return self.release
+
+    def _release_post(self, handler, name):
+        try:
+            doc = json.loads(handler._read_body().decode() or "{}")
+            source = doc["path"]
+        except ValueError as e:
+            handler._send_json(400, {"error": str(e)})
+            return
+        except KeyError:
+            handler._send_json(400, {"error": 'body needs {"path": '
+                                              '"..."}'})
+            return
+        try:
+            payload = self._release_controller().start() \
+                .start_release(name, source,
+                               policy=doc.get("policy"))
+        except ReleaseConflictError as e:
+            handler._send_json(409, {"error": str(e)})
+            return
+        except ValueError as e:
+            handler._send_json(400, {"error": str(e)})
+            return
+        except KeyError as e:
+            handler._send_json(404, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - bad candidate file
+            handler._send_json(400, {"error": repr(e)})
+            return
+        handler._send_json(200, payload)
+
+    def _release_get(self, handler, name=None):
+        if self.release is None:
+            if name is None:
+                handler._send_json(200, {"active": {},
+                                         "recent": {}})
+            else:
+                handler._send_json(404, {
+                    "error": "no release record for model %r"
+                             % name})
+            return
+        try:
+            handler._send_json(200, self.release.status(name))
+        except KeyError as e:
+            handler._send_json(404, {"error": str(e)})
+
+    def _release_delete(self, handler, name):
+        if self.release is None:
+            handler._send_json(404, {
+                "error": "no active release for model %r" % name})
+            return
+        try:
+            handler._send_json(200, self.release.abort(name))
+        except KeyError as e:
+            handler._send_json(404, {"error": str(e)})
 
     # -- aggregation --------------------------------------------------------
     def _fetch(self, replica, path, timeout=10):
@@ -1224,6 +1489,11 @@ class FleetRouter(HttpServerBase):
                     self._send_json(200, router.aggregate_slo())
                 elif path == "/models":
                     self._send_json(200, router.models())
+                elif path == "/release":
+                    router._release_get(self)
+                elif path.startswith("/release/"):
+                    router._release_get(
+                        self, path[len("/release/"):])
                 elif path in ("/", "/statusz"):
                     self._send_json(200, router.statusz())
                 elif path == "/debug/timeseries":
@@ -1275,6 +1545,9 @@ class FleetRouter(HttpServerBase):
                 elif path == "/reload" or \
                         path.startswith("/models/"):
                     router._admin_fanout(self, "POST", path)
+                elif path.startswith("/release/"):
+                    router._release_post(self,
+                                         path[len("/release/"):])
                 else:
                     self._drain_body()
                     self._send_json(404, {"error": "not found"})
@@ -1283,6 +1556,10 @@ class FleetRouter(HttpServerBase):
                 path = self.path.partition("?")[0]
                 if path.startswith("/models/"):
                     router._admin_fanout(self, "DELETE", path)
+                elif path.startswith("/release/"):
+                    self._drain_body()
+                    router._release_delete(
+                        self, path[len("/release/"):])
                 else:
                     self._drain_body()
                     self._send_json(404, {"error": "not found"})

@@ -113,6 +113,10 @@ from znicz_tpu.serving.breaker import CircuitOpenError
 from znicz_tpu.serving.continuous import normalize_priority
 from znicz_tpu.serving.engine import InferenceEngine
 from znicz_tpu.serving.registry import ModelRegistry, UnknownModelError
+from znicz_tpu.serving.release import (LocalTarget,
+                                       ReleaseConflictError,
+                                       ReleaseController,
+                                       generation_label)
 
 
 class ServingServer(HttpServerBase):
@@ -160,9 +164,19 @@ class ServingServer(HttpServerBase):
         #: error budgets — fed by _predict behind the slo.enabled()
         #: gate, served at GET /slo and the /statusz slo block
         self.slo = slo.SloTracker()
+        #: progressive-delivery controller (serving/release.py):
+        #: canary split + shadow mirror over this registry, operated
+        #: at POST/GET/DELETE /release/<model>.  Registry mode only;
+        #: its background threads arm on the first release.
+        self.release = None
+        if registry is not None:
+            self.release = ReleaseController(
+                LocalTarget(registry, self.slo))
 
     def stop(self):
         super(ServingServer, self).stop()
+        if self.release is not None:
+            self.release.stop()
         if self._owns_batcher:
             self.batcher.stop()
 
@@ -351,9 +365,28 @@ class ServingServer(HttpServerBase):
             return 400, model
         # the URL path segment wins over the body's "model" field
         model = model if model is not None else body_model
-        slo_model = model
+        # canary split (serving/release.py): an active release may
+        # rewrite the routed name to its candidate — deterministic
+        # per rid, so a retry lands on the same generation, and the
+        # candidate's SLO/metrics/lanes attribute to its own name
+        routed = model
+        ctl = self.release
+        if ctl is not None and ctl.active():
+            cand = ctl.route(model, rid)
+            if cand is not None:
+                routed = cand
+        slo_model = routed
         try:
-            engine = self._engine_for(model)
+            try:
+                engine = self._engine_for(routed)
+            except UnknownModelError:
+                if routed is model:
+                    raise
+                # the candidate vanished between split and resolution
+                # (a rollback just removed it): fall back to the live
+                # generation — clients are always answered
+                routed = slo_model = model
+                engine = self._engine_for(model)
             if slo_model is None and self.registry is not None:
                 # the default model carries its real name in the SLO
                 # accounting — budgets are per model, not per route
@@ -383,7 +416,7 @@ class ServingServer(HttpServerBase):
                 reqtrace.add_span(rid, "admission", t_admit,
                                   time.monotonic())
             if self._routed_batcher:
-                y = self.batcher.predict(x, model=model,
+                y = self.batcher.predict(x, model=routed,
                                          timeout_ms=timeout_ms,
                                          request_id=rid,
                                          priority=priority)
@@ -443,7 +476,12 @@ class ServingServer(HttpServerBase):
         # surface in the fleet /slo and /statusz (what remains is the
         # hop: relay framing, sockets, and this reply's serialization)
         ok_headers = dict(echo, **{
-            "X-Serving-Ms": "%.3f" % ((t_reply - t_admit) * 1e3)})
+            "X-Serving-Ms": "%.3f" % ((t_reply - t_admit) * 1e3),
+            # which generation answered: a canary candidate pins its
+            # encoded generation, the live model its engine version —
+            # loadgen asserts canary split percentages from this
+            "X-Serving-Generation": generation_label(slo_model or "",
+                                                     engine.version)})
         if raw:
             buf = io.BytesIO()
             numpy.save(buf, numpy.ascontiguousarray(y))
@@ -461,6 +499,11 @@ class ServingServer(HttpServerBase):
         if traced:
             # reply span: future resolved -> response bytes written
             reqtrace.add_span(rid, "reply", t_reply, time.monotonic())
+        if ctl is not None and routed is model and ctl.active():
+            # shadow mirror (serving/release.py): the client's reply
+            # is already on the wire — the candidate compare happens
+            # on the controller's worker thread, never here
+            ctl.mirror(slo_model, rid, x, y)
         return 200, slo_model
 
     def _reload(self, handler, model=None):
@@ -484,6 +527,11 @@ class ServingServer(HttpServerBase):
                 version = engine.load(path)
         except UnknownModelError as e:
             handler._send_json(404, {"error": str(e)})
+            return
+        except ReleaseConflictError as e:
+            # the model is mid-release: promote/rollback belong to
+            # the controller alone — a loud 409, never a silent race
+            handler._send_json(409, {"error": str(e)})
             return
         except Exception as e:  # noqa: BLE001 - bad model file
             # a failed (re)load rolled back scoped to this one model —
@@ -523,12 +571,72 @@ class ServingServer(HttpServerBase):
                 kwargs[key] = doc[key]
         try:
             version = self.registry.add(name, path, **kwargs)
+        except ReleaseConflictError as e:
+            handler._send_json(409, {"error": str(e)})
+            return
         except Exception as e:  # noqa: BLE001 - bad model file/name
             handler._send_json(400, {"error": repr(e)})
             return
         handler._send_json(200, {
             "model": name, "model_version": version, "source": path,
             "models": self.registry.names()})
+
+    # -- progressive delivery (serving/release.py) --------------------------
+    def _release_post(self, handler, name):
+        """POST /release/<model>: ``{"path": ..., "policy": {...}}``
+        deploys the candidate generation and starts the shadow ->
+        canary -> promote state machine."""
+        if self.release is None:
+            handler._drain_body()
+            handler._send_json(400, {
+                "error": "releases need a model registry — start the "
+                         "server with NAME=PATH model specs"})
+            return
+        try:
+            doc = json.loads(handler._read_body().decode() or "{}")
+            path = doc["path"]
+        except BodyTooLargeError as e:
+            handler._send_json(413, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - client error
+            handler._send_json(400, {"error": 'body needs {"path": '
+                                              '"..."} (%r)' % e})
+            return
+        try:
+            payload = self.release.start().start_release(
+                name, path, policy=doc.get("policy"))
+        except ReleaseConflictError as e:
+            handler._send_json(409, {"error": str(e)})
+            return
+        except UnknownModelError as e:
+            handler._send_json(404, {"error": str(e)})
+            return
+        except ValueError as e:
+            handler._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - bad candidate file
+            handler._send_json(400, {"error": repr(e)})
+            return
+        handler._send_json(200, payload)
+
+    def _release_get(self, handler, name=None):
+        if self.release is None:
+            handler._send_json(200, {"active": {}, "recent": {}})
+            return
+        try:
+            handler._send_json(200, self.release.status(name))
+        except KeyError as e:
+            handler._send_json(404, {"error": str(e)})
+
+    def _release_delete(self, handler, name):
+        if self.release is None:
+            handler._send_json(404, {"error": "no release plane "
+                                              "(single-engine mode)"})
+            return
+        try:
+            handler._send_json(200, self.release.abort(name))
+        except KeyError as e:
+            handler._send_json(404, {"error": str(e)})
 
     def _admin_remove(self, handler, name):
         if self.registry is None:
@@ -539,6 +647,9 @@ class ServingServer(HttpServerBase):
             self.registry.remove(name)
         except UnknownModelError as e:
             handler._send_json(404, {"error": str(e)})
+            return
+        except ReleaseConflictError as e:
+            handler._send_json(409, {"error": str(e)})
             return
         handler._send_json(200, {"removed": name,
                                  "models": self.registry.names()})
@@ -600,6 +711,11 @@ class ServingServer(HttpServerBase):
                     # the error-budget feed (serving/slo.py) — the
                     # payload the ROADMAP item-2 autoscaler consumes
                     self._send_json(200, server.slo.status())
+                elif path == "/release":
+                    server._release_get(self)
+                elif path.startswith("/release/"):
+                    server._release_get(
+                        self, path[len("/release/"):])
                 elif path in ("/", "/statusz"):
                     self._send_json(200, server.statusz())
                 elif self._handle_debug():
@@ -617,6 +733,9 @@ class ServingServer(HttpServerBase):
                     server._reload(self)
                 elif path.startswith("/models/"):
                     server._admin_add(self, path[len("/models/"):])
+                elif path.startswith("/release/"):
+                    server._release_post(self,
+                                         path[len("/release/"):])
                 else:
                     self._drain_body()  # keep-alive hygiene
                     self._send_json(404, {"error": "not found"})
@@ -626,6 +745,10 @@ class ServingServer(HttpServerBase):
                 if path.startswith("/models/"):
                     self._drain_body()
                     server._admin_remove(self, path[len("/models/"):])
+                elif path.startswith("/release/"):
+                    self._drain_body()
+                    server._release_delete(
+                        self, path[len("/release/"):])
                 else:
                     self._drain_body()
                     self._send_json(404, {"error": "not found"})
