@@ -541,6 +541,10 @@ def main(profile_dir=None):
     # 100% sampling vs the same armed fleet without one — gated
     # inverted so progressive delivery stays affordable
     _stamp_serving_release_shadow(out)
+    # continuous-profiler cost ledger (ISSUE 18): armed 97 Hz sampler
+    # vs disabled on the same HTTP mix (overhead gated inverted) +
+    # the measured Python data-plane tax (stamped-nonzero in CI)
+    _stamp_serving_pyprof(out)
     prec = out.get("serving_precision", {}).get("dtypes")
     if prec and isinstance(out.get("roofline"), dict):
         # the roofline block grows the per-dtype serving axis: where
@@ -1045,6 +1049,7 @@ def _serving_fleet_block(seed=7, max_batch=32, measure_s=4.0):
         # to their own pipes inside the router process)
         import threading
         threading.Thread(target=proc.stdout.read,
+                         name="znicz:bench-stdout-drain",
                          daemon=True).start()
         models = loadgen.discover_models(url)
         pool = loadgen.DaemonPool(256)
@@ -1201,6 +1206,7 @@ def _serving_fleet_observability_block(seed=11, max_batch=32,
                     raise RuntimeError(
                         "serve --fleet never printed its URL")
                 threading.Thread(target=proc.stdout.read,
+                                 name="znicz:bench-stdout-drain",
                                  daemon=True).start()
                 models = loadgen.discover_models(url)
                 pool = loadgen.DaemonPool(128)
@@ -1358,6 +1364,7 @@ def _serving_release_shadow_block(seed=13, max_batch=32,
                     raise RuntimeError(
                         "serve --fleet never printed its URL")
                 threading.Thread(target=proc.stdout.read,
+                                 name="znicz:bench-stdout-drain",
                                  daemon=True).start()
 
                 def call(path, doc=None, method=None):
@@ -1799,6 +1806,7 @@ def _serving_observability_block(duration=2.0, clients=8,
                 stop.set()
 
         threads = [threading.Thread(target=client, args=(k,),
+                                    name="znicz:bench-client-%d" % k,
                                     daemon=True)
                    for k in range(clients)]
         t0 = time.perf_counter()
@@ -1879,6 +1887,161 @@ def _stamp_serving_observability(out):
     block = out["serving_observability"]
     out["serving_observability_overhead_pct"] = (
         block.get("overhead_pct") or 0.0)
+
+
+def _serving_pyprof_block(duration=2.0, clients=8, max_batch=8):
+    """The continuous-profiler cost ledger (ISSUE 18): the SAME
+    closed-loop HTTP mix against one registry server twice — first
+    with the sampler DISABLED (its shipped default), then ARMED at
+    its stock 97 Hz — and, from the armed window's phase aggregates,
+    the first continuously-measured Python data-plane tax:
+
+    * ``overhead_pct`` — the armed-vs-disabled goodput delta, the
+      PR 14 methodology (one server/engine both laps, warm lap
+      first); floored at 1.0 for the stamp because tools/bench_gate
+      treats zero as the crash-guard sentinel, raw rides along;
+    * ``dataplane_python_pct`` — the share of non-idle samples
+      (everything but ``lock_wait``: a parked worker awaiting a
+      batch slot is capacity, not cost) spent in the Python
+      codec/relay phases (``json_decode``/``npy_decode``/
+      ``serialize``/``socket_io``).  The closed-loop clients run in
+      process, so this is the END-TO-END per-request tax — client
+      codec + server codec + socket relay — exactly the ledger
+      ROADMAP item 3's zero-copy rewrite must measurably beat."""
+    import threading
+    import urllib.request
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core import pyprof, telemetry
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+
+    telemetry.reset()
+    pyprof.reset()
+    # the bench driver's own main thread shows up in every sweep
+    # (it sleeps out the lap windows) — adopt the registry name so
+    # the ledger attributes it instead of diluting attributed_pct
+    pyprof.name_current_thread("bench-main")
+    root.common.telemetry.enabled = True
+    sources = _loadgen_models(max_batch)
+    registry = ModelRegistry(models=sources, max_batch=max_batch)
+    server = ServingServer(registry=registry).start()
+    url = "http://127.0.0.1:%d" % server.port
+    names = sorted(sources)
+    r = numpy.random.RandomState(5)
+    bodies = {}
+    for name in names:
+        n_in = sources[name][0]["input_sample_shape"][0]
+        bodies[name] = [
+            json.dumps({"inputs": r.uniform(
+                -1, 1, (1 + i % max_batch, n_in)).tolist()}).encode()
+            for i in range(4)]
+
+    def lap(seconds):
+        stop = threading.Event()
+        done = [0] * clients
+        errors = []
+
+        def client(k):
+            i = k
+            try:
+                while not stop.is_set():
+                    name = names[i % len(names)]
+                    req = urllib.request.Request(
+                        url + "/predict/" + name,
+                        bodies[name][i % len(bodies[name])],
+                        {"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req,
+                                                timeout=60) as resp:
+                        resp.read()
+                        assert resp.status == 200
+                    done[k] += 1
+                    i += 1
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                errors.append(repr(e))
+                stop.set()
+
+        threads = [threading.Thread(target=client, args=(k,),
+                                    name="znicz:bench-client-%d" % k,
+                                    daemon=True)
+                   for k in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            # a dead client thread would skew both the rps delta and
+            # the phase mix — fail the block loudly instead
+            raise RuntimeError(
+                "pyprof lap lost %d client(s): %s"
+                % (len(errors), errors[:3]))
+        return done, time.perf_counter() - t0
+
+    saved = bool(root.common.profiler.pyprof.get("enabled", False))
+    try:
+        lap(0.4)  # warm: dispatch paths hot before either timed lap
+        done_off, wall_off = lap(duration)
+        pyprof.enable()
+        pyprof.maybe_start()
+        before = pyprof.snapshot()
+        done_on, wall_on = lap(duration)
+        window = pyprof.diff_snapshots(before, pyprof.snapshot())
+    finally:
+        root.common.profiler.pyprof.enabled = saved
+        pyprof.reset()   # stops the sampler, drops the aggregates
+        server.stop()
+    rps_off = sum(done_off) / wall_off
+    rps_on = sum(done_on) / wall_on
+    raw = (1.0 - rps_on / max(rps_off, 1e-9)) * 100.0
+    phases = window.get("phases") or {}
+    samples = int(window.get("samples", 0))
+    active = max(1, samples - int(phases.get("lock_wait", 0)))
+    dataplane = 100.0 * sum(
+        int(phases.get(p, 0)) for p in pyprof.DATAPLANE_PHASES) \
+        / active
+    return {
+        "clients": clients,
+        "duration_s": duration,
+        "disabled_requests_per_sec": round(rps_off, 1),
+        "armed_requests_per_sec": round(rps_on, 1),
+        "overhead_pct_raw": round(raw, 2),
+        "overhead_pct": round(max(raw, 1.0), 2),
+        "dataplane_python_pct": round(dataplane, 2),
+        # proof the armed lap actually sampled (a knob that silently
+        # failed to arm would stamp a flattering zero) + the per-
+        # phase/per-component breakdown BENCH_NOTES records as the
+        # ROADMAP item-3 baseline
+        "armed_pyprof_samples": samples,
+        "active_samples": active,
+        "attributed_pct": window.get("attributed_pct", 0.0),
+        "phases": phases,
+        "components": window.get("components") or {},
+        "gil_wait_ms": (window.get("gil") or {}).get("wait_ms", 0.0),
+        "sampler_self_pct": (window.get("overhead")
+                             or {}).get("pct", 0.0),
+    }
+
+
+def _stamp_serving_pyprof(out):
+    """Stamp the continuous-profiler cost-ledger block + the two flat
+    keys (crash-guarded ZERO stamps): ``serving_pyprof_overhead_pct``
+    is gated INVERTED by tools/bench_gate.py (the sampler's tax must
+    stay bounded); ``serving_dataplane_python_pct`` is deliberately
+    NOT gated directionally — driving it DOWN is ROADMAP item 3's
+    goal, so a band gate would punish the improvement — but CI
+    asserts it stamps nonzero (a zero means the sampler armed and saw
+    no data plane: broken).  Shared by main(), main_serving() and the
+    ``--serving-pyprof`` CI entry."""
+    try:
+        out["serving_pyprof"] = _serving_pyprof_block()
+    except Exception as e:  # noqa: BLE001 - never kill the primary
+        out["serving_pyprof"] = {"error": repr(e)}
+    block = out["serving_pyprof"]
+    out["serving_pyprof_overhead_pct"] = (
+        block.get("overhead_pct") or 0.0)
+    out["serving_dataplane_python_pct"] = (
+        block.get("dataplane_python_pct") or 0.0)
 
 
 def _stamp_serving_precision(out, peaks):
@@ -1963,7 +2126,9 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
             rows[k] += len(x)
             i += 1
 
-    threads = [threading.Thread(target=client, args=(k,), daemon=True)
+    threads = [threading.Thread(target=client, args=(k,),
+                                name="znicz:bench-client-%d" % k,
+                                daemon=True)
                for k in range(clients)]
     t0 = time.perf_counter()
     for t in threads:
@@ -2022,6 +2187,9 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
     # ISSUE 17: the shadow-mirroring tax block — same stamps as the
     # main bench
     _stamp_serving_release_shadow(out)
+    # ISSUE 18: the continuous-profiler cost ledger — same stamps as
+    # the main bench
+    _stamp_serving_pyprof(out)
     print(json.dumps(out))
 
 
@@ -2067,6 +2235,20 @@ def main_serving_obs():
     print(json.dumps(out))
 
 
+def main_serving_pyprof():
+    """``--serving-pyprof``: ONLY the continuous-profiler cost-ledger
+    block + its two flat keys, as one JSON line — the CPU-feasible CI
+    entry (tools/ci.sh pipes it through ``bench_gate --assert-stamped
+    serving_pyprof_overhead_pct,serving_dataplane_python_pct`` so a
+    sampler that broke, stopped arming, or stopped seeing the data
+    plane fails the gate)."""
+    from znicz_tpu.core import telemetry
+    telemetry.reset()
+    out = {"metric": "serving_pyprof"}
+    _stamp_serving_pyprof(out)
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     import sys
     if "--mesh" in sys.argv:
@@ -2089,6 +2271,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--serving-obs" in sys.argv:
         main_serving_obs()
+        sys.exit(0)
+    if "--serving-pyprof" in sys.argv:
+        main_serving_pyprof()
         sys.exit(0)
     if "--serving" in sys.argv:
         kwargs = {}

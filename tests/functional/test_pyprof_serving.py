@@ -1,0 +1,286 @@
+"""The continuous profiling plane over real HTTP (ISSUE 18).
+
+The acceptance loop: an ARMED server under concurrent JSON traffic
+attributes its samples to named ``znicz:*`` components (http-handler,
+continuous batcher) with the ``json_decode`` phase provably nonzero
+under large bodies; ``GET /debug/pyprof`` captures a window and 409s
+while another debug capture holds the shared guard; the router's
+endpoint merges a 2-replica fleet with per-source sample counts that
+SUM; and the disabled-by-default path starts zero sampler threads,
+allocates no state (monkeypatch-boom pinned), and answers
+``enabled: false``."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import pyprof, telemetry
+from znicz_tpu.serving import ModelRegistry, ServingServer
+from znicz_tpu.serving.router import FleetRouter
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENV = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+
+#: wide inputs so one request body is ~0.7 MB of JSON — the decode
+#: has to occupy enough wall time for a 97 Hz sampler to catch it
+#: (tiny wine-sized bodies decode between two sweeps and the phase
+#: reads 0).  4 clients x 48 rows stays under the default 256-row
+#: queue_limit so no client ever sees a 429.
+WIDTH = 784
+ROWS = 48
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Telemetry + the sampler armed; aggregates wiped both sides."""
+    monkeypatch.setattr(root.common.telemetry, "enabled", True)
+    monkeypatch.setattr(root.common.profiler.pyprof, "enabled", True)
+    telemetry.reset()
+    pyprof.reset()
+    yield
+    pyprof.reset()
+    telemetry.reset()
+
+
+def _model_source(seed=7, n_in=WIDTH, n_hidden=16, n_out=4):
+    r = numpy.random.RandomState(seed)
+    manifest = {
+        "format": 1,
+        "layers": [
+            {"type": "all2all_tanh", "name": "fc0",
+             "arrays": {"weights": "w0.npy", "bias": "b0.npy"},
+             "include_bias": True, "weights_transposed": True},
+            {"type": "softmax", "name": "out",
+             "arrays": {"weights": "w1.npy", "bias": "b1.npy"},
+             "include_bias": True, "weights_transposed": True},
+        ],
+        "input_sample_shape": [n_in],
+    }
+    arrays = {
+        "w0.npy": r.randn(n_in, n_hidden).astype(numpy.float32),
+        "b0.npy": numpy.zeros(n_hidden, numpy.float32),
+        "w1.npy": r.randn(n_hidden, n_out).astype(numpy.float32),
+        "b1.npy": numpy.zeros(n_out, numpy.float32),
+    }
+    return manifest, arrays
+
+
+def _serve():
+    registry = ModelRegistry(models={"m": _model_source()},
+                             max_batch=ROWS)
+    server = ServingServer(registry=registry).start()
+    return server, "http://127.0.0.1:%d" % server.port
+
+
+def _big_body(seed):
+    x = numpy.random.RandomState(seed).uniform(-1, 1, (ROWS, WIDTH))
+    return json.dumps({"inputs": x.tolist()}).encode()
+
+
+def _predict_raw(url, body, timeout=60):
+    req = urllib.request.Request(
+        url + "/predict/m", body, {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, path, timeout=60):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _traffic(url, seconds, n_clients=4, prefix="test-client"):
+    """Closed-loop JSON clients on NAMED threads for ``seconds``;
+    returns (ok_count, errors) — errors fail the caller loudly."""
+    stop = time.monotonic() + seconds
+    ok = [0] * n_clients
+    errors = []
+
+    def run(i):
+        body = _big_body(100 + i)
+        while time.monotonic() < stop:
+            try:
+                code, _ = _predict_raw(url, body)
+                assert code == 200
+                ok[i] += 1
+            except Exception as e:  # noqa: BLE001 - collected
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(
+        target=run, args=(i,), daemon=True,
+        name=pyprof.thread_name("%s-%d" % (prefix, i)))
+        for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 60)
+    return sum(ok), errors
+
+
+def test_armed_server_attributes_components_and_phases(armed):
+    """THE acceptance pin: under concurrent large-JSON traffic the
+    window profile names the serving components and the json_decode
+    phase is live — the Python data-plane tax is measured, not
+    guessed."""
+    server, url = _serve()
+    try:
+        assert pyprof.running()    # HttpServerBase.start armed it
+        pyprof.name_current_thread("pytest-main")
+        _predict_raw(url, _big_body(0))    # compile outside window
+        before = pyprof.snapshot()
+        n_ok, errors = _traffic(url, seconds=2.0)
+        win = pyprof.diff_snapshots(before, pyprof.snapshot())
+        assert not errors, errors
+        assert n_ok > 0
+        assert win["samples"] > 0 and win["sweeps"] > 0
+        comps = win["components"]
+        assert comps.get("http-handler", 0) > 0, comps
+        assert comps.get("continuous", 0) > 0, comps
+        assert comps.get("test-client", 0) > 0, comps
+        # ~1 MB bodies: the decoder is provably on-CPU long enough
+        assert win["phases"].get("json_decode", 0) > 0, win["phases"]
+        dataplane = sum(win["phases"].get(p, 0)
+                        for p in pyprof.DATAPLANE_PHASES)
+        assert dataplane > 0
+        # every stack key carries its component as the root frame
+        assert all(";" in k for k in win["stacks"])
+        assert win["attributed_pct"] >= 90.0, comps
+    finally:
+        server.stop()
+
+
+def test_debug_pyprof_endpoint_formats_and_shared_guard(armed):
+    """GET /debug/pyprof serves the window in all three formats, and
+    the SHARED debug-capture guard 409s a second reader — for both
+    /debug/pyprof and the PR 4 /debug/profile (the drive-by fix)."""
+    server, url = _serve()
+    try:
+        code, prof = _get(url, "/debug/pyprof?seconds=0.3")
+        assert code == 200
+        assert prof["enabled"] is True
+        assert prof["seconds"] == 0.3
+        assert prof["pid"] == os.getpid()
+
+        held = []
+
+        def long_capture():
+            held.append(_get(url, "/debug/pyprof?seconds=2"))
+
+        t = threading.Thread(
+            target=long_capture, daemon=True,
+            name=pyprof.thread_name("test-capture"))
+        t.start()
+        time.sleep(0.5)    # the long capture holds the guard now
+        for path in ("/debug/pyprof?seconds=0.1", "/debug/profile"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(url, path)
+            assert err.value.code == 409, path
+            body = json.loads(err.value.read())
+            assert "capture" in body["error"], body
+        t.join(timeout=30)
+        assert held and held[0][0] == 200
+
+        # the rendered formats, after some sampled traffic
+        _traffic(url, seconds=0.5, n_clients=2)
+        code, doc = _get(url,
+                         "/debug/pyprof?seconds=0.2&format=speedscope")
+        assert code == 200
+        assert doc["$schema"].startswith("https://www.speedscope")
+        req = urllib.request.Request(
+            url + "/debug/pyprof?seconds=0.2&format=collapsed")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain")
+            text = resp.read().decode()
+        for line in text.strip().splitlines():
+            stack, count = line.rsplit(" ", 1)
+            assert int(count) > 0 and stack
+    finally:
+        server.stop()
+
+
+def test_fleet_merge_sums_replica_samples(armed, tmp_path):
+    """The router's /debug/pyprof is the fleet view: three sources
+    (router + both replicas) whose per-source counts SUM to the
+    merged total, serving components attributed across processes."""
+    from znicz_tpu.testing import build_fc_package_zip
+    zip_path = build_fc_package_zip(
+        str(tmp_path / "synth.zip"), [20, 64, 4], seed=42)
+    router = FleetRouter(
+        ["m=" + zip_path, "--max-batch", "8",
+         "--config", "common.profiler.pyprof.enabled=True"],
+        replicas=2, compile_cache_dir=str(tmp_path / "cache"),
+        env=ENV).start()
+    url = "http://127.0.0.1:%d" % router.port
+    try:
+        pyprof.maybe_start()   # the router process's own sampler
+        pyprof.name_current_thread("pytest-main")
+        body = json.dumps({"inputs": numpy.random.RandomState(1)
+                           .uniform(-1, 1, (4, 20)).tolist()}).encode()
+        stop = time.monotonic() + 3.0
+        errors = []
+
+        def run():
+            while time.monotonic() < stop:
+                try:
+                    assert _predict_raw(url, body)[0] == 200
+                except Exception as e:  # noqa: BLE001 - collected
+                    errors.append(repr(e))
+                    return
+
+        threads = [threading.Thread(
+            target=run, daemon=True,
+            name=pyprof.thread_name("test-client-%d" % i))
+            for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        code, prof = _get(url, "/debug/pyprof?seconds=1.5")
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert code == 200
+        assert prof["merged"] is True and prof["enabled"] is True
+        up = {r.rid for r in router.replicas() if r.state == "up"}
+        assert set(prof["sources"]) == up | {"router"}
+        assert prof["samples"] == sum(prof["sources"].values()) > 0
+        for rid in up:
+            assert prof["sources"][rid] > 0, prof["sources"]
+        comps = prof["components"]
+        assert comps.get("http-handler", 0) > 0, comps
+        assert comps.get("continuous", 0) > 0, comps
+    finally:
+        router.stop()
+
+
+def test_disabled_default_starts_nothing(monkeypatch):
+    """The shipped default: server start + traffic allocate NO
+    profiler state, spawn NO sampler thread, and the endpoint answers
+    enabled:false — the zero-overhead-off contract over real HTTP."""
+    monkeypatch.setattr(root.common.profiler.pyprof, "enabled", False)
+    pyprof.reset()
+
+    def boom(*a, **k):
+        raise AssertionError("disabled profiler allocated state")
+
+    monkeypatch.setattr(pyprof, "_ensure_state", boom)
+    server, url = _serve()
+    try:
+        assert _predict_raw(url, _big_body(9))[0] == 200
+        assert pyprof.running() is False
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("znicz:pyprof")]
+        code, prof = _get(url, "/debug/pyprof?seconds=0.1")
+        assert code == 200
+        assert prof == {"enabled": False}
+    finally:
+        server.stop()

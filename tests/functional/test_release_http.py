@@ -76,7 +76,13 @@ def _x(seed, rows=2):
 @pytest.fixture
 def served(tmp_path):
     saved = root.common.serving.slo_enabled
+    saved_tick = root.common.serving.release.tick_interval_s
     root.common.serving.slo_enabled = True
+    # park the background tick loop: the in-process tests advance the
+    # ladder with MANUAL ctl.tick() calls (the FAST policy's zeroed
+    # green windows), and on a loaded machine the 0.25 s background
+    # tick can otherwise promote mid-assertion
+    root.common.serving.release.tick_interval_s = 3600.0
     telemetry.enable()
     telemetry.reset()
     registry = ModelRegistry(max_batch=8)
@@ -88,6 +94,7 @@ def served(tmp_path):
     finally:
         server.stop()
         root.common.serving.slo_enabled = saved
+        root.common.serving.release.tick_interval_s = saved_tick
 
 
 def test_zero_touch_release_over_http(served):

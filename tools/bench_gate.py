@@ -120,7 +120,24 @@ GATED_INVERSE = ("serving_loadgen_p99_ms",
                  # fleet without one, same floored-at-1.0 honest-zero
                  # rule — progressive delivery getting expensive
                  # fails the round like a latency regression
-                 "serving_release_shadow_overhead_pct")
+                 "serving_release_shadow_overhead_pct",
+                 # the continuous Python profiler's goodput tax
+                 # (ISSUE 18): armed 97 Hz sampler vs disabled on the
+                 # same HTTP mix, same floored-at-1.0 honest-zero
+                 # rule.  Its sibling serving_dataplane_python_pct is
+                 # deliberately NOT band-gated — driving the Python
+                 # tax DOWN is ROADMAP item 3's goal, a directional
+                 # gate would punish the improvement — so CI pins it
+                 # with --assert-stamped instead (nonzero or fail)
+                 "serving_pyprof_overhead_pct")
+
+
+def check_stamped(new, keys):
+    """The ``--assert-stamped`` core, factored out so the selftest
+    proves the SAME code path CI runs: the keys whose value in
+    ``new`` is zero or missing (bench.py's crash-guard stamp) — any
+    entry here fails the gate."""
+    return [k for k in keys if not new.get(k)]
 
 
 def _payload(doc):
@@ -359,6 +376,34 @@ def selftest(threshold=0.10):
         dict(rs_old, serving_release_shadow_overhead_pct=4.0 *
              (1.0 + threshold)),
         rs_old, threshold)
+    # the continuous-profiler gates (ISSUE 18): the sampler's goodput
+    # tax is inverted-gated (rise and crash-guard zero both fail,
+    # wobble passes), and the data-plane ledger is pinned by the
+    # --assert-stamped path — a zero serving_dataplane_python_pct
+    # stamp (the sampler armed but saw no data plane: broken) must be
+    # reported as missing by the same check_stamped() CI runs
+    pp_old = {"serving_pyprof_overhead_pct": 2.4}
+    pp_rise, _ = compare(
+        dict(pp_old, serving_pyprof_overhead_pct=2.4 *
+             (1.0 + 2 * threshold) * 2.0),
+        pp_old, threshold)
+    pp_zero, _ = compare(
+        dict(pp_old, serving_pyprof_overhead_pct=0.0),
+        pp_old, threshold)
+    pp_wobble, _ = compare(
+        dict(pp_old, serving_pyprof_overhead_pct=2.4 *
+             (1.0 + threshold)),
+        pp_old, threshold)
+    pp_keys = ("serving_pyprof_overhead_pct",
+               "serving_dataplane_python_pct")
+    pp_stamp_zero = check_stamped(
+        {"serving_pyprof_overhead_pct": 2.4,
+         "serving_dataplane_python_pct": 0.0}, pp_keys)
+    pp_stamp_gone = check_stamped(
+        {"serving_pyprof_overhead_pct": 2.4}, pp_keys)
+    pp_stamp_ok = check_stamped(
+        {"serving_pyprof_overhead_pct": 2.4,
+         "serving_dataplane_python_pct": 61.0}, pp_keys)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
             or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
@@ -367,7 +412,10 @@ def selftest(threshold=0.10):
             or ob_rise or ob_zero or not ob_wobble \
             or fo_rise or fo_zero or hop_rise or hop_zero \
             or not fo_wobble \
-            or rs_rise or rs_zero or not rs_wobble:
+            or rs_rise or rs_zero or not rs_wobble \
+            or pp_rise or pp_zero or not pp_wobble \
+            or not pp_stamp_zero or not pp_stamp_gone \
+            or pp_stamp_ok:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
@@ -385,7 +433,12 @@ def selftest(threshold=0.10):
               "fleet_obs_wobble_passed=%s "
               "release_shadow_rise_rejected=%s "
               "release_shadow_zero_rejected=%s "
-              "release_shadow_wobble_passed=%s"
+              "release_shadow_wobble_passed=%s "
+              "pyprof_rise_rejected=%s pyprof_zero_rejected=%s "
+              "pyprof_wobble_passed=%s "
+              "dataplane_zero_stamp_rejected=%s "
+              "dataplane_missing_stamp_rejected=%s "
+              "dataplane_good_stamp_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
@@ -394,7 +447,9 @@ def selftest(threshold=0.10):
                  not fl_gone, fl_wobble, not ob_rise, not ob_zero,
                  ob_wobble, not fo_rise, not fo_zero, not hop_rise,
                  not hop_zero, fo_wobble, not rs_rise, not rs_zero,
-                 rs_wobble))
+                 rs_wobble, not pp_rise, not pp_zero, pp_wobble,
+                 bool(pp_stamp_zero), bool(pp_stamp_gone),
+                 not pp_stamp_ok))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
@@ -409,8 +464,11 @@ def selftest(threshold=0.10):
           "overhead wobble passes; fleet-tracing overhead and "
           "router hop-overhead rise/zero-stamp rejected, fleet "
           "overhead wobble passes; release shadow-mirroring "
-          "overhead rise/zero-stamp rejected, its wobble passes "
-          "(threshold %.0f%%)"
+          "overhead rise/zero-stamp rejected, its wobble passes; "
+          "pyprof sampler-overhead rise/zero-stamp rejected with "
+          "wobble passing, and a zero/missing "
+          "serving_dataplane_python_pct stamp is caught by the "
+          "--assert-stamped path (threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))
     return 0
 
@@ -459,7 +517,7 @@ def main(argv=None):
         print("bench_gate: cannot read new run: %s" % e)
         return 2
     if assert_stamped is not None:
-        missing = [k for k in assert_stamped if not new.get(k)]
+        missing = check_stamped(new, assert_stamped)
         if missing:
             print("bench_gate: crash-guard/missing stamps for %s "
                   "(values: %s) — the tier broke, failing the gate"

@@ -1,0 +1,76 @@
+"""Render a continuous-profiler capture into flamegraph inputs.
+
+Usage::
+
+    python tools/flamegraph.py <url|file>                # speedscope
+    python tools/flamegraph.py <url|file> -o prof.json   # to a file
+    python tools/flamegraph.py <url|file> --collapsed    # folded text
+
+The input is a ``GET /debug/pyprof`` payload (``core/pyprof.py``) —
+a saved JSON file, or an ``http(s)://`` URL fetched live.  Point it
+at a replica for one process, or at the fleet router for the stitched
+fleet-merged profile.
+
+Outputs:
+
+* default — a standalone speedscope-importable JSON document
+  (https://www.speedscope.app: drag the file in, or ``speedscope
+  prof.json``); sample counts become weights, component is the root
+  frame of every stack so the fleet view groups by component;
+* ``--collapsed`` — Brendan-Gregg folded-stack text
+  (``component;frame;...;leaf count`` per line), the input format of
+  ``flamegraph.pl`` and most flamegraph tooling.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the renderers live next to the sampler so the HTTP endpoint and
+# this CLI can never drift apart on the format
+from znicz_tpu.core import pyprof  # noqa: E402
+
+
+def _load(source):
+    if str(source).startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(source, timeout=60) as resp:
+            return json.loads(resp.read())
+    with open(source) as f:
+        return json.load(f)
+
+
+def main(argv):
+    args = [a for a in argv if not a.startswith("-")]
+    if not args:
+        raise SystemExit(__doc__)
+    out_path = None
+    if "-o" in argv:
+        out_path = argv[argv.index("-o") + 1]
+        args = [a for a in args if a != out_path]
+    prof = _load(args[0])
+    if not prof.get("stacks"):
+        raise SystemExit(
+            "no stacks in %s (profiler disabled, or an empty capture "
+            "window — arm root.common.profiler.pyprof.enabled and "
+            "put load on the server)" % args[0])
+    if "--collapsed" in argv:
+        text = pyprof.collapsed(prof) + "\n"
+    else:
+        text = json.dumps(pyprof.speedscope(
+            prof, name="pyprof:%s" % args[0]), indent=1) + "\n"
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+        print("wrote %s (%d samples, %d stacks)"
+              % (out_path, prof.get("samples", 0),
+                 len(prof["stacks"])))
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -442,7 +442,8 @@ class DaemonPool(object):
         self._q = queue.Queue()
         for i in range(int(max_workers)):
             t = threading.Thread(target=self._worker,
-                                 name="loadgen-%d" % i, daemon=True)
+                                 name="znicz:loadgen-%d" % i,
+                                 daemon=True)
             t.start()
 
     def _worker(self):

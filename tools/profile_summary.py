@@ -8,6 +8,7 @@ Usage::
     python tools/profile_summary.py --roofline <report.json> # cost registry
     python tools/profile_summary.py --ledger <report.json>   # memory ledger
     python tools/profile_summary.py --timeseries <ts.json>   # /debug rings
+    python tools/profile_summary.py --pyprof <url|file> [top_n]
 
 Input kinds, dispatched on the argument:
 
@@ -48,6 +49,14 @@ Input kinds, dispatched on the argument:
   point counts, first→last span, last value, min/max and the
   trailing per-second rate for counters — the over-time view of the
   metric registry.
+
+* ``--pyprof <url|file>`` renders a continuous-profiler capture
+  (``core/pyprof.py``; a saved ``GET /debug/pyprof`` payload, or an
+  ``http(s)://...`` URL fetched live — point it at the fleet router
+  for the stitched fleet view): per-component and per-phase
+  percentage tables, the top-N hot collapsed stacks, the GIL-wait
+  summary from the scheduling-delay probe, and the sampler's own
+  overhead self-meter.
 """
 
 import collections
@@ -418,13 +427,91 @@ def summarize_timeseries(path):
     return "\n".join(lines)
 
 
+def _load_pyprof(source):
+    """A pyprof payload from a saved JSON file or a live
+    ``http(s)://`` URL (``?seconds=`` passes through; the default
+    capture window applies otherwise)."""
+    if str(source).startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(source, timeout=60) as resp:
+            return json.loads(resp.read())
+    return _load_report(source)
+
+
+def summarize_pyprof(source, top_n=15):
+    """Markdown view of a continuous-profiler capture: component and
+    phase percentage tables, top-N hot stacks, GIL-wait and the
+    sampler's overhead self-meter."""
+    prof = _load_pyprof(source)
+    if not prof.get("enabled") and not prof.get("samples"):
+        raise SystemExit(
+            "profiler disabled and no samples in %s (arm "
+            "root.common.profiler.pyprof.enabled, or point at an "
+            "armed /debug/pyprof)" % source)
+    samples = int(prof.get("samples", 0)) or 1
+    lines = ["pyprof: %s  (%d samples, %.1f%% attributed%s)"
+             % (source, prof.get("samples", 0),
+                float(prof.get("attributed_pct", 0.0)),
+                ", fleet-merged over %d sources"
+                % len(prof["sources"]) if prof.get("merged") else "")]
+    if prof.get("truncated"):
+        lines.append("!! %d samples fell off the %d-stack capacity "
+                     "ring (raise root.common.profiler.pyprof."
+                     "capacity for full fidelity)"
+                     % (prof["truncated"], len(prof.get("stacks",
+                                                        ()))))
+    lines.append("")
+    lines.append("| component | samples | share |")
+    lines.append("|---|---|---|")
+    comps = prof.get("components") or {}
+    for comp in sorted(comps, key=lambda c: -comps[c]):
+        lines.append("| %s | %d | %.1f%% |"
+                     % (comp, comps[comp],
+                        100.0 * comps[comp] / samples))
+    lines.append("")
+    lines.append("| phase | samples | share |")
+    lines.append("|---|---|---|")
+    phases = prof.get("phases") or {}
+    for phase in sorted(phases, key=lambda p: -phases[p]):
+        if phases[phase]:
+            lines.append("| %s | %d | %.1f%% |"
+                         % (phase, phases[phase],
+                            100.0 * phases[phase] / samples))
+    stacks = prof.get("stacks") or {}
+    if stacks:
+        lines.append("")
+        lines.append("| top stack | samples | share |")
+        lines.append("|---|---|---|")
+        rows = sorted(stacks.items(), key=lambda kv: -kv[1])[:top_n]
+        for key, n in rows:
+            lines.append("| `%s` | %d | %.1f%% |"
+                         % (key[-90:], n, 100.0 * n / samples))
+    gil = prof.get("gil") or {}
+    if gil.get("probes"):
+        lines.append("")
+        lines.append("GIL probe: %d probes, baseline %s ms, "
+                     "%.3f ms excess wait attributed"
+                     % (gil["probes"], gil.get("baseline_ms", "?"),
+                        float(gil.get("wait_ms", 0.0))))
+    ovh = prof.get("overhead") or {}
+    if ovh:
+        lines.append("sampler overhead self-meter: %.3f%% of wall "
+                     "inside sample sweeps" % float(ovh.get("pct",
+                                                            0.0)))
+    return "\n".join(lines)
+
+
 if __name__ == "__main__":
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
     if sys.argv[1] in ("--journal", "--roofline", "--ledger",
-                       "--timeseries"):
+                       "--timeseries", "--pyprof"):
         if len(sys.argv) < 3:
             raise SystemExit(__doc__)
+        if sys.argv[1] == "--pyprof":
+            top = int(sys.argv[3]) if len(sys.argv) > 3 else 15
+            print(summarize_pyprof(sys.argv[2], top))
+            sys.exit(0)
         mode = {"--journal": summarize_journal,
                 "--roofline": summarize_roofline,
                 "--ledger": summarize_ledger,

@@ -72,7 +72,13 @@ SERIES_PREFIXES = frozenset((
     # trace spans (kind is bounded by reqtrace.ROUTER_SPAN_KINDS)
     "fleet",
     "health", "jax", "launcher", "loader",
-    "memory", "profiler", "registry",
+    "memory", "profiler",
+    # the continuous Python sampling profiler (ISSUE 18):
+    # pyprof.samples (sweep yield) and pyprof.gil_wait_ms (calibrated
+    # scheduling-delay excess) — core/pyprof.py, sampled into rings
+    # by core/timeseries.py
+    "pyprof",
+    "registry",
     # the release plane (ISSUE 17): shadow-compare / canary-state
     # series per (model, generation) — release.shadow_compares,
     # release.shadow_mismatches, release.shadow_dropped,
@@ -156,6 +162,10 @@ GATED_MODULES = {
     "znicz_tpu/core/timeseries.py": {
         "gates": ("enabled",),
         "required": ("sample_once", "maybe_start"),
+    },
+    "znicz_tpu/core/pyprof.py": {
+        "gates": ("enabled",),
+        "required": ("sample_once", "maybe_start", "gil_probe_once"),
     },
     "znicz_tpu/serving/reqtrace.py": {
         "gates": ("enabled", "sampled"),
@@ -952,6 +962,42 @@ def check_gate_order(tree, rel, pragmas, findings):
                 % (fn.name, hot[1], gate_line), token=fn.name))
 
 
+def check_thread_name(tree, rel, pragmas, findings):
+    """Every thread the codebase spawns must carry a stable
+    ``znicz:<component>`` name — the thread-name registry half of the
+    continuous profiler's contract (ISSUE 18, core/pyprof.py): the
+    sampler attributes stack samples BY THREAD NAME, so a thread
+    constructed without one surfaces as ``Thread-12`` and every one
+    of its samples lands in the ``unnamed`` bucket.  Flags
+    ``threading.Thread(...)`` construction without ``name=`` and
+    ``ThreadPoolExecutor(...)`` without ``thread_name_prefix=``
+    (tests are style-scope only and exempt; a ``**kwargs`` splat is
+    trusted to carry the name)."""
+    for node in _walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+        else:
+            continue
+        if fname not in ("Thread", "ThreadPoolExecutor"):
+            continue
+        kw = "name" if fname == "Thread" else "thread_name_prefix"
+        passed = {k.arg for k in node.keywords}
+        if None in passed or kw in passed:
+            continue
+        if pragmas.allows("thread-name", node.lineno):
+            continue
+        findings.append(Finding(
+            rel, node.lineno, "thread-name",
+            "%s(...) constructed without %s= — every spawned thread "
+            "needs a stable znicz:<component> name so pyprof sample "
+            "attribution never reads Thread-N (core/pyprof.py "
+            "thread_name())" % (fname, kw), token=fname))
+
+
 # ---------------------------------------------------------------------------
 # Legacy style checks (tools/lint.py heritage)
 # ---------------------------------------------------------------------------
@@ -1081,6 +1127,7 @@ def check_source(src, rel, vocab=None, style=True, invariants=True):
         check_lock_guard(tree, rel, pragmas, findings)
         check_jax(tree, rel, pragmas, findings)
         check_gate_order(tree, rel, pragmas, findings)
+        check_thread_name(tree, rel, pragmas, findings)
     return findings
 
 
@@ -1403,6 +1450,28 @@ def check_gd_unit(unit):
     if not enabled():
         return None
     return unit
+''',
+    },
+    "thread-name": {
+        "rel": "znicz_tpu/fixture_thread.py",
+        "bad": '''\
+import threading
+
+
+def start(worker):
+    t = threading.Thread(target=worker, daemon=True)  # seeded
+    t.start()
+    return t
+''',
+        "clean": '''\
+import threading
+
+
+def start(worker):
+    t = threading.Thread(target=worker, name="znicz:worker",
+                         daemon=True)
+    t.start()
+    return t
 ''',
     },
     "syntax": {

@@ -114,7 +114,8 @@ class Avatar(Unit):
             self._stop_evt.clear()
             self._thread = threading.Thread(
                 target=self._produce,
-                name="avatar-%s" % self.loader.name, daemon=True)
+                name="znicz:loader-avatar-%s" % self.loader.name,
+                daemon=True)
             self._thread.start()
 
     # -- producer side ------------------------------------------------------

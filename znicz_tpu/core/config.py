@@ -248,7 +248,8 @@ declare("common", {
             # comma-separated family prefixes worth a history (every
             # matching counter/gauge gets a ring; histograms record
             # their p50/p99) — keep it a bounded curated set
-            "prefixes": "serving,slo,jax,trainer,transfer,loader",
+            "prefixes":
+                "serving,slo,jax,trainer,transfer,loader,pyprof",
         },
     },
     # numeric training-health monitor (core/health.py) — off by default;
@@ -279,6 +280,25 @@ declare("common", {
         "leak_min_bytes": 1 << 20,  # ignore sub-MiB epoch growth
         "capture_seconds_cap": 60.0,  # /debug/profile?seconds= ceiling
         "capture_dir": None,      # default: <cache>/profiles
+        # continuous Python sampling profiler (core/pyprof.py) — off
+        # by default; when off no sampler thread exists and every hook
+        # is ONE config predicate.  Attributes sys._current_frames()
+        # samples to znicz:<component> thread names and classifies
+        # leaves into the fixed data-plane phase vocabulary; a
+        # calibrated scheduling-delay probe estimates GIL wait.
+        # Served at GET /debug/pyprof (fleet-merged on the router).
+        "pyprof": {
+            "enabled": False,
+            "hz": 97.0,             # sample rate — off-beat on
+                                    # purpose (coprime with the
+                                    # 1000/100/5 ms plane cadences)
+            "capacity": 512,        # distinct collapsed stacks kept
+            "max_depth": 24,        # frames folded per stack
+            "gil_probe": True,      # scheduling-delay probe thread
+            "gil_interval_ms": 5.0,  # probe sleep quantum
+            "gil_calib_probes": 20,  # overshoots -> median baseline
+            "capture_seconds_cap": 30.0,  # /debug/pyprof?seconds= cap
+        },
     },
     # deterministic fault injection (core/faults.py) — off by default;
     # when off every injection site is a single predicate with ZERO

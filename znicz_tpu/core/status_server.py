@@ -19,7 +19,10 @@ a stdlib ``ThreadingHTTPServer`` on a daemon thread serving
 * ``/debug/timeseries`` — the in-process metric time-series rings
   (core/timeseries.py),
 * ``/debug/trace/<rid>`` — sampled per-request span trees
-  (znicz_tpu/serving/reqtrace.py).
+  (znicz_tpu/serving/reqtrace.py),
+* ``/debug/pyprof?seconds=N`` — a windowed capture from the
+  continuous Python sampling profiler (core/pyprof.py;
+  ``format=collapsed|speedscope`` for renderer-ready output).
 
 The HTTP plumbing (handler ``_send`` helpers, daemon-thread lifecycle,
 idempotent ``stop()``) lives in :class:`HttpServerBase` /
@@ -43,6 +46,13 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core import telemetry
 from znicz_tpu.analysis import locksmith
+
+# ONE capture-concurrency guard shared by BOTH capture endpoints
+# (/debug/profile and /debug/pyprof): a JAX device trace and a
+# frame-walk capture interleaved on the same process would each
+# distort what the other measures, so the second concurrent capture
+# of EITHER kind gets the 409, not just a same-endpoint repeat.
+_capture_guard = locksmith.lock("status_server.debug_capture")
 
 _PAGE = """<html><head><title>znicz_tpu status</title>
 <meta http-equiv="refresh" content="5"></head>
@@ -73,6 +83,16 @@ class HandlerBase(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: route to the logger
         if self.owner is not None:
             self.owner.debug(fmt, *args)
+
+    def handle(self):
+        # adopt the thread-name registry (core/pyprof.py) at request
+        # entry: ThreadingHTTPServer spawns anonymous "Thread-N"
+        # threads, and a sample attributed to "Thread-N" is a sample
+        # lost to the "unnamed" bucket
+        t = threading.current_thread()
+        if not t.name.startswith("znicz:"):
+            t.name = "znicz:http-handler"
+        BaseHTTPRequestHandler.handle(self)
 
     def _send(self, code, ctype, body, headers=None):
         try:
@@ -149,7 +169,16 @@ class HandlerBase(BaseHTTPRequestHandler):
           (``core/timeseries.py``; 404-style empty when disabled),
         * ``GET /debug/trace`` / ``GET /debug/trace/<rid>`` — the
           sampled per-request span trees
-          (``znicz_tpu/serving/reqtrace.py``).
+          (``znicz_tpu/serving/reqtrace.py``),
+        * ``GET /debug/pyprof?seconds=N`` — a windowed capture from
+          the continuous Python sampling profiler
+          (``core/pyprof.py``; ``format=collapsed|speedscope``
+          selects renderer-ready output, default raw JSON;
+          ``{"enabled": false}`` when the knob is off).
+
+        The two CAPTURE endpoints (``/debug/profile`` and
+        ``/debug/pyprof``) share ONE concurrency guard: while either
+        capture runs, the other answers 409 too.
 
         Returns True when the request was handled."""
         path, _, query = self.path.partition("?")
@@ -205,6 +234,11 @@ class HandlerBase(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": "seconds must be a "
                                                "number"})
                 return True
+            if not _capture_guard.acquire(blocking=False):
+                self._send_json(409, {
+                    "error": "another debug capture (profile or "
+                             "pyprof) is already running"})
+                return True
             try:
                 # blocks THIS handler thread for the capture window
                 # (the server is threaded; other requests keep flowing)
@@ -215,7 +249,45 @@ class HandlerBase(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 - always answer HTTP
                 self._send_json(500, {"error": repr(e)})
                 return True
+            finally:
+                _capture_guard.release()
             self._send_json(200, result)
+            return True
+        if path == "/debug/pyprof":
+            from urllib.parse import parse_qs
+            from znicz_tpu.core import pyprof
+            qs = parse_qs(query)
+            try:
+                seconds = float(qs.get("seconds", ["2"])[0])
+            except ValueError:
+                self._send_json(400, {"error": "seconds must be a "
+                                               "number"})
+                return True
+            fmt = qs.get("format", ["json"])[0]
+            if not pyprof.enabled():
+                # the honest disabled answer — no capture, no guard
+                self._send_json(200, {"enabled": False})
+                return True
+            if not _capture_guard.acquire(blocking=False):
+                self._send_json(409, {
+                    "error": "another debug capture (profile or "
+                             "pyprof) is already running"})
+                return True
+            try:
+                # blocks THIS handler thread for the capture window
+                prof = pyprof.capture(seconds)
+            except Exception as e:  # noqa: BLE001 - always answer HTTP
+                self._send_json(500, {"error": repr(e)})
+                return True
+            finally:
+                _capture_guard.release()
+            if fmt == "collapsed":
+                self._send(200, "text/plain; charset=utf-8",
+                           (pyprof.collapsed(prof) + "\n").encode())
+            elif fmt == "speedscope":
+                self._send_json(200, pyprof.speedscope(prof))
+            else:
+                self._send_json(200, prof)
             return True
         return False
 
@@ -267,14 +339,19 @@ class HttpServerBase(Logger):
             self.port = self._httpd.server_address[1]
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever,
-                name=type(self).__name__.lower(), daemon=True)
+                name="znicz:" + type(self).__name__.lower(),
+                daemon=True)
             self._thread.start()
-        # arm the metric time-series sampler when its knob is on —
-        # every HTTP surface (status dashboard, serving front end)
-        # serves /debug/timeseries, so the server lifecycle is the one
-        # natural arming point (a no-op single predicate when off)
+        # arm the metric time-series sampler and the continuous
+        # Python profiler when their knobs are on — every HTTP
+        # surface (status dashboard, serving front end) serves
+        # /debug/timeseries and /debug/pyprof, so the server
+        # lifecycle is the one natural arming point (each a no-op
+        # single predicate when off)
         from znicz_tpu.core import timeseries
+        from znicz_tpu.core import pyprof
         timeseries.maybe_start()
+        pyprof.maybe_start()
         self.info("%s on http://%s:%d/", type(self).__name__,
                   self.host, self.port)
         return self

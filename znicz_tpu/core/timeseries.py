@@ -94,7 +94,7 @@ class _Series(object):
 
 def _prefixes():
     raw = _cfg.get("prefixes",
-                   "serving,slo,jax,trainer,transfer,loader")
+                   "serving,slo,jax,trainer,transfer,loader,pyprof")
     return tuple(p.strip() for p in str(raw).split(",") if p.strip())
 
 
@@ -168,7 +168,8 @@ def maybe_start():
         if _thread is not None and _thread.is_alive():
             return True
         _stop.clear()
-        _thread = threading.Thread(target=_run, name="timeseries",
+        _thread = threading.Thread(target=_run,
+                                   name="znicz:timeseries",
                                    daemon=True)
         _thread.start()
     return True

@@ -233,7 +233,7 @@ class Autoscaler(Logger):
             return self
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
-                                        name="autoscaler",
+                                        name="znicz:autoscaler",
                                         daemon=True)
         self._thread.start()
         return self

@@ -191,7 +191,8 @@ class ContinuousBatcher(Logger):
             self._running = True
             self._threads = [
                 threading.Thread(target=self._worker,
-                                 name="continuous-%d" % i, daemon=True)
+                                 name="znicz:continuous-%d" % i,
+                                 daemon=True)
                 for i in range(self.max_inflight)]
             for t in self._threads:
                 t.start()

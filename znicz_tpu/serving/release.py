@@ -322,10 +322,10 @@ class ReleaseController(Logger):
                 return self
             self._stop.clear()
             self._tick_thread = threading.Thread(
-                target=self._tick_loop, name="release-tick",
+                target=self._tick_loop, name="znicz:release-tick",
                 daemon=True)
             self._shadow_thread = threading.Thread(
-                target=self._shadow_loop, name="release-shadow",
+                target=self._shadow_loop, name="znicz:release-shadow",
                 daemon=True)
             self._tick_thread.start()
             self._shadow_thread.start()

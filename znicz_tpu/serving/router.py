@@ -100,6 +100,7 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger
 from znicz_tpu.core.status_server import (BodyTooLargeError,
                                           HandlerBase, HttpServerBase)
+from znicz_tpu.core import pyprof
 from znicz_tpu.core import telemetry
 from znicz_tpu.core import timeseries
 from znicz_tpu.serving import reqtrace
@@ -259,7 +260,8 @@ class Replica(Logger):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env)
         self._reader = threading.Thread(
-            target=self._drain_output, name="replica-%s-out" % rid,
+            target=self._drain_output,
+            name="znicz:replica-out-%s" % rid,
             daemon=True)
         self._reader.start()
 
@@ -562,7 +564,7 @@ class FleetRouter(HttpServerBase):
         super(FleetRouter, self).start()
         self._monitor_stop.clear()
         self._monitor = threading.Thread(
-            target=self._monitor_loop, name="fleet-monitor",
+            target=self._monitor_loop, name="znicz:fleet-monitor",
             daemon=True)
         self._monitor.start()
         return self
@@ -1425,6 +1427,49 @@ class FleetRouter(HttpServerBase):
         payloads["router"] = timeseries.snapshot()
         return timeseries.merge_snapshots(payloads)
 
+    def merged_pyprof(self, seconds=2.0):
+        """``GET /debug/pyprof`` at the router: every UP replica's
+        windowed capture fanned out IN PARALLEL (a pyprof capture
+        blocks for its whole window, so the sequential
+        ``_up_payloads`` walk would cost replicas x seconds) and
+        summed with the router's own concurrent capture into ONE
+        stitched fleet flamegraph (core/pyprof.py merge_profiles) —
+        per-source sample counts ride along for attribution, the PR
+        16 merged-timeseries pattern one layer down."""
+        payloads = {}
+        merge_lock = threading.Lock()
+
+        def fan(replica):
+            try:
+                raw = self._fetch(
+                    replica, "/debug/pyprof?seconds=%g" % seconds,
+                    timeout=seconds + 15)
+                payload = json.loads(raw)
+            except (OSError, ValueError):
+                return  # fetch failures skip (monitor will eject)
+            with merge_lock:
+                payloads[replica.rid] = payload
+
+        fanout = []
+        for i, replica in enumerate(self.replicas()):
+            if replica.state != UP:
+                continue
+            t = threading.Thread(
+                target=fan, args=(replica,),
+                name=pyprof.thread_name("router-fanout-%d" % i),
+                daemon=True)
+            t.start()
+            fanout.append(t)
+        # the router's own capture runs CONCURRENTLY with the fan-out
+        # (same window) — {"enabled": False} merges as zero samples
+        # when only the replica half of the fleet is armed
+        own = pyprof.capture(seconds)
+        for t in fanout:
+            t.join(timeout=seconds + 20)
+        with merge_lock:
+            payloads["router"] = own
+            return pyprof.merge_profiles(payloads)
+
     def healthz(self):
         with self._lock:
             blocks = {r.rid: r.stats() for r in self._replicas}
@@ -1506,6 +1551,38 @@ class FleetRouter(HttpServerBase):
                     code, payload = router.stitched_trace(
                         path[len("/debug/trace/"):])
                     self._send_json(code, payload)
+                elif path == "/debug/pyprof":
+                    # fleet fan-out + merge — NOT the router-local
+                    # capture _handle_debug would serve
+                    from urllib.parse import parse_qs
+                    qs = parse_qs(self.path.partition("?")[2])
+                    try:
+                        seconds = float(
+                            qs.get("seconds", ["2"])[0])
+                    except ValueError:
+                        self._send_json(400, {
+                            "error": "seconds must be a number"})
+                        return
+                    seconds = max(0.05, min(seconds, 30.0))
+                    fmt = qs.get("format", ["json"])[0]
+                    try:
+                        merged = router.merged_pyprof(seconds)
+                    except Exception as e:  # noqa: BLE001 - to HTTP
+                        self._send_json(500, {"error": repr(e)})
+                        return
+                    # the merged payload sums per-process collapsed
+                    # stacks, so the renderers apply to it unchanged
+                    if fmt == "collapsed":
+                        self._send(
+                            200, "text/plain; charset=utf-8",
+                            (pyprof.collapsed(merged) + "\n")
+                            .encode())
+                    elif fmt == "speedscope":
+                        self._send_json(
+                            200, pyprof.speedscope(
+                                merged, name="pyprof:fleet"))
+                    else:
+                        self._send_json(200, merged)
                 elif self._handle_debug():
                     pass
                 else:

@@ -104,7 +104,7 @@ import numpy
 from znicz_tpu.core.config import root
 from znicz_tpu.core.status_server import (BodyTooLargeError, HandlerBase,
                                           HttpServerBase)
-from znicz_tpu.core import compile_cache, telemetry
+from znicz_tpu.core import compile_cache, pyprof, telemetry
 from znicz_tpu.serving import reqtrace, slo
 from znicz_tpu.serving.batcher import (BatcherStoppedError, MicroBatcher,
                                        QueueFullError,
@@ -801,6 +801,10 @@ def _fleet_main(args, raw_argv):
     from znicz_tpu.serving.router import FleetRouter
 
     telemetry.enable()  # the router's own series + journal
+    # adopt the pyprof thread-name registry for the process's main
+    # thread — it blocks in the drain loop, and an unnamed MainThread
+    # would land every one of its samples in the "unnamed" bucket
+    pyprof.name_current_thread("serve-main")
     cfg = root.common.serving
     replica_argv = _replica_argv(raw_argv)
     if "--compile-cache" not in replica_argv:
@@ -932,6 +936,7 @@ def main(argv=None):
                            else sys_argv_tail())
 
     telemetry.enable()  # /metrics should work out of the box
+    pyprof.name_current_thread("serve-main")  # sampler attribution
     if args.compile_cache is not None:
         compile_cache.enable(args.compile_cache or None)
     else:

@@ -168,7 +168,9 @@ def timeout(seconds):
                 except BaseException as e:  # propagated below
                     result["error"] = e
 
-            t = threading.Thread(target=target, daemon=True)
+            t = threading.Thread(target=target,
+                                 name="znicz:test-timeout",
+                                 daemon=True)
             t.start()
             t.join(seconds)
             if t.is_alive():
