@@ -545,6 +545,9 @@ def main(profile_dir=None):
     # vs disabled on the same HTTP mix (overhead gated inverted) +
     # the measured Python data-plane tax (stamped-nonzero in CI)
     _stamp_serving_pyprof(out)
+    # durable-blackbox write-through tax (ISSUE 19): armed on-disk
+    # persistence vs disabled on the same HTTP mix — gated inverted
+    _stamp_serving_blackbox(out)
     prec = out.get("serving_precision", {}).get("dtypes")
     if prec and isinstance(out.get("roofline"), dict):
         # the roofline block grows the per-dtype serving axis: where
@@ -2044,6 +2047,148 @@ def _stamp_serving_pyprof(out):
         block.get("dataplane_python_pct") or 0.0)
 
 
+def _serving_blackbox_block(duration=2.0, clients=8, max_batch=8):
+    """The durable blackbox's write-through tax (ISSUE 19): the SAME
+    closed-loop HTTP mix against one registry server twice — both
+    laps with the SLO tracker and 1-in-8 trace sampling on (the
+    planes that actually feed the blackbox), first with the blackbox
+    DISABLED (its shipped default), then ARMED into a tempdir — so
+    the goodput delta isolates the on-disk write-through itself:
+    per-event journal appends, finish-time trace persistence, and
+    the sampler checkpoints.  ``overhead_pct`` is floored at 1.0 for
+    the stamp (tools/bench_gate treats zero as the crash-guard
+    sentinel); the raw delta and the armed writer's stats ride
+    along, and the block FAILS if the armed lap persisted nothing
+    (a knob that silently failed to arm would stamp a flattering
+    zero)."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+    from znicz_tpu.core.config import root
+    from znicz_tpu.core import blackbox, telemetry
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+    from znicz_tpu.serving import reqtrace
+
+    telemetry.reset()
+    blackbox.reset()
+    reqtrace.reset()
+    root.common.telemetry.enabled = True
+    # both laps: the feeding planes on (their cost is ISSUE 14/16's
+    # number, not this one's)
+    root.common.serving.slo_enabled = True
+    root.common.serving.trace_sample_n = 8
+    sources = _loadgen_models(max_batch)
+    registry = ModelRegistry(models=sources, max_batch=max_batch)
+    server = ServingServer(registry=registry).start()
+    url = "http://127.0.0.1:%d" % server.port
+    names = sorted(sources)
+    r = numpy.random.RandomState(7)
+    bodies = {}
+    for name in names:
+        n_in = sources[name][0]["input_sample_shape"][0]
+        bodies[name] = [
+            json.dumps({"inputs": r.uniform(
+                -1, 1, (1 + i % max_batch, n_in)).tolist()}).encode()
+            for i in range(4)]
+
+    def lap(seconds):
+        stop = threading.Event()
+        done = [0] * clients
+        errors = []
+
+        def client(k):
+            i = k
+            try:
+                while not stop.is_set():
+                    name = names[i % len(names)]
+                    req = urllib.request.Request(
+                        url + "/predict/" + name,
+                        bodies[name][i % len(bodies[name])],
+                        {"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req,
+                                                timeout=60) as resp:
+                        resp.read()
+                        assert resp.status == 200
+                    done[k] += 1
+                    i += 1
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                errors.append(repr(e))
+                stop.set()
+
+        threads = [threading.Thread(target=client, args=(k,),
+                                    name="znicz:bench-client-%d" % k,
+                                    daemon=True)
+                   for k in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            raise RuntimeError(
+                "blackbox lap lost %d client(s): %s"
+                % (len(errors), errors[:3]))
+        return done, time.perf_counter() - t0
+
+    bb_dir = tempfile.mkdtemp(prefix="znicz_bench_blackbox_")
+    saved_en = bool(root.common.telemetry.blackbox.get("enabled",
+                                                       False))
+    saved_dir = root.common.telemetry.blackbox.get("dir", None)
+    try:
+        lap(0.4)  # warm: dispatch paths hot before either timed lap
+        done_off, wall_off = lap(duration)
+        blackbox.enable(dir=bb_dir)
+        blackbox.maybe_arm("bench")
+        done_on, wall_on = lap(duration)
+        bb_stats = blackbox.stats()
+    finally:
+        blackbox.reset()
+        root.common.telemetry.blackbox.enabled = saved_en
+        root.common.telemetry.blackbox.dir = saved_dir
+        root.common.serving.slo_enabled = False
+        root.common.serving.trace_sample_n = 0
+        server.stop()
+        shutil.rmtree(bb_dir, ignore_errors=True)
+    if not bb_stats.get("records"):
+        raise RuntimeError("armed lap persisted no records — the "
+                           "blackbox never armed, the overhead "
+                           "number would be a lie")
+    rps_off = sum(done_off) / wall_off
+    rps_on = sum(done_on) / wall_on
+    raw = (1.0 - rps_on / max(rps_off, 1e-9)) * 100.0
+    return {
+        "clients": clients,
+        "duration_s": duration,
+        "disabled_requests_per_sec": round(rps_off, 1),
+        "armed_requests_per_sec": round(rps_on, 1),
+        "overhead_pct_raw": round(raw, 2),
+        "overhead_pct": round(max(raw, 1.0), 2),
+        # proof the armed lap actually persisted + sizing context
+        "armed_records": bb_stats.get("records", 0),
+        "armed_bytes_written": bb_stats.get("bytes_written", 0),
+        "armed_rotations": bb_stats.get("rotations", 0),
+    }
+
+
+def _stamp_serving_blackbox(out):
+    """Stamp the durable-blackbox block + its flat key (crash-guarded
+    ZERO stamp): ``serving_blackbox_overhead_pct`` is gated INVERTED
+    by tools/bench_gate.py — the crash-safe write-through must stay
+    affordable (ISSUE 19 budget: <= 2%) or arming it fleet-wide
+    stops being a default anyone can afford.  Shared by main(),
+    main_serving() and the ``--serving-blackbox`` CI entry."""
+    try:
+        out["serving_blackbox"] = _serving_blackbox_block()
+    except Exception as e:  # noqa: BLE001 - never kill the primary
+        out["serving_blackbox"] = {"error": repr(e)}
+    block = out["serving_blackbox"]
+    out["serving_blackbox_overhead_pct"] = (
+        block.get("overhead_pct") or 0.0)
+
+
 def _stamp_serving_precision(out, peaks):
     """Stamp the per-dtype serving block + the flat gated keys
     (crash-guarded with explicit ZERO stamps, so a broken precision
@@ -2190,6 +2335,9 @@ def main_serving(duration=5.0, clients=16, max_batch=64):
     # ISSUE 18: the continuous-profiler cost ledger — same stamps as
     # the main bench
     _stamp_serving_pyprof(out)
+    # ISSUE 19: the durable-blackbox write-through tax — same stamp
+    # as the main bench
+    _stamp_serving_blackbox(out)
     print(json.dumps(out))
 
 
@@ -2235,6 +2383,19 @@ def main_serving_obs():
     print(json.dumps(out))
 
 
+def main_serving_blackbox():
+    """``--serving-blackbox``: ONLY the durable-blackbox write-through
+    tax block + its flat key, as one JSON line — the CPU-feasible CI
+    entry (tools/ci.sh pipes it through ``bench_gate --assert-stamped
+    serving_blackbox_overhead_pct`` so a blackbox that broke, or
+    stopped arming, fails the gate)."""
+    from znicz_tpu.core import telemetry
+    telemetry.reset()
+    out = {"metric": "serving_blackbox"}
+    _stamp_serving_blackbox(out)
+    print(json.dumps(out))
+
+
 def main_serving_pyprof():
     """``--serving-pyprof``: ONLY the continuous-profiler cost-ledger
     block + its two flat keys, as one JSON line — the CPU-feasible CI
@@ -2274,6 +2435,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--serving-pyprof" in sys.argv:
         main_serving_pyprof()
+        sys.exit(0)
+    if "--serving-blackbox" in sys.argv:
+        main_serving_blackbox()
         sys.exit(0)
     if "--serving" in sys.argv:
         kwargs = {}

@@ -66,6 +66,10 @@ def _engine_flags_isolated():
     tsen = root.common.telemetry.timeseries.get("enabled", False)
     slo_en = root.common.serving.get("slo_enabled", False)
     trace_n = root.common.serving.get("trace_sample_n", 0)
+    # the durable blackbox (ISSUE 19): gate + dir/role knobs
+    bben = root.common.telemetry.blackbox.get("enabled", False)
+    bbdir = root.common.telemetry.blackbox.get("dir", None)
+    bbrole = root.common.telemetry.blackbox.get("role", None)
     yield
     root.common.timings.sync_each_run = sync
     root.common.telemetry.enabled = tel
@@ -90,6 +94,16 @@ def _engine_flags_isolated():
     root.common.telemetry.timeseries.enabled = tsen
     root.common.serving.slo_enabled = slo_en
     root.common.serving.trace_sample_n = trace_n
+    # durable-blackbox isolation: close any armed writer and uninstall
+    # the plane sinks, then restore the knobs (a test that armed the
+    # blackbox must not leave later tests writing segments)
+    root.common.telemetry.blackbox.enabled = bben
+    root.common.telemetry.blackbox.dir = bbdir
+    root.common.telemetry.blackbox.role = bbrole
+    import sys
+    blackbox = sys.modules.get("znicz_tpu.core.blackbox")
+    if blackbox is not None and blackbox.armed():
+        blackbox.reset()
 
 
 #: test modules whose CONCURRENT serving traffic runs under the armed

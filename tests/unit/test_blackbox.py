@@ -1,0 +1,494 @@
+"""Durable blackbox (znicz_tpu/core/blackbox.py, ISSUE 19): record
+framing, torn-tail recovery, rotation + retention bounds, the
+disabled-path zero-filesystem pin, write-through sink integration
+with the telemetry / timeseries / reqtrace planes, the obs query
+functions (timeline, --rid re-stitch, cross-restart --rate,
+--postmortem), the /debug/events filters + /debug/blackbox endpoint,
+and a REAL-SIGKILL crash-recovery pin over a subprocess writer."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core import blackbox, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _bb_isolated():
+    """Every test starts disarmed with a clean journal; ALL blackbox
+    knobs (not just the gate trio the session conftest covers) are
+    restored after."""
+    saved = {k: root.common.telemetry.blackbox.get(k)
+             for k in ("enabled", "dir", "role", "segment_bytes",
+                       "retention_bytes", "checkpoint_every_sweeps")}
+    telemetry.reset()
+    blackbox.reset()
+    yield
+    blackbox.reset()
+    telemetry.reset()
+    for k, v in saved.items():
+        setattr(root.common.telemetry.blackbox, k, v)
+
+
+# -- framing + torn-tail recovery ---------------------------------------------
+
+def test_framing_roundtrip(tmp_path):
+    path = str(tmp_path / "seg")
+    recs = [{"bb": "journal", "kind": "a.one", "t": 1.5},
+            {"bb": "trace", "rid": "r-1", "tree": {"spans": []}},
+            {"unicode": "å∂", "n": 3}]
+    with open(path, "wb") as f:
+        for r in recs:
+            f.write(blackbox._frame(r))
+    out, torn = blackbox.read_segment(path)
+    assert out == recs
+    assert torn == 0
+
+
+def test_torn_tail_recovered_around(tmp_path):
+    """A writer killed mid-record leaves a tail torn ANYWHERE —
+    inside the length prefix, the payload, or the missing trailing
+    newline.  Every cut recovers every COMPLETE record and counts
+    the partial bytes exactly."""
+    framed = [blackbox._frame({"i": i, "pad": "x" * 40})
+              for i in range(5)]
+    blob = b"".join(framed)
+    keep = len(blob) - len(framed[-1])
+    for cut in (keep + 1,             # inside the length prefix
+                keep + 12,            # inside the json payload
+                len(blob) - 1):       # json complete, newline missing
+        path = str(tmp_path / ("seg%d" % cut))
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        out, torn = blackbox.read_segment(path)
+        assert [r["i"] for r in out] == [0, 1, 2, 3]
+        assert torn == cut - keep
+
+
+def test_corrupt_payload_stops_loudly(tmp_path):
+    """A complete length prefix over a garbage payload stops the
+    reader AT the corruption (counted as torn), never half-parses."""
+    good = blackbox._frame({"i": 0})
+    bad = blackbox._frame({"i": 1})
+    bad = bad.split(b" ", 1)[0] + b" " + b"#" * (len(bad.split(
+        b" ", 1)[1]) - 1) + b"\n"
+    path = str(tmp_path / "seg")
+    with open(path, "wb") as f:
+        f.write(good + bad)
+    out, torn = blackbox.read_segment(path)
+    assert [r["i"] for r in out] == [0]
+    assert torn == len(bad)
+
+
+def test_read_all_counts_and_journals_torn_tails(tmp_path):
+    """Recovering a torn segment is LOUD: read_all reports the torn
+    byte count per segment, bumps the blackbox.torn_tails counter and
+    journals a blackbox.torn_tail event."""
+    root.common.telemetry.enabled = True
+    d = tmp_path / "bb"
+    d.mkdir()
+    seg = d / "dead.12345.ff.000"
+    with open(str(seg), "wb") as f:
+        f.write(blackbox._frame({"bb": "journal", "t": 1.0,
+                                 "kind": "pre.crash"}))
+        f.write(b"999 {\"torn")
+    records, torn = blackbox.read_all(str(d))
+    assert [r["kind"] for _, r in records] == ["pre.crash"]
+    assert torn == {str(seg): len(b"999 {\"torn")}
+    assert telemetry.counter("blackbox.torn_tails").value == 1
+    evs = [e for e in telemetry.journal_events()
+           if e["kind"] == "blackbox.torn_tail"]
+    assert evs and evs[0]["segment"] == str(seg)
+
+
+def test_foreign_files_in_a_shared_dir_are_skipped(tmp_path):
+    d = tmp_path / "bb"
+    d.mkdir()
+    (d / "README.txt").write_text("not a segment")
+    (d / "serve.1.zz.abc").write_text("bad name fields")
+    assert blackbox.scan(str(d)) == []
+    meta = blackbox.parse_segment_name("fleet.router.8.1a2b.007")
+    assert meta == {"role": "fleet.router", "pid": 8, "boot": "1a2b",
+                    "seq": 7}
+
+
+# -- the disabled fast path ---------------------------------------------------
+
+def test_disabled_blackbox_touches_no_filesystem(monkeypatch):
+    """The zero-overhead-off pin: gate off, maybe_arm returns after
+    ONE config predicate — booby-trapped writer/fs entry points prove
+    no sink is installed, no writer allocated, no fs syscall made."""
+    root.common.telemetry.blackbox.enabled = False
+    root.common.telemetry.enabled = True
+
+    def boom(*a, **k):
+        raise AssertionError("disabled blackbox touched the fs")
+
+    monkeypatch.setattr(blackbox, "_Writer", boom)
+    monkeypatch.setattr(blackbox, "open", boom, raising=False)
+    monkeypatch.setattr(blackbox.os, "makedirs", boom)
+    assert blackbox.maybe_arm("test") is False
+    assert blackbox.armed() is False
+    assert blackbox.current_segment() is None
+    telemetry.record_event("off.path", rid="r-0")  # sink never set
+    assert telemetry.journal_events()[-1]["kind"] == "off.path"
+    assert blackbox.stats() == {"enabled": False, "armed": False}
+
+
+# -- arming + write-through sinks ---------------------------------------------
+
+def test_role_knob_beats_argument_and_first_arm_wins(tmp_path):
+    blackbox.enable(dir=str(tmp_path / "bb"), role="cfgrole")
+    assert blackbox.maybe_arm("argrole") is True
+    assert blackbox.stats()["role"] == "cfgrole"
+    root.common.telemetry.blackbox.role = None
+    assert blackbox.maybe_arm("other") is True   # idempotent
+    assert blackbox.stats()["role"] == "cfgrole"
+    blackbox.reset()
+    assert blackbox.maybe_arm() is True          # no knob, no arg
+    assert blackbox.stats()["role"] == "proc"
+
+
+def test_write_through_sinks_land_on_disk(tmp_path, monkeypatch):
+    """One armed process: a journal event, a timeseries checkpoint
+    and a finished sampled trace each become a durable record AT EMIT
+    TIME — read back with zero process state."""
+    from znicz_tpu.core import timeseries
+    from znicz_tpu.serving import reqtrace
+    root.common.telemetry.enabled = True
+    monkeypatch.setattr(root.common.telemetry.timeseries, "enabled",
+                        True)
+    monkeypatch.setattr(root.common.serving, "trace_sample_n", 1)
+    timeseries.reset()
+    reqtrace.reset()
+    d = str(tmp_path / "bb")
+    blackbox.enable(dir=d, role="test", checkpoint_every_sweeps=1)
+    assert blackbox.maybe_arm() is True
+    try:
+        telemetry.record_event("unit.ping", rid="r-42", detail=7)
+        telemetry.counter("serving.batches").inc(3)
+        timeseries.sample_once(now=100.0)
+        reqtrace.begin("r-42", now=10.0, force=True)
+        reqtrace.add_span("r-42", "admission", 10.0, 10.001)
+        reqtrace.finish("r-42", now=10.010, model="m")
+        records, torn = blackbox.read_all(d)
+    finally:
+        timeseries.reset()
+        reqtrace.reset()
+    assert not torn
+    assert all(source.startswith("test.") for source, _ in records)
+    by = {}
+    for _, rec in records:
+        by.setdefault(rec["bb"], []).append(rec)
+    ev = [r for r in by["journal"] if r.get("kind") == "unit.ping"]
+    assert ev and ev[0]["rid"] == "r-42" and ev[0]["detail"] == 7
+    ck = by["ts"][-1]
+    assert ck["series"]["serving.batches"] == {
+        "kind": "counter", "t": 100.0, "v": 3.0}
+    tr = [r for r in by["trace"] if r["rid"] == "r-42"]
+    assert tr and tr[0]["tree"]["spans"][0]["kind"] == "admission"
+
+
+def test_rotation_retention_bounded_and_newest_queryable(tmp_path):
+    """Tiny segments + a tiny budget: the writer rotates (fsync
+    file-then-dir), retention deletes oldest-first, never the live
+    segment, the dir total stays bounded, and the NEWEST records
+    remain queryable through the reader."""
+    root.common.telemetry.enabled = True
+    d = str(tmp_path / "bb")
+    blackbox.enable(dir=d, role="rot", segment_bytes=512,
+                    retention_bytes=2048)
+    assert blackbox.maybe_arm() is True
+    for i in range(300):
+        telemetry.record_event("rot.tick", i=i)
+    st = blackbox.stats()
+    assert st["rotations"] > 0
+    assert st["retention_deleted"] > 0
+    assert st["total_bytes"] <= 2048 + 1024
+    live = blackbox.current_segment()
+    assert live is not None and os.path.exists(live)
+    out = blackbox.timeline(d, kind="rot")
+    assert out["events"], "retention deleted the live history"
+    assert out["events"][-1]["i"] == 299      # newest survived
+    assert out["events"][0]["i"] > 0          # oldest aged out
+
+
+def test_crash_report_points_at_live_segment(tmp_path):
+    root.common.telemetry.enabled = True
+    blackbox.enable(dir=str(tmp_path / "bb"), role="cr")
+    assert blackbox.maybe_arm() is True
+    telemetry.record_event("boom.precursor")
+    path = telemetry.write_crash_report(
+        reason="test", directory=str(tmp_path / "crash"))
+    with open(os.path.join(path, "report.json")) as f:
+        report = json.load(f)
+    assert report["blackbox_segment"] == blackbox.current_segment()
+    assert os.path.exists(report["blackbox_segment"])
+
+
+# -- the obs query functions --------------------------------------------------
+
+def test_timeline_merges_sources_and_filters(tmp_path):
+    d = str(tmp_path / "bb")
+    w1 = blackbox._Writer("router", d)
+    w1.write({"bb": "journal", "t": 2.0, "kind": "b.two",
+              "rid": "r-1"})
+    w1.close()
+    w2 = blackbox._Writer("replica", d)
+    w2.boot = "f" + w2.boot            # distinct segment name
+    w2.write({"bb": "journal", "t": 1.0, "kind": "a.one"})
+    w2.write({"bb": "journal", "t": 3.0, "kind": "a.three",
+              "exemplar_rid": "r-1"})
+    w2.write({"bb": "ts", "t": 4.0, "sweeps": 1, "series": {}})
+    w2.close()
+    out = blackbox.timeline(d)
+    # merged across sources, sorted by wall time, ts records excluded
+    assert [e["kind"] for e in out["events"]] == \
+        ["a.one", "b.two", "a.three"]
+    assert [e["source"].split(".")[0] for e in out["events"]] == \
+        ["replica", "router", "replica"]
+    assert [e["kind"] for e in
+            blackbox.timeline(d, kind="a")["events"]] == \
+        ["a.one", "a.three"]
+    # rid matches rid AND exemplar_rid fields; n keeps the newest
+    assert [e["kind"] for e in
+            blackbox.timeline(d, rid="r-1")["events"]] == \
+        ["b.two", "a.three"]
+    assert [e["kind"] for e in
+            blackbox.timeline(d, n=1)["events"]] == ["a.three"]
+    assert [e["kind"] for e in
+            blackbox.timeline(d, roles=("router",))["events"]] == \
+        ["b.two"]
+
+
+def test_query_rid_restitches_router_and_replica_trees(tmp_path,
+                                                       monkeypatch):
+    """The postmortem jewel: the router's persisted tree and the
+    replica's persisted tree for one rid, each from its OWN process
+    segment, re-stitch into the same cross-process trace
+    GET /debug/trace/<rid> would have answered live."""
+    from znicz_tpu.serving import reqtrace
+    monkeypatch.setattr(root.common.serving, "trace_sample_n", 1)
+    reqtrace.reset()
+    reqtrace.begin("q-1", now=0.0, force=True, origin="router")
+    reqtrace.add_span("q-1", "route", 0.0, 0.001)
+    reqtrace.add_span("q-1", "conn_acquire", 0.001, 0.002)
+    reqtrace.add_span("q-1", "relay_send", 0.002, 0.003)
+    reqtrace.add_span("q-1", "replica_wait", 0.003, 0.009)
+    reqtrace.add_span("q-1", "relay_reply", 0.009, 0.010)
+    reqtrace.finish("q-1", now=0.010, model="m")
+    router_tree = reqtrace.get("q-1")
+    reqtrace.reset()
+    reqtrace.begin("q-1", now=50.0, force=True)
+    reqtrace.add_span("q-1", "admission", 50.0, 50.001)
+    reqtrace.add_span("q-1", "dispatch", 50.001, 50.004)
+    reqtrace.add_span("q-1", "reply", 50.004, 50.005)
+    reqtrace.finish("q-1", now=50.005, model="m")
+    replica_tree = reqtrace.get("q-1")
+    reqtrace.reset()
+    d = str(tmp_path / "bb")
+    wr = blackbox._Writer("router", d)
+    wr.write({"bb": "trace", "t": 1.0, "rid": "q-1",
+              "tree": router_tree})
+    wr.write({"bb": "journal", "t": 2.0, "kind": "slo.burn",
+              "exemplar_rid": "q-1"})
+    wr.close()
+    wrep = blackbox._Writer("replica", d)
+    wrep.write({"bb": "trace", "t": 1.5, "rid": "q-1",
+                "tree": replica_tree})
+    wrep.close()
+    out = blackbox.query_rid(d, "q-1")
+    assert len(out["traces"]) == 2
+    stitched = out["stitched"]
+    assert stitched and stitched["stitched"] is True
+    kinds = set(stitched["span_kinds"])
+    assert {"route", "replica_wait", "relay_reply", "admission",
+            "dispatch", "reply", "replica"} <= kinds
+    assert stitched["replica"].startswith("replica.")
+    assert [e["kind"] for e in out["events"]] == ["slo.burn"]
+
+
+def test_query_rate_spans_restarts(tmp_path):
+    """Cross-restart rate(): a counter that died at 60 and restarted
+    from 0 merges into ONE monotonic series (the dead boot latches at
+    its final value, the successor sums on top)."""
+    d = str(tmp_path / "bb")
+
+    def ckpt(w, t, v, sweeps):
+        w.write({"bb": "ts", "t": t, "sweeps": sweeps,
+                 "series": {"serving.requests": {
+                     "kind": "counter", "t": t, "v": v}}})
+
+    w1 = blackbox._Writer("serve", d)
+    w1.boot = "aaa"
+    ckpt(w1, 100.0, 0.0, 1)
+    ckpt(w1, 160.0, 60.0, 2)
+    w1.close()                         # the process "dies" here
+    w2 = blackbox._Writer("serve", d)
+    w2.boot = "bbb"
+    ckpt(w2, 170.0, 0.0, 1)           # restarted from zero
+    ckpt(w2, 220.0, 30.0, 2)
+    w2.close()
+    out = blackbox.query_rate(d, "serving.requests")
+    assert len(out["sources"]) == 2
+    vs = [v for _, v in out["points"]]
+    assert vs == sorted(vs), "restart broke monotonicity: %r" % vs
+    assert vs[-1] == 90.0             # 60 latched + 30 on top
+    assert out["rate"] is not None and out["rate"] > 0
+
+
+def test_postmortem_prefers_newest_dead_boot(tmp_path):
+    d = str(tmp_path / "bb")
+    reaped = subprocess.Popen([sys.executable, "-c", "pass"])
+    reaped.wait(timeout=30)
+    dead = blackbox._Writer("replica", d)
+    dead.pid = reaped.pid              # exited + reaped: not alive
+    dead.boot = "ffffffffffff"
+    dead.write({"bb": "journal", "t": 5.0, "kind": "last.words"})
+    dead.write({"bb": "ts", "t": 6.0, "sweeps": 3,
+                "series": {"serving.requests": {
+                    "kind": "counter", "t": 6.0, "v": 9.0}}})
+    dead.write({"bb": "trace", "t": 7.0, "rid": "p-1", "tree": {}})
+    dead.close()
+    alive = blackbox._Writer("replica", d)  # THIS process: alive,
+    alive.boot = "fffffffffffff"            # even newer boot
+    alive.write({"bb": "journal", "t": 8.0, "kind": "still.here"})
+    alive.close()
+    pm = blackbox.postmortem(d, "replica")
+    assert pm["pid"] == dead.pid and pm["alive"] is False
+    assert [e["kind"] for e in pm["events"]] == ["last.words"]
+    assert pm["last_checkpoint"]["sweeps"] == 3
+    assert pm["trace_rids"] == ["p-1"]
+    assert blackbox.postmortem(d, "ghost")["error"]
+
+
+def test_obs_cli_timeline_and_filters(tmp_path, capsys):
+    d = str(tmp_path / "bb")
+    w = blackbox._Writer("serve", d)
+    w.write({"bb": "journal", "t": 1.0, "kind": "a.one",
+             "rid": "r-1"})
+    w.write({"bb": "journal", "t": 2.0, "kind": "b.two"})
+    w.close()
+    assert blackbox.cli_main(["--dir", d, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [e["kind"] for e in out["events"]] == ["a.one", "b.two"]
+    assert blackbox.cli_main(["--dir", d, "--kind", "a",
+                              "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [e["kind"] for e in out["events"]] == ["a.one"]
+    assert blackbox.cli_main(["--dir", d, "--rid", "r-1",
+                              "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [e["kind"] for e in out["events"]] == ["a.one"]
+    # human-readable mode prints without tracebacks too
+    assert blackbox.cli_main(["--dir", d]) == 0
+    assert "a.one" in capsys.readouterr().out
+    # a missing dir is a loud exit code, not a stack trace
+    assert blackbox.cli_main(["--dir", str(tmp_path / "nope")]) == 1
+    capsys.readouterr()
+
+
+# -- the HTTP surface ---------------------------------------------------------
+
+def test_debug_events_filters_and_blackbox_endpoint(tmp_path):
+    from znicz_tpu.core.status_server import StatusServer
+    root.common.telemetry.enabled = True
+    blackbox.enable(dir=str(tmp_path / "bb"), role="http")
+    for i in range(5):
+        telemetry.record_event("alpha.tick", i=i, rid="r-%d" % i)
+    telemetry.record_event("beta.tick", rid="r-1")
+    server = StatusServer(None, port=0).start()  # start() arms
+    try:
+        assert blackbox.armed() is True
+        telemetry.record_event("gamma.tick")     # lands on disk
+        base = "http://127.0.0.1:%d" % server.port
+
+        def get(path):
+            with urllib.request.urlopen(base + path,
+                                        timeout=10) as r:
+                return json.loads(r.read())
+
+        doc = get("/debug/events?kind=alpha")
+        assert doc["matched"] == 5 and doc["total"] >= 7
+        assert all(e["kind"] == "alpha.tick" for e in doc["events"])
+        doc = get("/debug/events?rid=r-1")
+        assert doc["matched"] == 2
+        assert {e["kind"] for e in doc["events"]} == \
+            {"alpha.tick", "beta.tick"}
+        doc = get("/debug/events?n=2&kind=alpha")
+        assert len(doc["events"]) == 2 and doc["matched"] == 5
+        assert doc["events"][-1]["i"] == 4       # newest-N kept
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/debug/events?n=zap",
+                                   timeout=10)
+        assert err.value.code == 400
+        st = get("/debug/blackbox")
+        assert st["enabled"] and st["armed"]
+        assert st["role"] == "http"
+        assert st["records"] >= 2                # meta + gamma.tick
+        assert st["segments_on_disk"] >= 1
+    finally:
+        server.stop()
+
+
+# -- the crash-recovery pin (a REAL SIGKILL) ----------------------------------
+
+_VICTIM = r"""
+import os, sys
+from znicz_tpu.core.config import root
+from znicz_tpu.core import blackbox, telemetry
+root.common.telemetry.enabled = True
+blackbox.enable(dir=sys.argv[1], role="victim")
+assert blackbox.maybe_arm()
+i = 0
+while True:
+    telemetry.record_event("victim.tick", i=i, pad="x" * 64)
+    print(i, flush=True)   # acked AFTER the write returned
+    i += 1
+"""
+
+
+def test_sigkill_mid_write_recovers_every_acked_record(tmp_path):
+    """The tentpole pin: a subprocess journaling in a tight loop is
+    SIGKILLed mid-stream.  Every ACKNOWLEDGED record (its write had
+    returned) is recovered from disk, the recovered ids are gapless
+    from 0, and any torn tail is reported — never silently
+    dropped."""
+    d = str(tmp_path / "bb")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _VICTIM, d],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo))
+    acked = -1
+    deadline = time.time() + 120
+    try:
+        while acked < 200 and time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            acked = int(line)
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        proc.stdout.close()
+    assert acked >= 200, "victim never ramped (acked=%d)" % acked
+    records, torn = blackbox.read_all(d)
+    ids = [rec["i"] for _, rec in records
+           if rec.get("bb") == "journal"
+           and rec.get("kind") == "victim.tick"]
+    assert ids == list(range(len(ids))), "recovered ids have gaps"
+    assert ids and ids[-1] >= acked, \
+        "acked %d but only %d recovered" % (acked, len(ids))
+    # a torn tail (if the kill landed mid-record) is counted loudly
+    assert all(nbytes > 0 for nbytes in torn.values())

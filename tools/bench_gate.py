@@ -129,7 +129,15 @@ GATED_INVERSE = ("serving_loadgen_p99_ms",
                  # tax DOWN is ROADMAP item 3's goal, a directional
                  # gate would punish the improvement — so CI pins it
                  # with --assert-stamped instead (nonzero or fail)
-                 "serving_pyprof_overhead_pct")
+                 "serving_pyprof_overhead_pct",
+                 # the durable blackbox's write-through tax
+                 # (ISSUE 19): armed on-disk persistence (journal
+                 # write-through, finish-time trace dumps, sampler
+                 # checkpoints) vs disabled on the same HTTP mix,
+                 # same floored-at-1.0 honest-zero rule — crash-safe
+                 # evidence getting expensive fails the round like a
+                 # latency regression (budget: <= 2%)
+                 "serving_blackbox_overhead_pct")
 
 
 def check_stamped(new, keys):
@@ -404,6 +412,21 @@ def selftest(threshold=0.10):
     pp_stamp_ok = check_stamped(
         {"serving_pyprof_overhead_pct": 2.4,
          "serving_dataplane_python_pct": 61.0}, pp_keys)
+    # the durable-blackbox gate (ISSUE 19), same inverted shape: the
+    # write-through persistence tax fails on a rise or a crash-guard
+    # zero stamp, wobbles inside the band pass
+    bb_old = {"serving_blackbox_overhead_pct": 1.6}
+    bb_rise, _ = compare(
+        dict(bb_old, serving_blackbox_overhead_pct=1.6 *
+             (1.0 + 2 * threshold) * 2.0),
+        bb_old, threshold)
+    bb_zero, _ = compare(
+        dict(bb_old, serving_blackbox_overhead_pct=0.0),
+        bb_old, threshold)
+    bb_wobble, _ = compare(
+        dict(bb_old, serving_blackbox_overhead_pct=1.6 *
+             (1.0 + threshold)),
+        bb_old, threshold)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
             or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
@@ -415,7 +438,8 @@ def selftest(threshold=0.10):
             or rs_rise or rs_zero or not rs_wobble \
             or pp_rise or pp_zero or not pp_wobble \
             or not pp_stamp_zero or not pp_stamp_gone \
-            or pp_stamp_ok:
+            or pp_stamp_ok \
+            or bb_rise or bb_zero or not bb_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
@@ -438,7 +462,9 @@ def selftest(threshold=0.10):
               "pyprof_wobble_passed=%s "
               "dataplane_zero_stamp_rejected=%s "
               "dataplane_missing_stamp_rejected=%s "
-              "dataplane_good_stamp_passed=%s"
+              "dataplane_good_stamp_passed=%s "
+              "blackbox_rise_rejected=%s blackbox_zero_rejected=%s "
+              "blackbox_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
@@ -449,7 +475,8 @@ def selftest(threshold=0.10):
                  not hop_zero, fo_wobble, not rs_rise, not rs_zero,
                  rs_wobble, not pp_rise, not pp_zero, pp_wobble,
                  bool(pp_stamp_zero), bool(pp_stamp_gone),
-                 not pp_stamp_ok))
+                 not pp_stamp_ok, not bb_rise, not bb_zero,
+                 bb_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
@@ -466,9 +493,11 @@ def selftest(threshold=0.10):
           "overhead wobble passes; release shadow-mirroring "
           "overhead rise/zero-stamp rejected, its wobble passes; "
           "pyprof sampler-overhead rise/zero-stamp rejected with "
-          "wobble passing, and a zero/missing "
+          "wobble passing, a zero/missing "
           "serving_dataplane_python_pct stamp is caught by the "
-          "--assert-stamped path (threshold %.0f%%)"
+          "--assert-stamped path, and a blackbox write-through "
+          "overhead rise/zero-stamp is rejected with its wobble "
+          "passing (threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))
     return 0
 

@@ -4,7 +4,8 @@ Usage::
 
     python tools/profile_summary.py <trace_dir> [top_n]      # XLA xplane
     python tools/profile_summary.py <trace.json> [top_n]     # telemetry
-    python tools/profile_summary.py --journal <events.jsonl> # black box
+    python tools/profile_summary.py --journal <events.jsonl|blackbox_dir> \
+        [--rid RID] [--kind PREFIX]                          # black box
     python tools/profile_summary.py --roofline <report.json> # cost registry
     python tools/profile_summary.py --ledger <report.json>   # memory ledger
     python tools/profile_summary.py --timeseries <ts.json>   # /debug rings
@@ -28,12 +29,18 @@ Input kinds, dispatched on the argument:
   spans on the same thread) — where the host-side control plane
   actually spends its time.
 
-* ``--journal <file>`` is a flight-recorder JSONL
+* ``--journal <file-or-dir>`` is a flight-recorder JSONL
   (``telemetry.export_journal``, or the ``events.jsonl`` of a crash
-  report): the tool prints the event timeline with timestamps relative
-  to the first event, health violations and slow serving requests
-  highlighted with a ``!!`` marker, and a per-kind count summary —
-  the first thing to read after a crash.
+  report) — or a durable-blackbox segment DIRECTORY
+  (``core/blackbox.py``), in which case the tool merges every
+  process's durable journal records into one cross-process timeline
+  (source-tagged) and reports torn tails loudly.  ``--rid RID``
+  keeps only the events naming one request (follow it across
+  planes); ``--kind PREFIX`` keeps only matching kinds (``slo``
+  matches ``slo.burn``).  The tool prints the event timeline with
+  timestamps relative to the first event, health violations and slow
+  serving requests highlighted with a ``!!`` marker, and a per-kind
+  count summary — the first thing to read after a crash.
 
 * ``--roofline <file.json>`` renders the executable cost registry
   (``profiler.export_report`` output, or a BENCH_*.json carrying a
@@ -271,25 +278,56 @@ def _format_event(ev, t0):
                                      " ".join(fields))
 
 
-def summarize_journal(path):
-    """Pretty-print a flight-recorder JSONL: relative-time event
-    timeline (violations highlighted) + per-kind counts."""
+def _load_journal(path, rid=None, kind=None):
+    """Journal events from a JSONL file OR a blackbox segment dir
+    (merged cross-process, source-tagged).  Returns ``(events,
+    torn)`` — ``torn`` maps segment path -> truncated-tail bytes."""
+    if os.path.isdir(path):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from znicz_tpu.core import blackbox
+        out = blackbox.timeline(path, n=0, kind=kind, rid=rid)
+        return out["events"], out["torn"]
     events = []
     with open(path) as f:
         for line in f:
             line = line.strip()
             if line:
                 events.append(json.loads(line))
+    if kind:
+        events = [e for e in events
+                  if str(e.get("kind", "")).startswith(kind)]
+    if rid:
+        events = [e for e in events
+                  if rid in (e.get("rid"), e.get("exemplar_rid"),
+                             e.get("request_id"))]
+    return events, {}
+
+
+def summarize_journal(path, rid=None, kind=None):
+    """Pretty-print a flight-recorder JSONL (or durable-blackbox
+    dir): relative-time event timeline (violations highlighted) +
+    per-kind counts; ``rid``/``kind`` filter before printing."""
+    events, torn = _load_journal(path, rid=rid, kind=kind)
     if not events:
-        raise SystemExit("no events in %s" % path)
+        raise SystemExit("no%s events in %s"
+                         % (" matching" if (rid or kind) else "",
+                            path))
     t0 = float(events[0].get("t", 0.0))
     counts = collections.Counter(str(e.get("kind", "?"))
                                  for e in events)
     alarms = sum(counts[k] for k in _ALARM_KINDS if k in counts)
-    lines = ["journal: %s  (%d events, %d kinds, %d alarm%s)"
+    filters = "".join([", rid=%s" % rid if rid else "",
+                       ", kind=%s*" % kind if kind else ""])
+    lines = ["journal: %s  (%d events, %d kinds, %d alarm%s%s)"
              % (path, len(events), len(counts), alarms,
-                "" if alarms == 1 else "s"), ""]
+                "" if alarms == 1 else "s", filters), ""]
     lines += [_format_event(ev, t0) for ev in events]
+    for seg, nbytes in sorted(torn.items()):
+        lines.append("!! torn tail: %d byte%s truncated at the end "
+                     "of %s (every complete record above was "
+                     "recovered)"
+                     % (nbytes, "" if nbytes == 1 else "s", seg))
     lines.append("")
     lines.append("| kind | count |")
     lines.append("|---|---|")
@@ -501,7 +539,23 @@ def summarize_pyprof(source, top_n=15):
     return "\n".join(lines)
 
 
+def _pop_opt(argv, name):
+    """Remove ``name VALUE`` from argv and return VALUE (or None)."""
+    if name not in argv:
+        return None
+    i = argv.index(name)
+    if i + 1 >= len(argv):
+        raise SystemExit(__doc__)
+    value = argv[i + 1]
+    del argv[i:i + 2]
+    return value
+
+
 if __name__ == "__main__":
+    argv = sys.argv[1:]
+    rid = _pop_opt(argv, "--rid")
+    kind = _pop_opt(argv, "--kind")
+    sys.argv = sys.argv[:1] + argv
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
     if sys.argv[1] in ("--journal", "--roofline", "--ledger",
@@ -512,8 +566,10 @@ if __name__ == "__main__":
             top = int(sys.argv[3]) if len(sys.argv) > 3 else 15
             print(summarize_pyprof(sys.argv[2], top))
             sys.exit(0)
-        mode = {"--journal": summarize_journal,
-                "--roofline": summarize_roofline,
+        if sys.argv[1] == "--journal":
+            print(summarize_journal(sys.argv[2], rid=rid, kind=kind))
+            sys.exit(0)
+        mode = {"--roofline": summarize_roofline,
                 "--ledger": summarize_ledger,
                 "--timeseries": summarize_timeseries}[sys.argv[1]]
         print(mode(sys.argv[2]))

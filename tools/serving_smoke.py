@@ -1,4 +1,4 @@
-"""CI smoke: the serving tier end to end, in nine acts.
+"""CI smoke: the serving tier end to end, in ten acts.
 
 **Act 1 — single engine (the PR 2 contract):** train a tiny wine
 model, snapshot it, bring up the HTTP front end, fire 64 CONCURRENT
@@ -126,6 +126,22 @@ flags), under act-2-style mixed loadgen traffic:
   ``socket_io``) are live under JSON traffic,
 * the sampler's own self-metered overhead stays under the ceiling
   on every replica process (direct per-replica captures).
+
+**Act 10 — the durable blackbox (ISSUE 19):** a fresh 2-replica
+fleet with the crash-safe on-disk blackbox armed on BOTH halves
+(router through ``root.common``, replicas through forwarded
+``--config`` flags), every process writing through to ONE shared
+segment dir, under seeded deterministic-rid loadgen traffic:
+
+* one replica is SIGKILLed mid-burst (the fleet keeps answering),
+* a FRESH ``python -m znicz_tpu obs --rid <rid> --json`` process —
+  knowing nothing but the segment dir — reconstructs a traced
+  request END TO END from disk alone: the router's persisted tree
+  and a replica's persisted tree re-stitched into one cross-process
+  trace with both sides' span kinds,
+* ``obs --postmortem replica`` bundles the KILLED replica's boot:
+  its final journal events, its last timeseries checkpoint and its
+  persisted trace rids survive the SIGKILL.
 
 **Act 4 — the batch-1 latency fast path (ISSUE 12):** the SAME wine
 snapshot served strict (f32) and fast (f32-fast) behind one registry:
@@ -273,6 +289,7 @@ def main():
     fleet_obs_smoke(tmp)
     release_smoke(tmp)
     pyprof_smoke(tmp)
+    blackbox_smoke(tmp)
 
 
 def _second_model_package(tmp):
@@ -1286,6 +1303,173 @@ def pyprof_smoke(tmp):
         router.stop()
         ppcfg.enabled = saved
         pyprof.reset()
+
+
+def blackbox_smoke(tmp):
+    """Act 10: the durable blackbox over a live 2-replica fleet
+    (ISSUE 19) — router + both replicas write through to ONE shared
+    segment dir, one replica is SIGKILLed mid-burst, and a fresh
+    ``python -m znicz_tpu obs`` process reconstructs a traced
+    request end to end from the on-disk segments alone."""
+    import subprocess
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import loadgen
+    from znicz_tpu.core import blackbox, timeseries
+    from znicz_tpu.serving import reqtrace
+    from znicz_tpu.serving.router import FleetRouter
+    from znicz_tpu.testing import build_fc_package_zip
+
+    telemetry.reset()
+    timeseries.reset()
+    reqtrace.reset()
+    blackbox.reset()
+    bb_dir = os.path.join(tmp, "bb")
+    cfg = root.common.serving
+    bbcfg = root.common.telemetry.blackbox
+    saved = (cfg.get("trace_sample_n", 0),
+             cfg.get("slo_enabled", False),
+             bbcfg.get("enabled", False), bbcfg.get("dir", None),
+             bbcfg.get("role", None))
+    # the act-7/9 one-knob-two-processes pattern: the router half
+    # arms through root.common in THIS process (HttpServerBase.start
+    # calls maybe_arm), the replica halves through forwarded --config
+    # flags — every process appends to the SAME segment dir
+    cfg.trace_sample_n = 1
+    cfg.slo_enabled = True
+    bbcfg.enabled = True
+    bbcfg.dir = bb_dir
+    bbcfg.role = "router"
+    zip_path = build_fc_package_zip(
+        os.path.join(tmp, "bb_model.zip"), [20, 64, 4], seed=44)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    router = FleetRouter(
+        ["m=" + zip_path, "--max-batch", str(MAX_BATCH),
+         "--timeout-ms", "0", "--queue-limit", "96",
+         "--config", "common.serving.trace_sample_n=1",
+         "--config", "common.serving.slo_enabled=True",
+         "--config", "common.telemetry.timeseries.enabled=True",
+         "--config", "common.telemetry.timeseries.interval_ms=100.0",
+         "--config", "common.telemetry.blackbox.enabled=True",
+         "--config", "common.telemetry.blackbox.dir=" + bb_dir,
+         "--config", "common.telemetry.blackbox.role=replica",
+         "--config",
+         "common.telemetry.blackbox.checkpoint_every_sweeps=2"],
+        replicas=2,
+        compile_cache_dir=os.path.join(tmp, "bb_cache"),
+        env=env).start()
+    url = "http://127.0.0.1:%d" % router.port
+    try:
+        assert blackbox.armed(), "the router half never armed"
+        models = loadgen.discover_models(url)
+        pool = loadgen.DaemonPool(32)
+        submit = loadgen.http_submit(url, pool, binary=True,
+                                     rid_prefix="smokebb")
+        # burst 1: a quiet 2-replica fleet, every request traced
+        # (sample_n=1) and its tree persisted at finish
+        report = loadgen.run(
+            loadgen.make_plan(60.0, 2.0, 13, models),
+            models, submit, 2000.0, 2.0, 13)
+        assert report["ok"] > 0, report
+        ups = [r for r in router.replicas() if r.state == "up"]
+        assert len(ups) == 2
+        victim = ups[0]
+        victim_pid = victim.proc.pid
+        # mid-burst SIGKILL under load (the act-6 pattern): the
+        # victim dies mid-write — its segments stay recoverable
+        burst = {}
+
+        def run_burst():
+            burst["report"] = loadgen.run(
+                loadgen.make_plan(60.0, 2.0, 17, models),
+                models, submit, 2000.0, 2.0, 17)
+
+        t = threading.Thread(target=run_burst,
+                             name="znicz:smoke-bb-burst")
+        t.start()
+        time.sleep(0.7)
+        victim.proc.kill()
+        t.join(timeout=120)
+        assert burst["report"]["ok"] > 0, burst
+        deadline = time.monotonic() + 15
+        while victim.state != "dead" and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert victim.state == "dead"
+        # pick a rid that left BOTH a router tree and a replica tree
+        # on disk, preferring one recorded by the now-dead victim
+        records, _ = blackbox.read_all(bb_dir)
+        router_rids, replica_rids, victim_rids = set(), set(), set()
+        for source, rec in records:
+            if rec.get("bb") != "trace":
+                continue
+            rid = rec.get("rid")
+            if source.startswith("router."):
+                router_rids.add(rid)
+            else:
+                replica_rids.add(rid)
+                if source.startswith("replica.%d." % victim_pid):
+                    victim_rids.add(rid)
+        both = router_rids & replica_rids
+        assert both, "no rid persisted on both sides: %d router / " \
+            "%d replica trees" % (len(router_rids), len(replica_rids))
+        pick = sorted(both & victim_rids) or sorted(both)
+        rid = pick[0]
+        from_victim = rid in victim_rids
+        # the CLI exactly as an operator would run it: a FRESH
+        # process that knows nothing but the dir — the whole
+        # reconstruction is disk-only
+        sub_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=repo)
+        proc = subprocess.run(
+            [sys.executable, "-m", "znicz_tpu", "obs",
+             "--dir", bb_dir, "--rid", rid, "--json"],
+            capture_output=True, text=True, timeout=120, env=sub_env)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["rid"] == rid
+        assert len(out["traces"]) >= 2, out["traces"]
+        stitched = out["stitched"]
+        assert stitched, "router + replica trees did not re-stitch"
+        kinds = set(stitched["span_kinds"])
+        assert {"admission", "dispatch", "reply"} <= kinds, kinds
+        assert set(reqtrace.ROUTER_REQUIRED_KINDS) <= kinds, kinds
+        # the postmortem bundle for the KILLED replica, same CLI:
+        # its final events, last checkpoint and trace rids survived
+        proc = subprocess.run(
+            [sys.executable, "-m", "znicz_tpu", "obs",
+             "--dir", bb_dir, "--postmortem", "replica", "--json"],
+            capture_output=True, text=True, timeout=120, env=sub_env)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        pm = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert pm["pid"] == victim_pid, \
+            "postmortem picked pid %s, victim was %d" % (
+                pm.get("pid"), victim_pid)
+        assert pm["events"], "no journal events survived the kill"
+        assert pm["last_checkpoint"], \
+            "no timeseries checkpoint survived the kill"
+        assert pm["trace_rids"], "no trace rids survived the kill"
+        print("blackbox smoke OK: %d+%d requests through an armed "
+              "2-replica fleet, shared dir %s, SIGKILL pid %d -> "
+              "obs --rid %s (from the %s) re-stitched %d span kinds "
+              "from disk; postmortem: %d events, checkpoint sweep "
+              "%s, %d trace rids%s"
+              % (report["ok"], burst["report"]["ok"],
+                 os.path.basename(bb_dir), victim_pid, rid,
+                 "dead victim" if from_victim else "survivor",
+                 len(kinds), len(pm["events"]),
+                 pm["last_checkpoint"].get("sweeps"),
+                 len(pm["trace_rids"]),
+                 "" if not pm["torn"] else
+                 ", torn tails %r" % pm["torn"]))
+    finally:
+        router.stop()
+        (cfg.trace_sample_n, cfg.slo_enabled, bbcfg.enabled,
+         bbcfg.dir, bbcfg.role) = saved
+        blackbox.reset()
+        timeseries.reset()
+        reqtrace.reset()
 
 
 if __name__ == "__main__":

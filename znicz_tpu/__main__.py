@@ -167,6 +167,12 @@ def main(argv=None):
         # profiler stack (core/profiler.py)
         from znicz_tpu.core.profiler import cli_main as profile_main
         return profile_main(argv[1:])
+    if argv and argv[0] == "obs":
+        # durable blackbox queries: merged cross-process timeline,
+        # --rid request reconstruction, cross-restart --rate, and
+        # --postmortem bundles (core/blackbox.py)
+        from znicz_tpu.core.blackbox import cli_main as obs_main
+        return obs_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m znicz_tpu",
         description="Run a znicz_tpu workflow (module path, file, or "

@@ -63,7 +63,12 @@ import re
 #: first dotted segment of every legal telemetry series name — extend
 #: ONLY with a reviewed family prefix (each series is a /metrics entry)
 SERIES_PREFIXES = frozenset((
-    "analysis", "faults",
+    "analysis",
+    # the durable blackbox (ISSUE 19): writer meters — records/bytes
+    # persisted, segment rotations, retention deletions, torn tails
+    # found on recovery (core/blackbox.py)
+    "blackbox",
+    "faults",
     # the multi-replica serving fleet (ISSUE 15): replica-count
     # gauges + autoscaler decision counters (serving/router.py,
     # serving/autoscaler.py) and the front-end router's proxy/retry
@@ -170,6 +175,10 @@ GATED_MODULES = {
     "znicz_tpu/serving/reqtrace.py": {
         "gates": ("enabled", "sampled"),
         "required": ("begin",),
+    },
+    "znicz_tpu/core/blackbox.py": {
+        "gates": ("enabled",),
+        "required": ("maybe_arm",),
     },
 }
 

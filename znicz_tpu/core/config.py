@@ -251,6 +251,32 @@ declare("common", {
             "prefixes":
                 "serving,slo,jax,trainer,transfer,loader,pyprof",
         },
+        # durable blackbox (core/blackbox.py) — crash-safe on-disk
+        # persistence for the journal/timeseries/SLO/trace planes as
+        # length-delimited JSONL segments <role>.<pid>.<boot>.<nnn>
+        # under ONE shared dir, queried by `python -m znicz_tpu obs`.
+        # Off by default; when off maybe_arm() is ONE config predicate
+        # and the process never touches the filesystem.
+        "blackbox": {
+            "enabled": False,
+            "dir": None,              # default: <cache>/blackbox —
+                                      # the fleet router pins its
+                                      # resolved dir into every
+                                      # replica so all processes share
+            "role": None,             # segment-name role; the fleet
+                                      # forwards "replica"/"router",
+                                      # else the arming call site's
+                                      # default wins
+            "segment_bytes": 1 << 20,  # rotate (fsync file, then dir)
+                                       # past this size
+            "retention_bytes": 64 << 20,  # delete oldest whole
+                                          # segments (never the live
+                                          # one) past this dir total;
+                                          # 0 disables retention
+            "checkpoint_every_sweeps": 5,  # persist the timeseries
+                                           # frontier every Nth
+                                           # sampler sweep
+        },
     },
     # numeric training-health monitor (core/health.py) — off by default;
     # when off every check site is a single predicate with ZERO device

@@ -157,7 +157,11 @@ class HandlerBase(BaseHTTPRequestHandler):
 
         * ``GET /debug/health`` — the health monitor's status JSON
           (healthz-style: 503 once a violation has been recorded),
-        * ``GET /debug/events`` — the flight-recorder journal,
+        * ``GET /debug/events`` — the flight-recorder journal
+          (``?n=`` newest-N cap, default 256; ``?kind=`` prefix
+          filter; ``?rid=`` follows one request),
+        * ``GET /debug/blackbox`` — the durable blackbox's writer
+          stats and segment inventory (``core/blackbox.py``),
         * ``GET /debug/profile?seconds=N`` — capture a ``jax.profiler``
           device trace for N seconds (capped by
           ``root.common.profiler.capture_seconds_cap``) and reply with
@@ -212,9 +216,38 @@ class HandlerBase(BaseHTTPRequestHandler):
             self._send_json(200 if st.get("ok", True) else 503, st)
             return True
         if path == "/debug/events":
+            from urllib.parse import parse_qs
+            qs = parse_qs(query)
+            try:
+                n = int(qs.get("n", ["256"])[0])
+            except ValueError:
+                self._send_json(400, {"error": "n must be an "
+                                               "integer"})
+                return True
+            kind = qs.get("kind", [None])[0]
+            rid = qs.get("rid", [None])[0]
+            events = telemetry.journal_events()
+            total = len(events)
+            if kind:
+                events = [e for e in events
+                          if str(e.get("kind", "")).startswith(kind)]
+            if rid:
+                events = [e for e in events
+                          if rid in (e.get("rid"),
+                                     e.get("exemplar_rid"),
+                                     e.get("request_id"))]
+            matched = len(events)
+            if n > 0:
+                events = events[-n:]
             self._send_json(200,
-                            {"events": telemetry.journal_events(),
+                            {"events": events,
+                             "total": total,
+                             "matched": matched,
                              "dropped": telemetry.journal_dropped()})
+            return True
+        if path == "/debug/blackbox":
+            from znicz_tpu.core import blackbox
+            self._send_json(200, blackbox.stats())
             return True
         if path == "/debug/faults":
             from znicz_tpu.core import faults
@@ -350,8 +383,10 @@ class HttpServerBase(Logger):
         # single predicate when off)
         from znicz_tpu.core import timeseries
         from znicz_tpu.core import pyprof
+        from znicz_tpu.core import blackbox
         timeseries.maybe_start()
         pyprof.maybe_start()
+        blackbox.maybe_arm()
         self.info("%s on http://%s:%d/", type(self).__name__,
                   self.host, self.port)
         return self

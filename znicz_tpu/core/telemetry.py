@@ -254,17 +254,37 @@ def export_trace(path):
 
 def journal_enabled():
     """The flight recorder records when telemetry, the health monitor,
-    the fault-injection registry OR the serving SLO tracker is on — a
-    health-only run still wants its black box, a chaos run must
-    journal what it injected and how recovery went, and an SLO-only
-    run must land its ``slo.burn`` threshold crossings."""
+    the fault-injection registry, the serving SLO tracker OR the
+    durable blackbox is on — a health-only run still wants its black
+    box, a chaos run must journal what it injected and how recovery
+    went, an SLO-only run must land its ``slo.burn`` threshold
+    crossings, and an armed blackbox (core/blackbox.py) needs events
+    to flow so its write-through sink can persist them."""
     if _cfg.get("enabled", False):
         return True
     if root.common.health.get("enabled", False):
         return True
     if root.common.faults.get("enabled", False):
         return True
-    return bool(root.common.serving.get("slo_enabled", False))
+    if root.common.serving.get("slo_enabled", False):
+        return True
+    return bool(_cfg.blackbox.get("enabled", False))
+
+
+#: write-through sink: when the durable blackbox arms it installs a
+#: callable here and every journal event ALSO lands on disk at emit
+#: time (core/blackbox.py) — a ring-dump-at-crash cannot help a
+#: SIGKILLed process.  None (one pointer compare on the emit path)
+#: in every unarmed process.
+_journal_sink = None
+
+
+def set_journal_sink(fn):
+    """Install (or, with None, remove) the durable write-through
+    journal sink.  Sink exceptions are swallowed at the emit site —
+    instrumentation must never take down the instrumented."""
+    global _journal_sink
+    _journal_sink = fn
 
 
 def record_event(kind, **fields):
@@ -280,6 +300,12 @@ def record_event(kind, **fields):
           "kind": kind}
     ev.update(fields)
     _journal.append(ev)
+    sink = _journal_sink
+    if sink is not None:
+        try:
+            sink(ev)
+        except Exception:  # noqa: BLE001 - never fail the emitter
+            logger.debug("journal sink failed", exc_info=True)
     return ev
 
 
@@ -337,11 +363,17 @@ def write_crash_report(reason="unhandled-exception", exc_info=None,
     if exc_info and exc_info[0] is not None:
         with open(os.path.join(path, "traceback.txt"), "w") as f:
             f.write("".join(traceback.format_exception(*exc_info)))
+    try:
+        from znicz_tpu.core import blackbox
+        blackbox_segment = blackbox.current_segment()
+    except Exception:  # noqa: BLE001 - a crash dump must not crash
+        blackbox_segment = None
     with open(os.path.join(path, "report.json"), "w") as f:
         json.dump({"reason": str(reason), "time": time.time(),
                    "pid": os.getpid(),
                    "journal_events": len(_journal),
-                   "journal_dropped": _journal.dropped}, f, indent=2)
+                   "journal_dropped": _journal.dropped,
+                   "blackbox_segment": blackbox_segment}, f, indent=2)
     logger.error("crash report -> %s (%s)", path, reason)
     return path
 

@@ -142,7 +142,38 @@ def sample_once(now=None):
     if telemetry.enabled():
         telemetry.counter("timeseries.sweeps").inc()
         telemetry.gauge("timeseries.series").set(len(_series))
+    sink = _checkpoint_sink
+    if sink is not None:
+        try:
+            sink(_sweeps, t)
+        except Exception:  # noqa: BLE001 - never fail the sampler
+            pass
     return touched
+
+
+#: durable-checkpoint sink: the blackbox (core/blackbox.py) installs
+#: a ``fn(sweeps, now)`` here when armed and persists
+#: :func:`last_points` every Nth sweep, so rate() queries survive
+#: process restarts.  None (one pointer compare) when unarmed.
+_checkpoint_sink = None
+
+
+def set_checkpoint_sink(fn):
+    """Install (or, with None, remove) the per-sweep checkpoint
+    sink."""
+    global _checkpoint_sink
+    _checkpoint_sink = fn
+
+
+def last_points():
+    """The newest point of every ring —
+    ``{name: {"kind", "t", "v"}}`` — the blackbox checkpoint payload
+    (a checkpoint needs only the frontier: the previous checkpoints
+    already persisted the history)."""
+    with _lock:
+        return {s.name: {"kind": s.kind,
+                         "t": s.points[-1][0], "v": s.points[-1][1]}
+                for s in _series.values() if s.points}
 
 
 def _run():

@@ -100,12 +100,14 @@ class Autoscaler(Logger):
 
     # -- the policy (pure) --------------------------------------------------
     def decide(self, alive, burn_fast, burn_slow, budget_remaining,
-               queue_rows, now=None):
+               queue_rows, now=None, exemplar_rid=None):
         """One decision: ``(action, reason)``.  ``alive`` counts the
         replicas that exist (up or spawning); burn/budget are the
         fleet aggregates (None = no traffic yet); ``queue_rows`` is
-        the fleet-wide queued-row total.  Mutates only the hysteresis
-        streak + cooldown bookkeeping."""
+        the fleet-wide queued-row total.  ``exemplar_rid`` (the
+        worst-burning model's last bad request) is carried into the
+        journaled decision record, never used by the policy.  Mutates
+        only the hysteresis streak + cooldown bookkeeping."""
         k = self.knobs()
         now = self._clock() if now is None else now
         in_cooldown = (self._last_action_t is not None and
@@ -158,7 +160,7 @@ class Autoscaler(Logger):
     def _signals(self):
         """Gather the live fleet inputs for one decision."""
         slo = self.fleet.aggregate_slo()
-        burn_fast = burn_slow = budget = None
+        burn_fast = burn_slow = budget = exemplar = None
         for m in (slo.get("models") or {}).values():
             for window, var in (("fast", "burn_fast"),
                                 ("slow", "burn_slow")):
@@ -166,8 +168,12 @@ class Autoscaler(Logger):
                 if burn is None:
                     continue
                 if var == "burn_fast":
-                    burn_fast = burn if burn_fast is None else \
-                        max(burn_fast, burn)
+                    if burn_fast is None or burn > burn_fast:
+                        burn_fast = burn
+                        # the worst-burning model's last bad request:
+                        # the rid a postmortem follows from the
+                        # journaled decision into the trace plane
+                        exemplar = m.get("exemplar_rid") or exemplar
                 else:
                     burn_slow = burn if burn_slow is None else \
                         max(burn_slow, burn)
@@ -180,6 +186,7 @@ class Autoscaler(Logger):
             "burn_slow": burn_slow,
             "budget_remaining": budget,
             "queue_rows": self.fleet.queued_rows_total(),
+            "exemplar_rid": exemplar,
         }
 
     def step(self):
@@ -192,12 +199,16 @@ class Autoscaler(Logger):
                       t=round(now, 3))
         with self._lock:
             self._last = record
+        # the journal stamps its own wall-clock "t" — the record's
+        # monotonic "t" (kept for /statusz) must not clobber it, or
+        # the blackbox's merged cross-process timeline missorts
+        journal = {k: v for k, v in record.items() if k != "t"}
         if telemetry.enabled():
             telemetry.counter("fleet.autoscaler_decisions").inc()
-        telemetry.record_event("autoscaler.decision", **record)
+        telemetry.record_event("autoscaler.decision", **journal)
         if action == SCALE_UP:
             self._last_action_t = now
-            telemetry.record_event("autoscaler.scale_up", **record)
+            telemetry.record_event("autoscaler.scale_up", **journal)
             if telemetry.enabled():
                 telemetry.counter("fleet.autoscaler_scale_ups").inc()
             self.info("scaling up: %s", reason)
@@ -209,7 +220,7 @@ class Autoscaler(Logger):
         elif action == SCALE_DOWN:
             self._last_action_t = now
             self._green_streak = 0
-            telemetry.record_event("autoscaler.scale_down", **record)
+            telemetry.record_event("autoscaler.scale_down", **journal)
             if telemetry.enabled():
                 telemetry.counter(
                     "fleet.autoscaler_scale_downs").inc()

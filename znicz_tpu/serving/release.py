@@ -708,7 +708,8 @@ class ReleaseController(Logger):
         telemetry.record_event(
             "release.advance", model=rel.model,
             candidate=rel.cand_name, step=rel.step_idx,
-            canary_pct=rel.canary_pct, signals=signals)
+            canary_pct=rel.canary_pct, signals=signals,
+            exemplar_rid=rel.last_mismatch_rid)
         self._note_state(rel)
         self.info("release of %r advanced to canary step %d "
                   "(%.4g%% of traffic)", rel.model, rel.step_idx,

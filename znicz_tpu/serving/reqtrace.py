@@ -219,6 +219,20 @@ def set_model(rid, model):
             tr.model = model
 
 
+#: trace-persistence sink: the durable blackbox (core/blackbox.py)
+#: installs a ``fn(rid, tree)`` here when armed; every closed
+#: head-sampled tree is then persisted at finish time, so a SIGKILLed
+#: replica's sampled traces survive it.  None (one pointer compare on
+#: the finish path) when unarmed.
+_finish_sink = None
+
+
+def set_finish_sink(fn):
+    """Install (or, with None, remove) the finish-time trace sink."""
+    global _finish_sink
+    _finish_sink = fn
+
+
 def finish(rid, now=None, model=None):
     """Close the tree (stamps the total wall time)."""
     t = float(now if now is not None else time.monotonic())
@@ -229,6 +243,12 @@ def finish(rid, now=None, model=None):
         tr.t_end = t
         if model is not None:
             tr.model = model
+    sink = _finish_sink
+    if sink is not None:
+        try:
+            sink(rid, get(rid))
+        except Exception:  # noqa: BLE001 - never fail the request
+            pass
     return True
 
 

@@ -96,6 +96,7 @@ import argparse
 import io
 import json
 import math
+import os
 import time
 import uuid
 
@@ -104,7 +105,7 @@ import numpy
 from znicz_tpu.core.config import root
 from znicz_tpu.core.status_server import (BodyTooLargeError, HandlerBase,
                                           HttpServerBase)
-from znicz_tpu.core import compile_cache, pyprof, telemetry
+from znicz_tpu.core import blackbox, compile_cache, pyprof, telemetry
 from znicz_tpu.serving import reqtrace, slo
 from znicz_tpu.serving.batcher import (BatcherStoppedError, MicroBatcher,
                                        QueueFullError,
@@ -812,6 +813,17 @@ def _fleet_main(args, raw_argv):
         # first deserializes the shared cache instead of compiling
         replica_argv += ["--compile-cache",
                          compile_cache.configured_dir()]
+    if blackbox.enabled():
+        # the fleet shares ONE blackbox dir: arm the router under the
+        # "router" role, pin the RESOLVED dir into every replica (a
+        # relative --config dir or a changed dirs.cache must not
+        # shear the fleet apart), and hand replicas their role so
+        # `obs --postmortem replica` means what it says
+        blackbox.maybe_arm("router")
+        bb_dir = os.path.abspath(blackbox.configured_dir())
+        replica_argv += [
+            "--config", "common.telemetry.blackbox.dir=%s" % bb_dir,
+            "--config", "common.telemetry.blackbox.role=replica"]
     router = FleetRouter(
         replica_argv, replicas=args.fleet,
         port=(args.port if args.port is not None
@@ -937,6 +949,11 @@ def main(argv=None):
 
     telemetry.enable()  # /metrics should work out of the box
     pyprof.name_current_thread("serve-main")  # sampler attribution
+    # arm the durable blackbox BEFORE the engines build, so startup
+    # milestones land on disk too (a fleet replica arrives here with
+    # role=replica pinned into its config by _fleet_main; a plain
+    # serve arms as "serve"; one predicate when the knob is off)
+    blackbox.maybe_arm("serve")
     if args.compile_cache is not None:
         compile_cache.enable(args.compile_cache or None)
     else:

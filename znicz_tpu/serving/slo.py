@@ -185,10 +185,12 @@ class SloTracker(object):
             over = (burn_fast is not None and burn_slow is not None
                     and burn_fast >= k["threshold"]
                     and burn_slow >= k["threshold"])
-            crossed = over and not m.burning
+            was_burning = m.burning
+            crossed = over and not was_burning
             m.burning = over if over else (
                 m.burning and burn_fast is not None
                 and burn_fast >= k["threshold"])
+            cleared = was_burning and not m.burning
             exemplar = m.last_bad_rid
         if telemetry.enabled():
             telemetry.counter(telemetry.labeled(
@@ -210,6 +212,16 @@ class SloTracker(object):
                 "slo.burn", model=model,
                 burn_fast=round(burn_fast, 3),
                 burn_slow=round(burn_slow, 3),
+                threshold=k["threshold"],
+                budget_remaining=round(remaining, 4),
+                exemplar_rid=exemplar)
+        elif cleared:
+            # the incident's other edge: without it a durable journal
+            # (core/blackbox.py) shows burns that apparently never end
+            telemetry.record_event(
+                "slo.burn_over", model=model,
+                burn_fast=(round(burn_fast, 3)
+                           if burn_fast is not None else None),
                 threshold=k["threshold"],
                 budget_remaining=round(remaining, 4),
                 exemplar_rid=exemplar)
