@@ -1,0 +1,346 @@
+"""The binary framed relay end to end (ISSUE 20): a REAL 2-replica
+fleet served over the wire by default — bit-identical replies across
+codecs, typed error parity with HTTP, the stitched trace's wire span
+kinds, the zero-copy frame→engine buffer-identity pin, and the
+mid-dispatch SIGKILL retry-safety contract over frames."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from znicz_tpu.core.config import root
+from znicz_tpu.serving import wire
+from znicz_tpu.serving.router import FleetRouter
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENV = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+MAX_BATCH = 8
+N_IN, N_OUT = 6, 3
+
+
+def _synth_zip(directory):
+    from znicz_tpu.testing import build_fc_package_zip
+    return build_fc_package_zip(os.path.join(directory, "synth.zip"),
+                                [N_IN, 8, N_OUT], seed=42)
+
+
+def _x(seed, rows=2):
+    return numpy.random.RandomState(seed).uniform(
+        -1.0, 1.0, (rows, N_IN))
+
+
+def _predict_json(url, x, rid=None, model="m", timeout=60):
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        url + "/predict/" + model,
+        json.dumps({"inputs": numpy.asarray(x).tolist()}).encode(),
+        headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """One shared 2-replica fleet, relay at its shipped default
+    (ENABLED), tracing armed on BOTH halves (the router samples
+    in-process under root.common; the replicas through the forwarded
+    --config flag)."""
+    tmp = tmp_path_factory.mktemp("wire_fleet")
+    saved = root.common.serving.get("trace_sample_n", 0)
+    root.common.serving.trace_sample_n = 1
+    router = FleetRouter(
+        ["m=" + _synth_zip(str(tmp)), "--max-batch", str(MAX_BATCH),
+         "--config", "common.serving.trace_sample_n=1"],
+        replicas=2, compile_cache_dir=str(tmp / "cache"),
+        env=ENV).start()
+    url = "http://127.0.0.1:%d" % router.port
+    yield router, url
+    router.stop()
+    root.common.serving.trace_sample_n = saved
+
+
+def test_wire_ports_discovered_everywhere(fleet):
+    """Every replica advertises its relay port in /healthz and the
+    router stashed it at rotation entry; the router's own listener
+    advertises alongside."""
+    router, url = fleet
+    for r in router.replicas():
+        assert r.state == "up"
+        assert r.wire_port, "router never discovered %s's port" % r.rid
+        hz = _get(r.url, "/healthz")
+        assert hz["wire_port"] == r.wire_port
+    assert _get(url, "/healthz")["wire_port"] == router.wire_port
+
+
+def test_replies_bit_identical_across_codecs(fleet):
+    """The SAME inputs over (a) JSON/HTTP through the router (the
+    relay carries it as a frame underneath), (b) a raw .npy HTTP
+    body, and (c) a direct binary frame at the router's listener —
+    all three replies identical; the JSON schema byte-for-byte."""
+    _, url = fleet
+    x = numpy.ascontiguousarray(_x(99, rows=3))
+    code, json_doc = _predict_json(url, x, rid="codec-json")
+    assert code == 200 and json_doc["model"] == "m"
+
+    body = wire.npy_bytes(x)
+    req = urllib.request.Request(
+        url + "/predict/m", body,
+        {"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+        import io
+        npy_out = numpy.load(io.BytesIO(resp.read()))
+    assert npy_out.tolist() == json_doc["outputs"]
+
+    conn = wire.WireConn("127.0.0.1",
+                         _get(url, "/healthz")["wire_port"],
+                         timeout=60)
+    try:
+        kind, meta, rbody = conn.request(
+            {"rid": "codec-wire", "model": "m"}, body, timeout=60)
+        assert kind == wire.KIND_RESPONSE and meta["status"] == 200
+        import io
+        wire_out = numpy.load(io.BytesIO(bytes(rbody)))
+        assert numpy.array_equal(wire_out, npy_out)
+        # reply="json": the SAME serializer the HTTP surface uses —
+        # schema equality, not just value closeness
+        kind, meta, rbody = conn.request(
+            {"rid": "codec-wirejson", "model": "m",
+             "reply": "json"}, body, timeout=60)
+        assert kind == wire.KIND_RESPONSE and meta["status"] == 200
+        wire_doc = json.loads(bytes(rbody))
+    finally:
+        conn.close()
+    assert wire_doc["outputs"] == json_doc["outputs"]
+    assert sorted(wire_doc) == sorted(json_doc)
+
+
+def test_error_frames_match_the_http_payload(fleet):
+    """Typed ERROR frames carry the exact JSON object the HTTP
+    surface answers — every error class maps 1:1 across codecs."""
+    _, url = fleet
+    try:
+        _predict_json(url, _x(1), model="nope")
+        raise AssertionError("unknown model answered 200")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        http_payload = json.loads(e.read())
+    conn = wire.WireConn("127.0.0.1",
+                         _get(url, "/healthz")["wire_port"],
+                         timeout=60)
+    try:
+        kind, meta, _ = conn.request(
+            {"rid": "err-404", "model": "nope"},
+            wire.npy_bytes(_x(1)), timeout=60)
+    finally:
+        conn.close()
+    assert kind == wire.KIND_ERROR
+    assert meta["status"] == 404
+    # same payload shape and same error text, modulo the per-request
+    # id the server stamps into both
+    payload = dict(meta["payload"], request_id=None)
+    assert payload == dict(http_payload, request_id=None)
+
+
+def test_stitched_trace_carries_the_wire_span_kinds(fleet):
+    """With tracing armed fleet-wide, a relayed request's stitched
+    tree shows BOTH new kinds: the router's relay_wait (nested in
+    relay_reply) and the replica's frame_decode (nested in
+    admission) — alongside the full HTTP-era vocabulary."""
+    _, url = fleet
+    rid = "wire-trace-1"
+    assert _predict_json(url, _x(5), rid=rid)[0] == 200
+    deadline = time.monotonic() + 15
+    tree = None
+    while time.monotonic() < deadline:
+        try:
+            tree = _get(url, "/debug/trace/" + rid)
+            if tree.get("stitched"):
+                break
+        except urllib.error.HTTPError:
+            pass
+        time.sleep(0.2)
+    assert tree and tree.get("stitched"), "no stitched tree for %s" % rid
+    spans = {s["kind"]: s for s in tree["spans"]}
+    assert "relay_wait" in spans, sorted(spans)
+    assert "frame_decode" in spans, sorted(spans)
+    # nesting: relay_wait inside relay_reply's window (router side)
+    rr, rw = spans["relay_reply"], spans["relay_wait"]
+    assert rr["start_ms"] <= rw["start_ms"] + 1e-6
+    assert rw["start_ms"] + rw["duration_ms"] <= \
+        rr["start_ms"] + rr["duration_ms"] + 1e-6
+    # frame_decode inside admission's window (replica side)
+    adm, fd = spans["admission"], spans["frame_decode"]
+    assert adm["start_ms"] <= fd["start_ms"] + 1e-6
+    assert fd["start_ms"] + fd["duration_ms"] <= \
+        adm["start_ms"] + adm["duration_ms"] + 1e-6
+    assert tree["complete"] is True
+
+
+def test_statusz_mux_and_replica_codec_split(fleet):
+    """The router's /statusz wire block proves the relay carried the
+    traffic; the replicas' codec split shows it arrived binary."""
+    router, url = fleet
+    for i in range(4):
+        assert _predict_json(url, _x(400 + i))[0] == 200
+    mux = _get(url, "/statusz")["wire"]
+    assert mux["port"] == router.wire_port
+    assert mux["round_trips"] > 0
+    assert mux["conns"] > 0
+
+    def counter(u, name):
+        with urllib.request.urlopen(u + "/metrics",
+                                    timeout=30) as resp:
+            for line in resp.read().decode().splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+        return 0.0
+
+    binary = sum(counter(
+        r.url, "znicz_serving_codec_requests_codec_binary")
+        for r in router.replicas() if r.state == "up")
+    assert binary > 0, "no replica counted a binary-codec request"
+    proto = sum(counter(r.url, "znicz_wire_protocol_errors")
+                for r in router.replicas() if r.state == "up")
+    assert proto == 0
+
+
+def test_zero_copy_frame_body_reaches_the_engine(tmp_path,
+                                                 monkeypatch):
+    """THE zero-copy pin: with a matching dtype and a full bucket,
+    the array the engine's predict receives SHARES MEMORY with the
+    array :func:`wire.parse_npy` materialized over the frame body —
+    the bytes the socket delivered are the bytes the engine consumes
+    (decoded exactly once, copied zero times)."""
+    from znicz_tpu.core import telemetry
+    from znicz_tpu.serving import ModelRegistry, ServingServer
+
+    telemetry.enable()
+    registry = ModelRegistry(models={"m": _synth_zip(str(tmp_path))},
+                             max_batch=MAX_BATCH)
+    server = ServingServer(registry=registry, port=0).start()
+    try:
+        assert server.wire_port, "replica listener never armed"
+        eng = registry.engine("m")
+        captured = {}
+        real_parse = wire.parse_npy
+
+        def spy_parse(buf):
+            arr = real_parse(buf)
+            captured.setdefault("parsed", arr)
+            return arr
+
+        real_predict = eng.predict
+
+        def spy_predict(x, request_ids=None):
+            captured.setdefault("engine_x", x)
+            return real_predict(x, request_ids=request_ids)
+
+        monkeypatch.setattr(wire, "parse_npy", spy_parse)
+        monkeypatch.setattr(eng, "predict", spy_predict)
+        # a FULL bucket in the engine's own dtype: asarray and the
+        # batcher's single-request assembly are both the identity
+        dtype = numpy.asarray(
+            real_predict(_x(1, rows=1))).dtype
+        x = _x(77, rows=MAX_BATCH).astype(dtype)
+        conn = wire.WireConn("127.0.0.1", server.wire_port,
+                             timeout=60)
+        try:
+            kind, meta, _ = conn.request(
+                {"rid": "zc-1", "model": "m"}, wire.npy_bytes(x),
+                timeout=60)
+        finally:
+            conn.close()
+        assert kind == wire.KIND_RESPONSE and meta["status"] == 200
+        assert "parsed" in captured and "engine_x" in captured
+        numpy.testing.assert_array_equal(captured["engine_x"], x)
+        assert numpy.shares_memory(captured["engine_x"],
+                                   captured["parsed"]), \
+            "the frame body was copied between decode and dispatch"
+    finally:
+        server.stop()
+
+
+def test_kill_mid_dispatch_over_the_wire_honest_error(tmp_path):
+    """The retry-safety pin over FRAMES: a stall fault holds the
+    dispatch, the replica is SIGKILLed mid-flight, and the binary
+    client receives a typed ERROR frame carrying the same honest
+    'admission unknowable' 503 the HTTP surface answers — the peer's
+    oracle proves no duplicate dispatch."""
+    rules = ("{'serving.forward': {'kind': 'stall', "
+             "'stall_ms': 8000, 'at': 5}}")
+    router = FleetRouter(
+        ["m=" + _synth_zip(str(tmp_path)), "--max-batch",
+         str(MAX_BATCH),
+         "--config", "common.faults.enabled=True",
+         "--config", "common.faults.rules=" + rules],
+        replicas=2, compile_cache_dir=str(tmp_path / "cache"),
+        env=ENV).start()
+    url = "http://127.0.0.1:%d" % router.port
+    result = {}
+
+    def fire():
+        conn = wire.WireConn("127.0.0.1", router.wire_port,
+                             timeout=60)
+        try:
+            result["frame"] = conn.request(
+                {"rid": "wire-victim", "model": "m"},
+                wire.npy_bytes(_x(1)), timeout=60)
+        except Exception as e:  # noqa: BLE001 - asserted below
+            result["exc"] = e
+        finally:
+            conn.close()
+    try:
+        t = threading.Thread(target=fire)
+        t.start()
+        victim = peer = None
+        deadline = time.monotonic() + 30
+        while victim is None and time.monotonic() < deadline:
+            for r in router.replicas():
+                try:
+                    if _get(r.url,
+                            "/admitted/wire-victim")["admitted"]:
+                        victim = r
+                    else:
+                        peer = r
+                except (OSError, ValueError):
+                    pass
+            time.sleep(0.05)
+        assert victim is not None, "request never admitted anywhere"
+        victim.proc.kill()
+        t.join(timeout=60)
+        assert "frame" in result, result.get("exc")
+        kind, meta, _ = result["frame"]
+        assert kind == wire.KIND_ERROR, (kind, meta)
+        assert meta["status"] == 503
+        assert meta["payload"]["retry_safe"] is False
+        assert "retry unsafe" in meta["payload"]["error"]
+        assert _get(peer.url,
+                    "/admitted/wire-victim")["admitted"] is False
+        # the fleet keeps answering over frames (the peer's own
+        # stall rule may hold this reply — that is the fault)
+        conn = wire.WireConn("127.0.0.1", router.wire_port,
+                             timeout=60)
+        try:
+            kind, meta, _ = conn.request(
+                {"rid": "wire-after", "model": "m"},
+                wire.npy_bytes(_x(2)), timeout=60)
+        finally:
+            conn.close()
+        assert kind == wire.KIND_RESPONSE and meta["status"] == 200
+    finally:
+        router.stop()

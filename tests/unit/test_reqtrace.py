@@ -209,3 +209,60 @@ def test_stitch_clamps_a_jitter_inflated_replica_wall(traced):
     stitched = traced.stitch(traced.get("h5"), traced.get("rep2"),
                              replica="fleet-1")
     assert stitched["clock_offset_ms"] == pytest.approx(3.0)
+
+
+# -- the binary relay's span kinds (ISSUE 20) --------------------------------
+
+def test_wire_kinds_are_valid_on_both_origins(traced):
+    """``frame_decode`` and ``relay_wait`` are vocabulary on BOTH
+    sides (the replica decodes frames; the router waits on them) —
+    add_span must accept them where 'teleport' is loud."""
+    assert set(reqtrace.WIRE_SPAN_KINDS) == {"frame_decode",
+                                             "relay_wait"}
+    traced.begin("w0")
+    traced.add_span("w0", "frame_decode", 0.0, 0.001)
+    traced.finish("w0", now=0.01)
+    traced.begin("w1", origin="router")
+    traced.add_span("w1", "relay_wait", 0.0, 0.001)
+    traced.finish("w1", now=0.01)
+    assert traced.get("w0") and traced.get("w1")
+
+
+def test_frame_decode_nests_in_admission_partition_exact(traced):
+    """The replica-side frame decode nests INSIDE admission — the
+    six-kind partition must stay exact (parts_ms == wall_ms), the
+    wire kind adding detail, never double-counted time."""
+    t0 = 300.0
+    assert traced.begin("w2", now=t0) is True
+    traced.add_span("w2", "admission", t0, t0 + 0.002)
+    traced.add_span("w2", "frame_decode", t0 + 0.0005, t0 + 0.0015)
+    traced.add_span("w2", "queue_wait", t0 + 0.002, t0 + 0.003)
+    traced.add_span("w2", "assembly", t0 + 0.003, t0 + 0.004)
+    traced.add_span("w2", "dispatch", t0 + 0.004, t0 + 0.009)
+    traced.add_span("w2", "device", t0 + 0.005, t0 + 0.008)
+    traced.add_span("w2", "reply", t0 + 0.009, t0 + 0.010)
+    traced.finish("w2", now=t0 + 0.010, model="m")
+    tree = traced.get("w2")
+    assert tree["complete"] is True
+    assert tree["wall_ms"] == pytest.approx(10.0)
+    assert tree["parts_ms"] == pytest.approx(10.0), \
+        "frame_decode leaked into the partition sum"
+
+
+def test_relay_wait_nests_in_relay_reply_partition_exact(traced):
+    """The router-side frame wait nests INSIDE relay_reply — the hop
+    partition stays exact over the binary transport."""
+    t0 = 400.0
+    assert traced.begin("w3", now=t0, origin="router") is True
+    traced.add_span("w3", "route", t0, t0 + 0.001)
+    traced.add_span("w3", "conn_acquire", t0 + 0.001, t0 + 0.002)
+    traced.add_span("w3", "relay_send", t0 + 0.002, t0 + 0.003)
+    traced.add_span("w3", "replica_wait", t0 + 0.003, t0 + 0.012)
+    traced.add_span("w3", "relay_reply", t0 + 0.012, t0 + 0.016)
+    traced.add_span("w3", "relay_wait", t0 + 0.012, t0 + 0.015)
+    traced.finish("w3", now=t0 + 0.016, model="m")
+    tree = traced.get("w3")
+    assert tree["complete"] is True
+    assert tree["wall_ms"] == pytest.approx(16.0)
+    assert tree["parts_ms"] == pytest.approx(16.0), \
+        "relay_wait leaked into the partition sum"

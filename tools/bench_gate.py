@@ -84,7 +84,13 @@ GATED = ("value", "f32_images_per_sec", "cifar_caffe_images_per_sec",
          # plane that stops protecting the high lane, fails the
          # round like any throughput drop
          "serving_fleet_scaling_efficiency_pct",
-         "serving_priority_high_goodput_under_overload_pct")
+         "serving_priority_high_goodput_under_overload_pct",
+         # the binary framed relay (ISSUE 20): fleet wall_rps with
+         # the wire transport end to end (loadgen --wire binary →
+         # router mux → replica) — a relay that slows down, breaks,
+         # or silently falls back to HTTP fails the round like any
+         # throughput drop
+         "serving_wire_wall_rps")
 
 #: latency-style keys (lower is better): a RISE past the threshold
 #: fails; zero/missing when the previous round had a number fails too
@@ -427,6 +433,21 @@ def selftest(threshold=0.10):
         dict(bb_old, serving_blackbox_overhead_pct=1.6 *
              (1.0 + threshold)),
         bb_old, threshold)
+    # the binary-relay gate (ISSUE 20): the wire-transport fleet
+    # wall_rps fails on a drop past the band and on a VANISHED key
+    # (a relay that silently fell back to HTTP stops stamping — that
+    # must read as the regression it is); wobble inside the band
+    # passes.  Its hop-overhead sibling rides the inverted
+    # serving_router_hop_overhead_ms gate proven above (hop_rise /
+    # hop_zero)
+    wire_old = {"serving_wire_wall_rps": 900.0}
+    wr_drop, _ = compare(
+        dict(wire_old, serving_wire_wall_rps=900.0 * 0.85),
+        wire_old, threshold)
+    wr_gone, _ = compare({}, wire_old, threshold)
+    wr_wobble, _ = compare(
+        dict(wire_old, serving_wire_wall_rps=900.0 * 0.95),
+        wire_old, threshold)
     if ok_drop or ok_zero or ok_gone or not ok_wobble or not ok_up \
             or srv_drop or srv_p99_up or srv_p99_zero \
             or not srv_wobble or dt_drop or dt_gone or not dt_wobble \
@@ -439,7 +460,8 @@ def selftest(threshold=0.10):
             or pp_rise or pp_zero or not pp_wobble \
             or not pp_stamp_zero or not pp_stamp_gone \
             or pp_stamp_ok \
-            or bb_rise or bb_zero or not bb_wobble:
+            or bb_rise or bb_zero or not bb_wobble \
+            or wr_drop or wr_gone or not wr_wobble:
         print("bench_gate selftest FAILED: drop_rejected=%s "
               "zero_rejected=%s vanished_rejected=%s wobble_passed=%s "
               "improvement_passed=%s serving_drop_rejected=%s "
@@ -464,7 +486,8 @@ def selftest(threshold=0.10):
               "dataplane_missing_stamp_rejected=%s "
               "dataplane_good_stamp_passed=%s "
               "blackbox_rise_rejected=%s blackbox_zero_rejected=%s "
-              "blackbox_wobble_passed=%s"
+              "blackbox_wobble_passed=%s wire_drop_rejected=%s "
+              "wire_vanished_rejected=%s wire_wobble_passed=%s"
               % (not ok_drop, not ok_zero, not ok_gone, ok_wobble,
                  ok_up, not srv_drop, not srv_p99_up,
                  not srv_p99_zero, srv_wobble, not dt_drop,
@@ -476,7 +499,7 @@ def selftest(threshold=0.10):
                  rs_wobble, not pp_rise, not pp_zero, pp_wobble,
                  bool(pp_stamp_zero), bool(pp_stamp_gone),
                  not pp_stamp_ok, not bb_rise, not bb_zero,
-                 bb_wobble))
+                 bb_wobble, not wr_drop, not wr_gone, wr_wobble))
         return 1
     print("bench_gate selftest OK vs %s: 15%% drop / zero stamp / "
           "vanished key on %r rejected, 5%% wobble and +20%% "
@@ -495,9 +518,11 @@ def selftest(threshold=0.10):
           "pyprof sampler-overhead rise/zero-stamp rejected with "
           "wobble passing, a zero/missing "
           "serving_dataplane_python_pct stamp is caught by the "
-          "--assert-stamped path, and a blackbox write-through "
+          "--assert-stamped path, a blackbox write-through "
           "overhead rise/zero-stamp is rejected with its wobble "
-          "passing (threshold %.0f%%)"
+          "passing, and the binary-relay wall_rps drop and "
+          "vanished wire key are rejected with its wobble passing "
+          "(threshold %.0f%%)"
           % (os.path.basename(path), key, 100 * threshold))
     return 0
 

@@ -30,10 +30,10 @@ echo "== accuracy delta selftest (bf16/int8 pins hold; sabotaged int8 scales rej
 JAX_PLATFORMS=cpu python tools/accuracy_delta.py --selftest
 echo "== chaos smoke (SIGKILL mid-epoch -> resume bit-identical; breaker opens -> recovers)"
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
-echo "== serving smoke (wine over HTTP, 64 concurrent, 0 recompiles; then 2-model registry + loadgen SLO; then f32+int8 same-model precision act; then f32-fast batch-1 latency act; then SLO plane: budget burn + trace by rid + live timeseries; then 2-replica fleet: priority overload + mid-burst SIGKILL; then fleet tracing: stitched cross-process tree by rid + hop overhead + merged timeseries; then continuous profiling: fleet-merged /debug/pyprof, >=90% znicz:* attribution, live data-plane phases; then durable blackbox: mid-burst SIGKILL -> obs --rid re-stitches a traced request from disk + postmortem bundle)"
+echo "== serving smoke (wine over HTTP, 64 concurrent, 0 recompiles; then 2-model registry + loadgen SLO; then f32+int8 same-model precision act; then f32-fast batch-1 latency act; then SLO plane: budget burn + trace by rid + live timeseries; then 2-replica fleet: priority overload + mid-burst SIGKILL; then fleet tracing: stitched cross-process tree by rid + hop overhead + merged timeseries; then continuous profiling: fleet-merged /debug/pyprof, >=90% znicz:* attribution, live data-plane phases; then durable blackbox: mid-burst SIGKILL -> obs --rid re-stitches a traced request from disk + postmortem bundle; then binary framed relay: JSON + binary concurrently over a 2-replica fleet, bit-identical replies, per-codec telemetry separated)"
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
-echo "== serving fleet stamping (2-replica scaling efficiency + high-priority goodput under overload + armed fleet-tracing overhead + router hop overhead; crash-guarded zeros fail the gate)"
-JAX_PLATFORMS=cpu python bench.py --serving-fleet | python tools/bench_gate.py - --assert-stamped serving_fleet_scaling_efficiency_pct,serving_priority_high_goodput_under_overload_pct,serving_fleet_observability_overhead_pct,serving_router_hop_overhead_ms,serving_release_shadow_overhead_pct
+echo "== serving fleet stamping (2-replica scaling efficiency + high-priority goodput under overload + armed fleet-tracing overhead + router hop overhead + binary-relay wall_rps and hop speedup; crash-guarded zeros fail the gate)"
+JAX_PLATFORMS=cpu python bench.py --serving-fleet | python tools/bench_gate.py - --assert-stamped serving_fleet_scaling_efficiency_pct,serving_priority_high_goodput_under_overload_pct,serving_fleet_observability_overhead_pct,serving_router_hop_overhead_ms,serving_release_shadow_overhead_pct,serving_wire_wall_rps,serving_wire_hop_speedup_x
 echo "== serving tail-latency stamping (f32-fast batch-1 + per-scenario p99s; crash-guarded zeros fail the gate)"
 JAX_PLATFORMS=cpu python bench.py --serving-tail | python tools/bench_gate.py - --assert-stamped tail
 echo "== serving observability-overhead stamping (armed SLO plane vs disabled on the same HTTP mix; a crash-guarded zero fails the gate)"

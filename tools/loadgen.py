@@ -590,6 +590,98 @@ def http_submit(base_url, pool, binary=False, rid_prefix=None):
     return submit
 
 
+def discover_wire_port(base_url, timeout=10.0):
+    """The server's framed-relay port from ``GET /healthz`` (both the
+    replica and the fleet router publish ``wire_port`` there).  A
+    not-ready 503 still carries the payload."""
+    import urllib.error
+    import urllib.request
+    url = base_url.rstrip("/") + "/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            doc = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        doc = json.loads(e.read())
+    port = doc.get("wire_port")
+    if not port:
+        raise SystemExit(
+            "loadgen: %s reports no wire_port — the server runs "
+            "with common.serving.wire.enabled=False; use --wire "
+            "http" % url)
+    return int(port)
+
+
+def wire_submit(base_url, pool, rid_prefix=None):
+    """A ``submit(model, x, timeout_ms) -> Future`` over the binary
+    framed relay (serving/wire.py) — the client half of ``--wire
+    binary``.  The traffic is seed-identical to the HTTP modes (same
+    plan, same seeded input slices); only the transport differs:
+    one persistent connection per pool worker, a length-prefixed
+    REQUEST frame per request (rid/model/priority/timeout_ms in the
+    frame meta, the raw ``.npy`` body cached per ``(model, rows)``
+    exactly as ``--npy`` caches it), and the RESPONSE frame's
+    ``generation`` meta resolving the future — the same per-
+    generation attribution the HTTP header carries.  A request
+    failing on a stale parked connection retries once on a fresh
+    one; a typed ERROR frame raises its carried status verbatim."""
+    import io
+    import itertools
+    import urllib.parse
+
+    from znicz_tpu.serving import wire
+
+    parsed = urllib.parse.urlsplit(base_url)
+    port = discover_wire_port(base_url)
+    npy_cache = {}
+    local = threading.local()
+    rid_seq = itertools.count()  # count() is atomic under the GIL
+
+    def _body(model, x):
+        key = (model, x.shape[0])
+        body = npy_cache.get(key)
+        if body is None:
+            buf = io.BytesIO()
+            numpy.save(buf, numpy.ascontiguousarray(x))
+            body = npy_cache[key] = buf.getvalue()
+        return body
+
+    def _do(model, x, timeout_ms, priority):
+        body = _body(model, x)
+        meta = {"rid": "%s-%06d" % (rid_prefix or "wire",
+                                    next(rid_seq))}
+        if model is not None:
+            meta["model"] = model
+        if priority is not None:
+            meta["priority"] = priority
+        if timeout_ms:
+            meta["timeout_ms"] = timeout_ms
+        wait = (timeout_ms / 1e3 + 65.0) if timeout_ms else 120.0
+        for attempt in (0, 1):
+            conn = getattr(local, "conn", None)
+            if conn is None:
+                conn = wire.WireConn(parsed.hostname, port,
+                                     timeout=wait)
+                local.conn = conn
+            try:
+                kind, rmeta, _rbody = conn.request(meta, body,
+                                                   timeout=wait)
+            except (wire.WireProtocolError, OSError):
+                conn.close()
+                local.conn = None
+                if attempt:
+                    raise
+                continue  # stale parked connection: one fresh retry
+            status = int(rmeta.get("status", 500))
+            if status >= 400:
+                raise _HttpStatusError(status)
+            return rmeta.get("generation") or True
+
+    def submit(model, x, timeout_ms, priority=None):
+        return pool.submit(_do, model, x, timeout_ms, priority)
+
+    return submit
+
+
 class _HttpStatusError(Exception):
     def __init__(self, code):
         self.code = int(code)
@@ -627,6 +719,17 @@ def main(argv=None):
                              "capacity/fleet-scaling measurements; "
                              "note: per-request timeout_ms does not "
                              "ride in a binary body)")
+    parser.add_argument("--wire", default="http",
+                        choices=("http", "binary"),
+                        help="client transport: 'http' (default; "
+                             "--npy picks the body codec) or "
+                             "'binary' — the persistent framed "
+                             "relay (serving/wire.py) the router "
+                             "itself speaks to replicas, with rid/"
+                             "model/priority/timeout_ms in the "
+                             "frame meta.  Same seed = byte-"
+                             "identical traffic either way; only "
+                             "the transport differs")
     parser.add_argument("--priority-mix", default=None,
                         metavar="PRIO:W[,PRIO:W...]",
                         help="weighted per-request priority draw "
@@ -665,10 +768,14 @@ def main(argv=None):
     plan = make_plan(args.rate, args.duration, args.seed, models,
                      priority_mix=args.priority_mix)
     pool = DaemonPool(args.concurrency)
-    out = run(plan, models,
-              http_submit(args.url, pool, binary=args.npy), slo_ms,
+    if args.wire == "binary":
+        submit = wire_submit(args.url, pool)
+    else:
+        submit = http_submit(args.url, pool, binary=args.npy)
+    out = run(plan, models, submit, slo_ms,
               args.duration, args.seed, timeout_ms=args.timeout_ms)
     out["url"] = args.url
+    out["wire"] = args.wire
     out["models"] = [m.name or "<default>" for m in models]
     print(json.dumps(out))
     if args.assert_goodput_pct is not None:

@@ -1,4 +1,4 @@
-"""CI smoke: the serving tier end to end, in ten acts.
+"""CI smoke: the serving tier end to end, in eleven acts.
 
 **Act 1 — single engine (the PR 2 contract):** train a tiny wine
 model, snapshot it, bring up the HTTP front end, fire 64 CONCURRENT
@@ -142,6 +142,22 @@ segment dir, under seeded deterministic-rid loadgen traffic:
 * ``obs --postmortem replica`` bundles the KILLED replica's boot:
   its final journal events, its last timeseries checkpoint and its
   persisted trace rids survive the SIGKILL.
+
+**Act 11 — the binary framed relay (ISSUE 20):** a fresh 2-replica
+fleet at shipped defaults (the relay is ON), the SAME seeded inputs
+fired concurrently over the documented JSON/HTTP surface and as
+``--wire binary`` length-prefixed frames at the router's listener:
+
+* every JSON/binary reply pair for identical inputs is BIT-identical
+  (the replica answers both codecs through one serializer),
+* with the relay on, every router-relayed request lands on the
+  replicas as ONE binary frame — the replica-side
+  ``codec_requests`` split shows exactly the relayed count under
+  ``codec_binary`` while a direct replica HTTP request counts under
+  ``codec_http`` (the labels separate, never alias),
+* the router's ``/statusz`` mux block shows the round trips (the
+  relay really carried the storm) and the fleet's
+  ``wire.protocol_errors`` counter stays ZERO.
 
 **Act 4 — the batch-1 latency fast path (ISSUE 12):** the SAME wine
 snapshot served strict (f32) and fast (f32-fast) behind one registry:
@@ -290,6 +306,7 @@ def main():
     release_smoke(tmp)
     pyprof_smoke(tmp)
     blackbox_smoke(tmp)
+    wire_smoke(tmp)
 
 
 def _second_model_package(tmp):
@@ -1470,6 +1487,149 @@ def blackbox_smoke(tmp):
         blackbox.reset()
         timeseries.reset()
         reqtrace.reset()
+
+
+def wire_smoke(tmp):
+    """Act 11: the binary framed relay (ISSUE 20) over a live
+    2-replica fleet — the SAME seeded inputs fired CONCURRENTLY over
+    the documented JSON/HTTP surface and over ``--wire binary``
+    frames straight at the router's listener, replies bit-identical
+    pairwise; per-codec telemetry separated on the replicas; the
+    router's mux block proves every relayed request rode the wire
+    with zero protocol errors."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    from znicz_tpu.serving import wire
+    from znicz_tpu.serving.router import FleetRouter
+    from znicz_tpu.testing import build_fc_package_zip
+
+    telemetry.reset()
+    zip_path = build_fc_package_zip(
+        os.path.join(tmp, "wire_model.zip"), [12, 32, 5], seed=21)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    # shipped defaults: the relay is ON — nothing to arm
+    router = FleetRouter(
+        ["m=" + zip_path, "--max-batch", str(MAX_BATCH)],
+        replicas=2,
+        compile_cache_dir=os.path.join(tmp, "wire_cache"),
+        env=env).start()
+    url = "http://127.0.0.1:%d" % router.port
+    try:
+        ups = [r for r in router.replicas() if r.state == "up"]
+        assert len(ups) == 2
+        for r in ups:
+            assert r.wire_port, \
+                "replica %s never advertised a wire port" % r.rid
+        hz = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=10).read())
+        assert hz.get("wire_port"), \
+            "router /healthz carries no wire_port"
+
+        def seeded_x(i):
+            r = numpy.random.RandomState(500 + i)
+            return r.uniform(-1, 1, (1 + i % MAX_BATCH, 12))
+
+        n = 32
+        results = {}
+        errors = []
+
+        def json_client(i):
+            try:
+                req = urllib.request.Request(
+                    url + "/predict/m",
+                    json.dumps(
+                        {"inputs": seeded_x(i).tolist()}).encode(),
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    results[("json", i)] = json.loads(
+                        resp.read())["outputs"]
+            except Exception as e:  # noqa: BLE001 - asserted below
+                errors.append("json %d: %r" % (i, e))
+
+        def wire_client(i):
+            try:
+                conn = wire.WireConn("127.0.0.1", hz["wire_port"],
+                                     timeout=60)
+                try:
+                    kind, meta, body = conn.request(
+                        {"rid": "smoke-wire-%d" % i, "model": "m",
+                         "reply": "json"},
+                        wire.npy_bytes(
+                            numpy.ascontiguousarray(seeded_x(i))))
+                finally:
+                    conn.close()
+                assert kind == wire.KIND_RESPONSE \
+                    and meta["status"] == 200, (kind, meta)
+                results[("wire", i)] = json.loads(
+                    bytes(body))["outputs"]
+            except Exception as e:  # noqa: BLE001 - asserted below
+                errors.append("wire %d: %r" % (i, e))
+
+        threads = []
+        for i in range(n):
+            threads.append(threading.Thread(
+                target=json_client, args=(i,),
+                name="znicz:smoke-wire-json-%d" % i))
+            threads.append(threading.Thread(
+                target=wire_client, args=(i,),
+                name="znicz:smoke-wire-bin-%d" % i))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, "mixed-codec failures: %s" % errors[:5]
+        for i in range(n):
+            assert results[("json", i)] == results[("wire", i)], \
+                "codec divergence at request %d" % i
+
+        def counter_of(u, name):
+            with urllib.request.urlopen(u + "/metrics",
+                                        timeout=30) as resp:
+                text = resp.read().decode()
+            for line in text.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+            return 0.0
+
+        # with the relay on, EVERY router-relayed request reaches the
+        # replicas as one binary frame — the edge codec (JSON vs
+        # frames) must not leak into the replica-side codec split
+        binary = sum(counter_of(
+            r.url, "znicz_serving_codec_requests_codec_binary")
+            for r in ups)
+        assert binary == 2 * n, \
+            "expected %d binary-codec requests on the replicas, " \
+            "saw %d" % (2 * n, binary)
+        # a direct replica HTTP request is the http codec — the
+        # labels separate, not alias
+        req = urllib.request.Request(
+            ups[0].url + "/predict/m",
+            json.dumps({"inputs": seeded_x(0).tolist()}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            direct = json.loads(resp.read())["outputs"]
+        assert direct == results[("json", 0)]
+        http_codec = sum(counter_of(
+            r.url, "znicz_serving_codec_requests_codec_http")
+            for r in ups)
+        assert http_codec >= 1, "direct HTTP request not counted " \
+                                "under the http codec"
+        st = json.loads(urllib.request.urlopen(
+            url + "/statusz", timeout=10).read())
+        mux = st.get("wire") or {}
+        assert (mux.get("round_trips") or 0) >= 2 * n, mux
+        proto_errs = sum(counter_of(
+            r.url, "znicz_wire_protocol_errors") for r in ups)
+        assert proto_errs == 0, \
+            "%d wire protocol errors during the storm" % proto_errs
+        print("wire smoke OK: %d JSON + %d binary requests "
+              "concurrently through a 2-replica fleet, replies "
+              "bit-identical pairwise; %d relay round trips, 0 "
+              "protocol errors; replica codec split binary=%d "
+              "http=%d" % (n, n, mux.get("round_trips"),
+                           int(binary), int(http_codec)))
+    finally:
+        router.stop()
 
 
 if __name__ == "__main__":

@@ -95,7 +95,13 @@ SERIES_PREFIXES = frozenset((
     # burn-rate and error-budget series (serving/slo.py) and the
     # time-series sampler's own meters (core/timeseries.py)
     "slo", "snapshotter", "timeseries",
-    "trainer", "transfer", "unit", "workflow",
+    "trainer", "transfer", "unit",
+    # the binary framed relay (ISSUE 20): frame/byte/error meters on
+    # both the listener and the router-side mux (serving/wire.py) —
+    # wire.frames_in, wire.bytes_in, wire.protocol_errors,
+    # wire.round_trips, wire.dead_conns, ...
+    "wire",
+    "workflow",
 ))
 
 #: legal ``labeled()`` label keys — a bounded set by design (every
@@ -116,6 +122,10 @@ LABEL_KEYS = frozenset((
     # request data
     "replica",
     "scenario", "site",
+    # the binary framed relay (ISSUE 20): which transport carried a
+    # request into serving.codec_requests — exactly two values
+    # ("binary" / "http"), serving/server.py
+    "codec",
 ))
 
 #: identifiers that mark a label VALUE as derived from request data —

@@ -435,6 +435,37 @@ declare("common", {
         # router can prove a request never reached a replica's batcher
         # before retrying it on a peer (GET /admitted/<rid>)
         "admitted_rid_capacity": 4096,
+        # binary framed relay (serving/wire.py) — the persistent
+        # length-prefixed router<->replica transport; see
+        # docs/serving.md "Wire protocol" for the frame layout and
+        # the zero-copy ingest contract
+        "wire": {
+            "enabled": True,         # the binary relay is the DEFAULT
+                                     # router<->replica transport;
+                                     # False falls back to HTTP/JSON
+                                     # everywhere (the documented
+                                     # compatibility surface)
+            "conns_per_replica": 2,  # persistent mux connections the
+                                     # router keeps per replica
+            "max_frame_mb": 32.0,    # frame-body ceiling; oversize
+                                     # answers a typed error frame
+            "read_timeout_ms": 10000.0,  # half-frame (slowloris)
+                                         # sweep deadline
+            "workers": 128,          # listener dispatch threads.  A
+                                     # worker PARKS through the whole
+                                     # blocking /predict state
+                                     # machine, so this bounds how
+                                     # many in-flight frames reach
+                                     # lane admission concurrently —
+                                     # undersize it and overload
+                                     # queues FIFO in the pool AHEAD
+                                     # of the priority lanes (HTTP
+                                     # got this for free from thread-
+                                     # per-connection).  Sized past
+                                     # queue-limit so every arriving
+                                     # frame is shed or queued BY
+                                     # PRIORITY, never by arrival.
+        },
         # multi-replica serving fleet (serving/router.py +
         # serving/autoscaler.py) — see docs/serving.md "Fleet
         # topology" for every knob's meaning

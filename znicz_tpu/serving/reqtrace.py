@@ -57,6 +57,13 @@ track (router pid 0, replica pid 1).  The six
 ``ROUTER_TOP_LEVEL_KINDS`` partition ROUTER wall time — the fleet
 functional test pins parts-sum ≈ wall across the hop too.
 
+The binary relay (serving/wire.py, PR 20) adds two NESTED kinds —
+``frame_decode`` inside the replica's ``admission`` (the zero-copy
+``.npy`` parse) and ``relay_wait`` inside the router's
+``relay_reply`` (response frame complete on the mux loop → the relay
+worker resumed) — so binary-path traces stitch exactly like HTTP
+traces and neither partition gains a member.
+
 Gate discipline: every hook guards with :func:`enabled` — ONE config
 predicate — and an unsampled rid costs one dict lookup.  When off,
 nothing allocates (monkeypatch-boom pinned).
@@ -95,8 +102,17 @@ ROUTER_TOP_LEVEL_KINDS = ("route", "conn_acquire", "relay_send",
 ROUTER_REQUIRED_KINDS = ("route", "conn_acquire", "relay_send",
                          "replica_wait", "relay_reply")
 
+#: binary-relay hop kinds (serving/wire.py — PR 20).  Both NEST
+#: inside existing partition members, so neither joins a required or
+#: top-level set and both six-kind partitions stay exact:
+#: ``frame_decode`` (the replica's zero-copy ``.npy`` parse) nests in
+#: ``admission``; ``relay_wait`` (response frame complete on the mux
+#: loop → the relay worker thread resumed) nests in ``relay_reply``.
+WIRE_SPAN_KINDS = ("frame_decode", "relay_wait")
+
 #: the full vocabulary — :func:`add_span` stays LOUD on anything else
-_ALL_KINDS = frozenset(SPAN_KINDS) | frozenset(ROUTER_SPAN_KINDS)
+_ALL_KINDS = (frozenset(SPAN_KINDS) | frozenset(ROUTER_SPAN_KINDS) |
+              frozenset(WIRE_SPAN_KINDS))
 
 #: per-origin (required-for-complete, partition) kind sets
 _ORIGINS = {
@@ -234,12 +250,17 @@ def set_finish_sink(fn):
 
 
 def finish(rid, now=None, model=None):
-    """Close the tree (stamps the total wall time)."""
+    """Close the tree (stamps the total wall time).  First close
+    wins: a caller that knows the true reply stamp closes early with
+    ``now=``, and the surrounding safety-net ``finally`` close is a
+    no-op — post-reply bookkeeping never inflates the wall."""
     t = float(now if now is not None else time.monotonic())
     with _lock:
         tr = _traces.get(rid)
         if tr is None:
             return False
+        if tr.t_end is not None:
+            return True
         tr.t_end = t
         if model is not None:
             tr.model = model

@@ -103,7 +103,7 @@ from znicz_tpu.core.status_server import (BodyTooLargeError,
 from znicz_tpu.core import pyprof
 from znicz_tpu.core import telemetry
 from znicz_tpu.core import timeseries
-from znicz_tpu.serving import reqtrace
+from znicz_tpu.serving import reqtrace, wire
 from znicz_tpu.serving.release import (ReleaseConflictError,
                                        ReleaseController)
 from znicz_tpu.analysis import locksmith
@@ -243,6 +243,10 @@ class Replica(Logger):
         self.url = None
         self.host = None
         self.port = None
+        #: where the replica's binary framed relay listens
+        #: (serving/wire.py) — discovered from /healthz at rotation
+        #: entry; None = HTTP relay only
+        self.wire_port = None
         self.outstanding = 0        # in-flight proxied requests
         self.served = 0
         self.probe_failures = 0
@@ -292,6 +296,14 @@ class Replica(Logger):
                 with urllib.request.urlopen(self.url + "/healthz",
                                             timeout=5) as resp:
                     if resp.status == 200:
+                        try:
+                            # the ready payload carries the binary
+                            # relay port — stash it here so rotation
+                            # entry needs no second (raceable) probe
+                            self.wire_port = json.loads(
+                                resp.read()).get("wire_port")
+                        except ValueError:
+                            pass
                         return True
             except urllib.error.HTTPError:
                 pass      # 503: still warming
@@ -349,6 +361,7 @@ class Replica(Logger):
     def stats(self):
         return {
             "id": self.rid, "state": self.state, "url": self.url,
+            "wire_port": self.wire_port,
             "outstanding": self.outstanding, "served": self.served,
             "reason": self.reason, "pid": self.proc.pid,
             "exit_code": self.proc.poll(),
@@ -363,8 +376,115 @@ def _decode_predict_body(data, ctype):
     if (ctype or "").startswith("application/octet-stream") or \
             data[:6] == b"\x93NUMPY":
         return numpy.load(io.BytesIO(data))
-    doc = json.loads(data.decode())
+    doc = json.loads(bytes(data).decode())
     return numpy.asarray(doc["outputs"], dtype=numpy.float64)
+
+
+class _RouterWireExchange(object):
+    """One client REQUEST frame on the ROUTER's relay listener,
+    presented as the handler surface :meth:`FleetRouter
+    ._relay_predict` speaks.  The ``.npy`` body passes through to the
+    replica UNTOUCHED (``wire_meta`` marks the passthrough for
+    :func:`_wire_encode`) — a binary request is decoded exactly once
+    fleet-wide, at the replica, zero-copy.  Errors answer typed ERROR
+    frames; the winning reply answers a RESPONSE frame via
+    ``wire_reply`` (the :func:`_relay_reply` dispatch)."""
+
+    __slots__ = ("request", "wire_meta", "t_recv", "headers",
+                 "status")
+
+    def __init__(self, request):
+        meta = request.meta
+        self.request = request
+        self.wire_meta = meta
+        self.t_recv = request.t_recv
+        self.status = None
+        headers = {"Content-Type": "application/octet-stream"}
+        rid = meta.get("rid")
+        if rid:
+            headers["X-Request-Id"] = str(rid)
+        priority = meta.get("priority")
+        if priority:
+            headers["X-Priority"] = str(priority)
+        self.headers = headers
+
+    def _read_body(self):
+        return self.request.body
+
+    def _drain_body(self):
+        pass
+
+    def _send_json(self, code, obj, headers=None):
+        headers = headers or {}
+        self.status = int(code)
+        self.request.reply(wire.error_frame(
+            code, obj, rid=headers.get("X-Request-Id"),
+            retry_after=headers.get("Retry-After")))
+
+    def wire_reply(self, status, ctype, data, headers):
+        self.status = int(status)
+        if status >= 400 and (ctype or "").startswith(
+                "application/json"):
+            # a relayed replica error leaves as the SAME typed ERROR
+            # frame a direct-to-replica wire client would see — the
+            # payload is the JSON object either HTTP surface answers
+            try:
+                payload = json.loads(bytes(data))
+            except ValueError:
+                payload = {"error": bytes(data).decode("latin-1")}
+            self.request.reply(wire.error_frame(
+                status, payload, rid=headers.get("X-Request-Id"),
+                retry_after=headers.get("Retry-After")))
+            return
+        meta = {"status": int(status), "ctype": ctype}
+        for header, key in (("X-Request-Id", "rid"),
+                            ("X-Serving-Generation", "generation"),
+                            ("Retry-After", "retry_after")):
+            if headers.get(header) is not None:
+                meta[key] = headers[header]
+        self.request.reply(
+            wire.pack_frame(wire.KIND_RESPONSE, meta, data))
+
+
+def _wire_encode(handler, body, fwd_headers):
+    """The relay frame's ``(body, extras)`` for one ingress request.
+    A wire-ingest or ``.npy`` HTTP body passes through byte-for-byte
+    (decoded ONCE fleet-wide, at the replica); a JSON body is parsed
+    here — the edge — and re-leaves as ``.npy`` with
+    ``reply="json"``, so the replica answers the exact JSON schema
+    (same serializer) the compatibility surface documents.  Raises
+    :class:`ValueError` on a client-fault body (the 400 path)."""
+    meta = getattr(handler, "wire_meta", None)
+    if meta is not None:
+        extras = {k: meta[k] for k in ("timeout_ms", "reply")
+                  if meta.get(k) is not None}
+        return body, extras
+    ctype = (fwd_headers.get("Content-Type") or "").split(";")[0]
+    if ctype == "application/octet-stream" or \
+            body[:6] == b"\x93NUMPY":
+        return body, {}
+    doc = json.loads(bytes(body).decode() or "null")
+    extras = {"reply": "json"}
+    if isinstance(doc, dict):
+        inputs = doc.get("inputs")
+        if doc.get("timeout_ms") is not None:
+            extras["timeout_ms"] = doc["timeout_ms"]
+        if doc.get("model") is not None:
+            if not isinstance(doc["model"], str):
+                raise ValueError('"model" must be a string')
+            extras["model"] = doc["model"]
+        if doc.get("priority") is not None:
+            extras["priority"] = doc["priority"]
+    else:
+        inputs = doc
+    if inputs is None:
+        raise ValueError('body needs {"inputs": [[...], ...]} '
+                         "(or a raw .npy payload)")
+    # float64 == JSON's own number type: the replica's parse into the
+    # model dtype rounds exactly as it rounds the JSON list itself,
+    # so the two codecs answer bit-identical outputs
+    return wire.npy_bytes(numpy.asarray(inputs,
+                                        dtype=numpy.float64)), extras
 
 
 class _FleetTarget(object):
@@ -526,6 +646,12 @@ class FleetRouter(HttpServerBase):
         #: created lazily on the first POST /release/<model>
         self.release = None
         self._release_guard = None
+        #: the binary framed relay (serving/wire.py): the rid-
+        #: multiplexed persistent-connection pool to the replicas
+        #: (the DEFAULT transport when serving.wire.enabled) and the
+        #: router's own client-facing frame listener
+        self._wire_mux = None
+        self._wire = None
 
     # -- fleet membership ---------------------------------------------------
     def _spawn(self):
@@ -538,7 +664,32 @@ class FleetRouter(HttpServerBase):
             self._replicas.append(replica)
         return replica
 
+    def _discover_wire(self, replica):
+        """The replica's framed-relay port from its /healthz payload
+        (None on any failure — the HTTP relay then carries it until
+        the monitor's next probe retries the discovery).  A non-200
+        answer still carries the port: a warming/degraded 503 body is
+        the same payload."""
+        if self._wire_mux is None or replica.url is None:
+            return None
+        try:
+            with urllib.request.urlopen(replica.url + "/healthz",
+                                        timeout=5) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()
+        except OSError:
+            return None
+        try:
+            return json.loads(body).get("wire_port")
+        except ValueError:
+            return None
+
     def _enter_rotation(self, replica):
+        if replica.wire_port is None:
+            # normally stashed by wait_ready's 200 payload; a replica
+            # entering by another path gets one discovery probe here
+            replica.wire_port = self._discover_wire(replica)
         replica.state = UP
         replica.probe_failures = 0
         telemetry.record_event("fleet.replica_spawn",
@@ -549,6 +700,15 @@ class FleetRouter(HttpServerBase):
     def start(self, wait_ready=True):
         """Spawn the initial fleet (concurrently), wait until every
         replica is ready, then open the routing surface."""
+        if root.common.serving.get("wire", {}).get("enabled", True):
+            # the binary relay is the default transport: the mux must
+            # exist before the first replica enters rotation (its
+            # wire port is discovered there), and the router's own
+            # frame listener opens alongside the HTTP surface
+            self._wire_mux = wire.WireMux()
+            self._wire = wire.WireListener(
+                self._wire_group, host=self.host,
+                name="router").start()
         spawned = [self._spawn() for _ in range(self._n_initial)]
         timeout_s = float(_fleet.get("spawn_timeout_s", 180.0))
         if wait_ready:
@@ -649,6 +809,12 @@ class FleetRouter(HttpServerBase):
         if self.release is not None:
             self.release.stop()
         super(FleetRouter, self).stop()
+        if self._wire is not None:
+            self._wire.stop()
+            self._wire = None
+        if self._wire_mux is not None:
+            self._wire_mux.stop()
+            self._wire_mux = None
         self.shutdown_fleet()
 
     def drain(self):
@@ -657,6 +823,12 @@ class FleetRouter(HttpServerBase):
         self._draining = True
         telemetry.record_event("fleet.drain")
         self.stop()
+
+    @property
+    def wire_port(self):
+        """The router's own framed-relay listener port (mirrors the
+        replica contract), or None with the wire disabled."""
+        return self._wire.port if self._wire is not None else None
 
     # -- rotation -----------------------------------------------------------
     def replicas(self):
@@ -702,9 +874,20 @@ class FleetRouter(HttpServerBase):
         with self._lock:
             if replica.state == DEAD:
                 return False
+            if replica.state == state:
+                # a planned retire raced the monitor's own draining
+                # probe: the first eject wins and keeps its reason
+                return False
             replica.state = state
             replica.reason = reason
         replica.close_conns()
+        if state == DEAD and self._wire_mux is not None:
+            # parked frames fail fast ONLY on a dead replica — a
+            # DRAINING one is still serving what it already admitted,
+            # so its in-flight frames must be left to complete (the
+            # zero-loss drain; close_conns above only closes PARKED
+            # keep-alives, the HTTP analog of the same rule)
+            self._wire_mux.drop(replica.rid)
         if telemetry.enabled():
             telemetry.counter("router.replica_ejections").inc()
         self._set_gauges()
@@ -744,7 +927,12 @@ class FleetRouter(HttpServerBase):
                     self.warning("replica %s died (exit %s)",
                                  replica.rid, code)
             elif replica.state == DRAINING:
+                # a finished drain: now the conns can go — any frame
+                # still parked on the mux died with the process
                 replica.state = DEAD
+                replica.close_conns()
+                if self._wire_mux is not None:
+                    self._wire_mux.drop(replica.rid)
                 self._set_gauges()
             return
         if replica.state != UP:
@@ -754,6 +942,10 @@ class FleetRouter(HttpServerBase):
                                         timeout=5) as resp:
                 payload = json.loads(resp.read())
             replica.probe_failures = 0
+            if replica.wire_port is None:
+                # a hiccup at rotation entry must not demote the
+                # replica to HTTP relay forever
+                replica.wire_port = payload.get("wire_port")
             if payload.get("draining"):
                 self._eject(replica, DRAINING, "draining")
         except urllib.error.HTTPError as e:
@@ -778,7 +970,7 @@ class FleetRouter(HttpServerBase):
 
     # -- the proxy ----------------------------------------------------------
     def _send_to(self, replica, method, path, body, headers,
-                 trace=None):
+                 trace=None, t0=None):
         """One forwarded request over a (reused) keep-alive
         connection.  Raises :class:`_NeverSentError` when the connect
         failed (resend safe) and :class:`_SentUnknownError` when the
@@ -791,6 +983,10 @@ class FleetRouter(HttpServerBase):
         ``first_byte`` stamp) — the caller commits them only for the
         attempt that actually answered, so a failed attempt collapses
         into one ``retry`` span and the partition stays exact."""
+        if isinstance(body, memoryview):
+            # wire-ingest fallback (a replica without a relay port):
+            # the frame body rides as a plain HTTP .npy POST
+            body = bytes(body)
         head = ["%s %s HTTP/1.1" % (method, path),
                 "Host: %s:%d" % (replica.host, replica.port),
                 "Content-Length: %d" % len(body or b"")]
@@ -798,7 +994,8 @@ class FleetRouter(HttpServerBase):
             head.append("%s: %s" % (key, value))
         request_bytes = ("\r\n".join(head) + "\r\n\r\n").encode(
             "latin-1") + (body or b"")
-        t_acq = time.monotonic() if trace is not None else 0.0
+        t_acq = (t0 if t0 is not None else time.monotonic()) \
+            if trace is not None else 0.0
         conn, reused = replica.get_conn()
         t_send = time.monotonic() if trace is not None else 0.0
         timing = {} if trace is not None else None
@@ -825,6 +1022,67 @@ class FleetRouter(HttpServerBase):
                  {"replica": replica.rid}),
             ]
             trace["first_byte"] = timing["first_byte"]
+        return status, resp_headers, data
+
+    def _send_wire(self, replica, meta, body, trace=None, t0=None):
+        """One forwarded request over the binary relay — the same
+        ``(status, resp_headers, data)`` contract (and the same
+        retry-safety exception taxonomy) as :meth:`_send_to`, so the
+        relay loop treats the two transports identically.  The frame
+        round-trips on the rid-multiplexed persistent mux
+        (:class:`~znicz_tpu.serving.wire.WireMux`): no per-request
+        connect, no HTTP head, no body re-encode."""
+        t_acq = (t0 if t0 is not None else time.monotonic()) \
+            if trace is not None else 0.0
+        timing = {} if trace is not None else None
+        try:
+            kind, rmeta, rbody, t_frame = self._wire_mux.round_trip(
+                replica.rid, (replica.host, replica.wire_port),
+                meta, body, timeout=_PROXY_TIMEOUT, timing=timing)
+        except wire.WireConnectError as e:
+            raise _NeverSentError(repr(e))
+        except wire.WireTimeoutError as e:
+            raise _SentUnknownError(repr(e), timed_out=True)
+        except (wire.WireDeadError, OSError) as e:
+            raise _SentUnknownError(repr(e))
+        status = int(rmeta.get("status", 502))
+        resp_headers = {}
+        if kind == wire.KIND_ERROR:
+            # the ERROR frame's payload IS the JSON object the HTTP
+            # surface would have answered — every downstream
+            # classifier (_refused_pre_admission, the client relay)
+            # reads it unchanged
+            data = json.dumps(rmeta.get("payload") or {}).encode()
+            resp_headers["Content-Type"] = "application/json"
+        else:
+            data = bytes(rbody)
+            resp_headers["Content-Type"] = (rmeta.get("ctype") or
+                                            "application/octet-stream")
+            if rmeta.get("serving_ms") is not None:
+                resp_headers["X-Serving-Ms"] = str(rmeta["serving_ms"])
+            if rmeta.get("generation"):
+                resp_headers["X-Serving-Generation"] = \
+                    rmeta["generation"]
+        if rmeta.get("retry_after") is not None:
+            resp_headers["Retry-After"] = str(rmeta["retry_after"])
+        if trace is not None:
+            # the worker stamps t_sent AFTER _sendall_nb returns; on
+            # a fast hop the reply frame can complete on the mux loop
+            # before this worker is scheduled again — clamp so
+            # replica_wait never runs backwards
+            t_sent = min(timing.get("t_sent", t_acq), t_frame)
+            trace["spans"] = [
+                ("conn_acquire", t_acq,
+                 timing.get("t_acquire", t_acq), {"mux": True}),
+                ("relay_send", timing.get("t_acquire", t_acq),
+                 t_sent, None),
+                ("replica_wait", t_sent, t_frame,
+                 {"replica": replica.rid, "wire": True}),
+            ]
+            trace["first_byte"] = t_frame
+            # frame complete on the mux loop -> this worker resumed:
+            # the relay_wait span, NESTED inside relay_reply
+            trace["resumed"] = time.monotonic()
         return status, resp_headers, data
 
     def _rid_admitted(self, replica, rid, sent_at):
@@ -876,13 +1134,43 @@ class FleetRouter(HttpServerBase):
             return "warming"
         return None
 
+    def _wire_group(self, group):
+        """Front-door binary ingest: every complete frame the
+        listener loop drained from one readable socket arrives as a
+        group.  Each becomes a :class:`_RouterWireExchange` and runs
+        the SAME `_proxy_predict` path as HTTP — same sampling, same
+        retry/oracle/breaker logic — only the transport at both edges
+        differs.  Trailing requests fan out to the pool so one slow
+        relay never holds up its coalesced siblings."""
+        exchanges = []
+        for req in group:
+            exchanges.append(_RouterWireExchange(req))
+        for ex in exchanges[1:]:
+            self._wire.submit(self._wire_relay_one, ex)
+        if exchanges:
+            self._wire_relay_one(exchanges[0])
+
+    def _wire_relay_one(self, ex):
+        model = ex.wire_meta.get("model")
+        path = "/predict/%s" % model if model else "/predict"
+        try:
+            self._proxy_predict(ex, path)
+        except Exception as e:  # noqa: BLE001 -- keep the conn sane
+            if ex.status is None:
+                ex.request.reply(wire.error_frame(
+                    500, {"error": str(e),
+                          "request_id": ex.wire_meta.get("rid")},
+                    rid=ex.wire_meta.get("rid")))
+
     def _proxy_predict(self, handler, path):
         """One routed /predict: head-samples the admission under the
         shared ``trace_sample_n`` knob (origin="router"), then hands
         the relay to :meth:`_relay_predict`.  The wrapper owns
         closing the tree so every early-return error path still
         stamps its wall time."""
-        t_recv = time.monotonic()
+        # a wire-ingest exchange back-dates receipt to its frame's
+        # completion on the listener loop, like the replica side
+        t_recv = getattr(handler, "t_recv", None) or time.monotonic()
         if telemetry.enabled():
             telemetry.counter("router.requests").inc()
         rid = (handler.headers.get("X-Request-Id") or "").strip()
@@ -939,6 +1227,25 @@ class FleetRouter(HttpServerBase):
             if cand is not None:
                 path = "/predict/" + cand
                 model = cand
+        # binary relay (the default transport): encode the frame body
+        # ONCE before the attempt loop — a wire/.npy ingress passes
+        # through byte-for-byte, a JSON ingress is parsed here at the
+        # edge and re-leaves as .npy (decoded exactly once fleet-wide)
+        wire_body = wire_extras = None
+        if self._wire_mux is not None:
+            try:
+                wire_body, wire_extras = _wire_encode(
+                    handler, body, fwd_headers)
+            except ValueError as e:
+                handler._send_json(400, {"error": repr(e),
+                                         "request_id": rid},
+                                   headers=echo)
+                return
+            if model is None and wire_extras.get("model") is not None:
+                # the body's "model" routes exactly as the HTTP relay
+                # lets the replica route it — and rides in the frame
+                # meta, not re-serialized into the body
+                model = live_model = wire_extras["model"]
         hops = []   # committed (kind, t0, t1) spans — the histograms
         if traced:
             t_route = time.monotonic()
@@ -947,6 +1254,12 @@ class FleetRouter(HttpServerBase):
         retries = int(_fleet.get("route_retries", 2))
         tried = set()
         for attempt in range(retries + 1):
+            # the attempt clock starts BEFORE the pick: replica
+            # selection (a lock) and per-attempt meta assembly land
+            # inside conn_acquire, so the hop phases tile the wall
+            # with no gap — the partition pin holds even when the
+            # binary relay shrinks the hop to ~1ms
+            attempt_t0 = time.monotonic() if traced else 0.0
             replica = self._pick(exclude=tried)
             if replica is None:
                 handler._send_json(
@@ -956,12 +1269,27 @@ class FleetRouter(HttpServerBase):
                 return
             tried.add(replica.rid)
             sent_at = time.time()
-            attempt_t0 = time.monotonic() if traced else 0.0
             hop = {} if traced else None
             try:
-                status, resp_headers, data = self._send_to(
-                    replica, "POST", path, body, fwd_headers,
-                    trace=hop)
+                if wire_body is not None and replica.wire_port:
+                    meta = {"rid": rid}
+                    for key, value in wire_extras.items():
+                        if key != "model":  # the path/canary wins
+                            meta[key] = value
+                    if model is not None:
+                        meta["model"] = model
+                    if fwd_headers.get("X-Priority"):
+                        meta["priority"] = fwd_headers["X-Priority"]
+                    if "X-Trace-Sampled" in fwd_headers:
+                        meta["sampled"] = \
+                            fwd_headers["X-Trace-Sampled"]
+                    status, resp_headers, data = self._send_wire(
+                        replica, meta, wire_body, trace=hop,
+                        t0=attempt_t0 if traced else None)
+                else:
+                    status, resp_headers, data = self._send_to(
+                        replica, "POST", path, body, fwd_headers,
+                        trace=hop, t0=attempt_t0 if traced else None)
             except _NeverSentError:
                 # nothing went out: resend is safe by construction
                 self._release(replica)
@@ -1075,7 +1403,20 @@ class FleetRouter(HttpServerBase):
                 first = hop.get("first_byte", t_done)
                 reqtrace.add_span(rid, "relay_reply", first, t_done)
                 hops.append(("relay_reply", first, t_done))
+                if "resumed" in hop:
+                    # binary relay only: frame complete on the mux
+                    # loop -> the relay worker resumed (nested in
+                    # relay_reply — the partition stays exact)
+                    reqtrace.add_span(rid, "relay_wait", first,
+                                      hop["resumed"])
+                    hops.append(("relay_wait", first,
+                                 hop["resumed"]))
                 reqtrace.set_model(rid, model)
+                # close the tree AT the reply stamp: the histogram
+                # and overhead bookkeeping below happen after the
+                # client already has its bytes, and must not count
+                # against the hop-phase partition
+                reqtrace.finish(rid, now=t_done)
                 self._note_hops(model, hops)
             serving_ms = resp_headers.get("X-Serving-Ms")
             if status == 200 and serving_ms:
@@ -1482,6 +1823,10 @@ class FleetRouter(HttpServerBase):
             "replicas_up": up,
             "replicas": blocks,
         }
+        if self._wire is not None:
+            # mirrors the replica contract: wire-aware clients
+            # (loadgen --wire binary) discover the relay port here
+            payload["wire_port"] = self._wire.port
         if self._draining:
             payload["draining"] = True
             return 503, payload
@@ -1502,6 +1847,9 @@ class FleetRouter(HttpServerBase):
         }
         if self.autoscaler is not None:
             payload["autoscaler"] = self.autoscaler.status()
+        if self._wire is not None:
+            payload["wire"] = dict(self._wire_mux.stats(),
+                                   port=self._wire.port)
         return payload
 
     def models(self):
@@ -1655,7 +2003,13 @@ _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
 def _relay_reply(handler, status, ctype, data, headers):
     """Write a proxied reply in ONE buffered send, bypassing
     ``send_response``'s per-reply date formatting and logging — the
-    relay's reply path is as hot as its forward path."""
+    relay's reply path is as hot as its forward path.  A wire-ingest
+    exchange (:class:`_RouterWireExchange`) answers a RESPONSE frame
+    instead."""
+    wire_reply = getattr(handler, "wire_reply", None)
+    if wire_reply is not None:
+        wire_reply(status, ctype, data, headers)
+        return
     lines = ["HTTP/1.1 %d %s" % (status,
                                  _REASONS.get(status, "Status")),
              "Content-Type: %s" % ctype,

@@ -106,7 +106,7 @@ from znicz_tpu.core.config import root
 from znicz_tpu.core.status_server import (BodyTooLargeError, HandlerBase,
                                           HttpServerBase)
 from znicz_tpu.core import blackbox, compile_cache, pyprof, telemetry
-from znicz_tpu.serving import reqtrace, slo
+from znicz_tpu.serving import reqtrace, slo, wire
 from znicz_tpu.serving.batcher import (BatcherStoppedError, MicroBatcher,
                                        QueueFullError,
                                        RequestTimeoutError)
@@ -118,6 +118,85 @@ from znicz_tpu.serving.release import (LocalTarget,
                                        ReleaseConflictError,
                                        ReleaseController,
                                        generation_label)
+
+
+class _WireExchange(object):
+    """One binary-relay REQUEST frame presented as the handler surface
+    :meth:`ServingServer._predict` speaks — the wire path runs the
+    SAME /predict state machine as HTTP (SLO accounting, priority
+    lanes, admitted-rid oracle, breaker, drain, tracing all ride
+    along), only the codec differs.  The pre-parsed zero-copy array
+    rides in ``wire_inputs``; ``t_recv`` back-dates admission to the
+    frame's completion on the event loop; ``pre_spans`` carries the
+    ``frame_decode`` span for sampled rids.  Replies go out as
+    RESPONSE frames (200) or typed ERROR frames (everything else) the
+    moment the state machine answers."""
+
+    __slots__ = ("request", "meta", "wire_inputs", "t_recv",
+                 "pre_spans", "headers", "status", "t_sent")
+
+    def __init__(self, request, arr, decode_span):
+        meta = request.meta
+        self.request = request
+        self.meta = meta
+        self.wire_inputs = arr
+        self.t_recv = request.t_recv
+        self.pre_spans = (("frame_decode",) + decode_span,)
+        self.status = None
+        #: stamped just BEFORE the reply frame is written — the
+        #: tracing wall must close no later than the router's frame
+        #: read (its replica_wait end), and a post-write stamp can
+        #: lag by a whole GIL switch interval while this worker
+        #: waits to run again
+        self.t_sent = None
+        headers = {"Content-Type": "application/octet-stream"}
+        rid = meta.get("rid")
+        if rid:
+            headers["X-Request-Id"] = str(rid)
+        priority = meta.get("priority")
+        if priority:
+            headers["X-Priority"] = str(priority)
+        sampled = meta.get("sampled")
+        if sampled is not None:
+            headers["X-Trace-Sampled"] = str(sampled)
+        self.headers = headers
+
+    # the handler surface _predict/_predict_inner touches
+    def _read_body(self):
+        return b""
+
+    def _drain_body(self):
+        pass
+
+    def _send_json(self, code, obj, headers=None):
+        headers = headers or {}
+        self.status = int(code)
+        if int(code) == 200:
+            # a JSON-reply 200 (the router relays it verbatim to a
+            # JSON client — the SAME serializer the HTTP surface
+            # uses, so the two codecs answer bit-identical bodies)
+            self._reply_frame(code, "application/json",
+                              json.dumps(obj).encode(), headers)
+            return
+        self.t_sent = time.monotonic()
+        self.request.reply(wire.error_frame(
+            code, obj, rid=headers.get("X-Request-Id"),
+            retry_after=headers.get("Retry-After")))
+
+    def _send(self, code, ctype, body, headers=None):
+        self.status = int(code)
+        self._reply_frame(code, ctype, body, headers or {})
+
+    def _reply_frame(self, code, ctype, body, headers):
+        meta = {"status": int(code), "ctype": ctype}
+        for header, key in (("X-Request-Id", "rid"),
+                            ("X-Serving-Ms", "serving_ms"),
+                            ("X-Serving-Generation", "generation")):
+            if headers.get(header) is not None:
+                meta[key] = headers[header]
+        self.t_sent = time.monotonic()
+        self.request.reply(
+            wire.pack_frame(wire.KIND_RESPONSE, meta, body))
 
 
 class ServingServer(HttpServerBase):
@@ -173,8 +252,69 @@ class ServingServer(HttpServerBase):
         if registry is not None:
             self.release = ReleaseController(
                 LocalTarget(registry, self.slo))
+        #: the binary framed-relay listener (serving/wire.py) — armed
+        #: by start() when root.common.serving.wire.enabled (the
+        #: default transport a fleet router speaks to this replica)
+        self._wire = None
+
+    def start(self):
+        # the relay listener arms BEFORE the HTTP surface opens: the
+        # first healthz 200 a fleet router sees must already carry
+        # wire_port (wait_ready stashes it from that very payload —
+        # arming after would race the router's discovery)
+        if root.common.serving.get("wire", {}).get("enabled", True):
+            self._wire = wire.WireListener(
+                self._wire_group, host=self.host,
+                name="replica").start()
+        super(ServingServer, self).start()
+        return self
+
+    @property
+    def wire_port(self):
+        return self._wire.port if self._wire is not None else None
+
+    def _wire_group(self, group):
+        """Handler for the framed-relay listener: the requests a
+        readable pass drained together decode their ``.npy`` bodies
+        in ONE sweep (coalesced frame decode — queued same-lane
+        requests pay the codec as a group, the way their dispatch
+        coalesces downstream), then each runs the SAME /predict state
+        machine the HTTP surface runs.  The first request continues
+        on this worker; the rest fan out to the listener's pool."""
+        exchanges = []
+        for req in group:
+            t0 = time.monotonic()
+            try:
+                arr = wire.parse_npy(req.body)
+            except ValueError as e:
+                req.reply(wire.error_frame(
+                    400, {"error": repr(e),
+                          "request_id": req.meta.get("rid")},
+                    rid=req.meta.get("rid")))
+                continue
+            exchanges.append(_WireExchange(req, arr,
+                                           (t0, time.monotonic())))
+        for ex in exchanges[1:]:
+            self._wire.submit(self._wire_one, ex)
+        if exchanges:
+            self._wire_one(exchanges[0])
+
+    def _wire_one(self, ex):
+        try:
+            self._predict(ex, model=ex.meta.get("model"))
+        except Exception as e:  # noqa: BLE001 - always answer a frame
+            self.warning("wire predict %s failed: %r",
+                         ex.meta.get("rid"), e)
+            if ex.status is None:
+                ex.request.reply(wire.error_frame(
+                    500, {"error": repr(e),
+                          "request_id": ex.meta.get("rid")},
+                    rid=ex.meta.get("rid")))
 
     def stop(self):
+        if self._wire is not None:
+            self._wire.stop()
+            self._wire = None
         super(ServingServer, self).stop()
         if self.release is not None:
             self.release.stop()
@@ -219,6 +359,8 @@ class ServingServer(HttpServerBase):
             payload = dict(self.engine.stats())
             payload["compile_cache"] = compile_cache.stats()
         payload["queued_rows"] = self.batcher.queued_rows
+        if self._wire is not None:
+            payload["wire"] = {"port": self._wire.port}
         if slo.enabled():
             payload["slo"] = self.slo.status()
         if telemetry.enabled():
@@ -236,9 +378,10 @@ class ServingServer(HttpServerBase):
         global health nor pulls the healthy models out of rotation.
         """
         if self.registry is None:
-            stats = self.engine.stats()
+            stats = dict(self.engine.stats(),
+                         wire_port=self.wire_port)
             if self._draining:
-                stats = dict(stats, ready=False, draining=True)
+                stats.update(ready=False, draining=True)
             return (200 if stats["ready"] else 503), stats
         readiness = self.registry.readiness()
         any_ready = any(readiness.values())
@@ -252,6 +395,10 @@ class ServingServer(HttpServerBase):
             # per-model stats, ONE compile-cache directory walk)
             "memory": self.registry.memory_stats(),
             "compile_cache": compile_cache.stats(),
+            # where this replica's binary framed relay listens (None
+            # = wire disabled) — the fleet router discovers the
+            # relay port here when it enters a replica into rotation
+            "wire_port": self.wire_port,
         }
         if self._draining:
             payload["draining"] = True
@@ -265,13 +412,30 @@ class ServingServer(HttpServerBase):
         the model is known — it must parse straight into THAT model's
         dtype.  The ``X-Priority`` header wins over the body's
         ``priority`` field (the router forwards the header)."""
+        arr = getattr(handler, "wire_inputs", None)
+        if arr is not None:
+            # binary relay (_WireExchange): the body already parsed
+            # ZERO-COPY over the frame's memoryview on the listener —
+            # request metadata rides in the frame, not in headers.
+            # reply="json" asks for the JSON 200 schema (a router
+            # relaying to a JSON client); the default is raw .npy.
+            meta = handler.meta
+            model = meta.get("model")
+            if model is not None and not isinstance(model, str):
+                raise ValueError('"model" must be a string')
+            return (arr, meta.get("timeout_ms"),
+                    meta.get("reply") != "json", model,
+                    normalize_priority(meta.get("priority")))
         body = handler._read_body()
         ctype = (handler.headers.get("Content-Type") or "").split(";")[0]
         priority = (handler.headers.get("X-Priority") or "").strip() \
             or None
         if ctype == "application/octet-stream" or \
                 body[:6] == b"\x93NUMPY":
-            return (numpy.load(io.BytesIO(body)), None, True, None,
+            # same zero-copy ingest as the wire path: the array
+            # materializes straight over the request body's buffer
+            # (wire.parse_npy), no io.BytesIO/numpy.load copy
+            return (wire.parse_npy(body), None, True, None,
                     normalize_priority(priority))
         doc = json.loads(body.decode() or "null")
         if isinstance(doc, dict):
@@ -310,7 +474,16 @@ class ServingServer(HttpServerBase):
         429/503/504/500 and over-SLO 200s burn the budget; 400-class
         client faults do not)."""
         rid = self._request_id(handler)
-        t_admit = time.monotonic()
+        # a wire exchange back-dates admission to the frame's
+        # completion on the event loop — the decode + dispatch queue
+        # time counts against the request, as a client experiences it
+        t_admit = getattr(handler, "t_recv", None) or time.monotonic()
+        if telemetry.enabled():
+            telemetry.counter(telemetry.labeled(
+                "serving.codec_requests",
+                codec=("binary"
+                       if getattr(handler, "wire_inputs", None)
+                       is not None else "http"))).inc()
         sampled_hdr = (handler.headers.get("X-Trace-Sampled")
                        or "").strip()
         if sampled_hdr == "1":
@@ -328,10 +501,17 @@ class ServingServer(HttpServerBase):
         else:
             traced = reqtrace.enabled() and reqtrace.begin(
                 rid, now=t_admit)
+        if traced:
+            # relay pre-spans (frame_decode): stamped on the wire
+            # listener before this state machine ran — NESTED inside
+            # the admission window, so the partition stays exact
+            for kind, t0, t1 in getattr(handler, "pre_spans", ()):
+                reqtrace.add_span(rid, kind, t0, t1)
         code, slo_model = self._predict_inner(handler, rid, model,
                                               t_admit, traced)
         if traced:
-            reqtrace.finish(rid, model=slo_model)
+            reqtrace.finish(rid, model=slo_model,
+                            now=getattr(handler, "t_sent", None))
         if slo.enabled():
             self.slo.record(slo_model, code,
                             (time.monotonic() - t_admit) * 1e3,
@@ -499,7 +679,12 @@ class ServingServer(HttpServerBase):
             handler._send_json(200, payload, headers=ok_headers)
         if traced:
             # reply span: future resolved -> response bytes written
-            reqtrace.add_span(rid, "reply", t_reply, time.monotonic())
+            # (a wire exchange stamped the write itself — closing at
+            # "now" would bill this worker's re-schedule latency to
+            # the reply and overflow the router's replica_wait window)
+            reqtrace.add_span(rid, "reply", t_reply,
+                              getattr(handler, "t_sent", None)
+                              or time.monotonic())
         if ctl is not None and routed is model and ctl.active():
             # shadow mirror (serving/release.py): the client's reply
             # is already on the wire — the candidate compare happens
